@@ -1,0 +1,26 @@
+"""Analytics Zoo TPU — a TPU-native deep-learning framework.
+
+A from-scratch re-design of Analytics Zoo's capabilities
+(reference: /root/reference, Scala/Spark/BigDL) as an idiomatic
+JAX/XLA/Pallas framework:
+
+- ``core``     — context/mesh init, config, triggers, TensorBoard writer
+                 (replaces NNContext / ZooTrigger / zoo.tensorboard).
+- ``data``     — FeatureSet-style host datasets with memory tiers, image &
+                 text preprocessing (replaces zoo.feature.*).
+- ``nn``       — Keras-style Sequential/Model + autograd Variable DSL,
+                 layers, objectives, metrics (replaces
+                 zoo.pipeline.api.keras / autograd).
+- ``train``    — Estimator: one jitted SPMD train step with XLA collectives
+                 (replaces InternalDistriOptimizer / AllReduceParameter).
+- ``parallel`` — mesh construction, sharding rules, ring attention
+                 (replaces the Spark block-manager allreduce backend).
+- ``ops``      — Pallas TPU kernels (flash attention, NMS, ...).
+- ``models``   — built-in model zoo (NCF, WideAndDeep, AnomalyDetector,
+                 TextClassifier, Seq2seq, KNRM, SSD, BERT ...).
+- ``deploy``   — InferenceModel multi-backend serving + cluster serving.
+"""
+
+__version__ = "0.1.0"
+
+from analytics_zoo_tpu.core.context import init_zoo_context, ZooContext  # noqa: F401

@@ -1,0 +1,125 @@
+"""Unified typed configuration.
+
+The reference spreads configuration over four mechanisms (Spark conf files,
+env vars, JVM system properties, per-app CLI/YAML — see
+reference common/NNContext.scala:188-237 and
+serving/utils/ClusterServingHelper.scala:104-170).  Here a single dataclass
+is the source of truth; env vars with the ``ZOO_`` prefix override fields,
+and YAML/dict loading covers the serving use-case.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+_ENV_PREFIX = "ZOO_"
+
+
+@dataclass
+class ZooConfig:
+    """Global framework configuration.
+
+    Fields mirror the *capabilities* of the reference's config surface:
+    engine/thread tuning becomes XLA/mesh settings, failure-retry knobs keep
+    their semantics (reference api/keras/models/Topology.scala:1180-1181).
+    """
+
+    # --- device / mesh ---------------------------------------------------
+    platform: Optional[str] = None          # None = let JAX pick (tpu>cpu)
+    mesh_shape: Optional[Tuple[int, ...]] = None   # None = all devices on "data"
+    mesh_axis_names: Tuple[str, ...] = ("data",)
+    # Preferred compute dtype for matmul-heavy paths (MXU wants bf16).
+    compute_dtype: str = "float32"
+
+    # --- training --------------------------------------------------------
+    # Failure-retry semantics of InternalDistriOptimizer.train
+    # (reference Topology.scala:1179-1261).
+    failure_retry_times: int = 5
+    failure_retry_interval_s: float = 120.0
+    checkpoint_dir: Optional[str] = None
+    # Async checkpointing (orbax) on by default.
+    async_checkpoint: bool = True
+
+    # --- data ------------------------------------------------------------
+    # Memory tier for FeatureSet caches: DRAM | DISK_AND_DRAM | DIRECT
+    # (reference feature/pmem/NativeArray.scala:21-37; PMEM itself has no
+    # TPU-host equivalent — DISK_AND_DRAM covers the capacity use-case).
+    default_memory_type: str = "DRAM"
+    data_prefetch: int = 2                  # batches prefetched to device
+    shuffle_buffer: int = 10000
+
+    # --- logging / summaries --------------------------------------------
+    log_level: str = "INFO"
+    tensorboard_dir: Optional[str] = None
+
+    # --- misc ------------------------------------------------------------
+    seed: int = 42
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+    @classmethod
+    def from_env(cls, **overrides: Any) -> "ZooConfig":
+        """Build a config from defaults <- ZOO_* env vars <- overrides."""
+        kwargs: Dict[str, Any] = {}
+        for f in dataclasses.fields(cls):
+            env_key = _ENV_PREFIX + f.name.upper()
+            if env_key in os.environ:
+                kwargs[f.name] = _coerce(os.environ[env_key], f.type)
+        kwargs.update(overrides)
+        return cls(**kwargs)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ZooConfig":
+        names = {f.name for f in dataclasses.fields(cls)}
+        known = {k: v for k, v in d.items() if k in names}
+        extra = {k: v for k, v in d.items() if k not in names}
+        cfg = cls(**known)
+        cfg.extra.update(extra)
+        return cfg
+
+    @classmethod
+    def from_yaml(cls, path: str) -> "ZooConfig":
+        try:
+            import yaml  # type: ignore
+
+            with open(path) as f:
+                d = yaml.safe_load(f) or {}
+        except ImportError:
+            with open(path) as f:
+                d = json.load(f)
+        return cls.from_dict(_flatten(d))
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    def replace(self, **kw: Any) -> "ZooConfig":
+        return dataclasses.replace(self, **kw)
+
+
+def _flatten(d: Dict[str, Any], prefix: str = "") -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+    for k, v in d.items():
+        key = f"{prefix}{k}" if not prefix else f"{prefix}_{k}"
+        if isinstance(v, dict):
+            out.update(_flatten(v, key))
+        else:
+            out[key] = v
+    return out
+
+
+def _coerce(raw: str, typ: Any) -> Any:
+    t = str(typ)
+    if "int" in t and "Tuple" not in t:
+        return int(raw)
+    if "float" in t:
+        return float(raw)
+    if "bool" in t:
+        return raw.lower() in ("1", "true", "yes", "on")
+    if "Tuple" in t or "Sequence" in t:
+        return tuple(
+            int(x) if x.strip().isdigit() else x.strip() for x in raw.split(",")
+        )
+    return raw

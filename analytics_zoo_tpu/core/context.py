@@ -1,0 +1,136 @@
+"""Framework context: device discovery + mesh construction.
+
+Replaces the reference's ``NNContext.initNNContext`` (common/NNContext.scala:133-148)
+which creates a SparkContext, applies engine config and calls BigDL
+``Engine.init``.  On TPU there is no cluster-manager handshake: a single
+controller process discovers the devices JAX exposes, builds a
+``jax.sharding.Mesh`` over them, and all parallelism is expressed as
+shardings over that mesh (XLA inserts the ICI/DCN collectives).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from dataclasses import dataclass
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from analytics_zoo_tpu.core.config import ZooConfig
+
+logger = logging.getLogger("analytics_zoo_tpu")
+
+_GLOBAL_CONTEXT: Optional["ZooContext"] = None
+
+
+@dataclass
+class ZooContext:
+    """Holds the device mesh and global config.
+
+    The mesh always exists (1-device meshes are fine) so every code path is
+    written SPMD-first; single-chip is just the degenerate mesh.
+    """
+
+    config: ZooConfig
+    mesh: "jax.sharding.Mesh"
+
+    # ------------------------------------------------------------------
+    @property
+    def num_devices(self) -> int:
+        return int(np.prod(self.mesh.devices.shape))
+
+    @property
+    def data_axis(self) -> str:
+        return self.config.mesh_axis_names[0]
+
+    def data_sharding(self, ndim: int = 1):
+        """NamedSharding that shards dim 0 over the data axis, replicates rest."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        spec = P(self.data_axis, *([None] * (ndim - 1)))
+        return NamedSharding(self.mesh, spec)
+
+    def replicated_sharding(self):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        return NamedSharding(self.mesh, P())
+
+
+def init_zoo_context(
+    config: Optional[ZooConfig] = None,
+    *,
+    mesh_shape: Optional[Sequence[int]] = None,
+    axis_names: Optional[Sequence[str]] = None,
+    multihost: bool = False,
+    **config_overrides,
+) -> ZooContext:
+    """Initialise (or re-initialise) the global framework context.
+
+    Parameters mirror capabilities of ``init_nncontext`` /
+    ``init_spark_on_local`` / ``init_spark_on_yarn``
+    (reference pyzoo/zoo/common/nncontext.py:23-104): instead of a Spark
+    master/cores/executors topology the caller describes a device mesh.
+
+    ``multihost=True`` runs ``jax.distributed.initialize()`` so the same
+    program scales to multi-host pods over DCN (replacing the reference's
+    Spark-driver + block-manager transport, docs/wp-bigdl.md:140-160).
+    """
+    global _GLOBAL_CONTEXT
+    import jax
+
+    if config is None:
+        config = ZooConfig.from_env(**config_overrides)
+    elif config_overrides:
+        config = config.replace(**config_overrides)
+
+    logging.basicConfig(level=getattr(logging, config.log_level.upper(), 20))
+
+    if multihost:
+        jax.distributed.initialize()
+
+    if mesh_shape is not None:
+        config = config.replace(mesh_shape=tuple(mesh_shape))
+    if axis_names is not None:
+        config = config.replace(mesh_axis_names=tuple(axis_names))
+
+    devices = jax.devices(config.platform) if config.platform else jax.devices()
+    mesh = make_mesh(devices, config.mesh_shape, config.mesh_axis_names)
+
+    _GLOBAL_CONTEXT = ZooContext(config=config, mesh=mesh)
+    logger.info(
+        "init_zoo_context: %d device(s) %s, mesh %s axes %s",
+        len(devices),
+        devices[0].platform,
+        dict(zip(mesh.axis_names, mesh.devices.shape)),
+        mesh.axis_names,
+    )
+    return _GLOBAL_CONTEXT
+
+
+def make_mesh(devices, mesh_shape, axis_names) -> "jax.sharding.Mesh":
+    from jax.sharding import Mesh
+
+    n = len(devices)
+    if mesh_shape is None:
+        mesh_shape = (n,) + (1,) * (len(axis_names) - 1)
+    if int(np.prod(mesh_shape)) != n:
+        raise ValueError(
+            f"mesh_shape {mesh_shape} needs {np.prod(mesh_shape)} devices, "
+            f"have {n}"
+        )
+    dev_array = np.asarray(devices).reshape(mesh_shape)
+    return Mesh(dev_array, tuple(axis_names))
+
+
+def get_zoo_context() -> ZooContext:
+    """Current global context, creating a default one on first use."""
+    global _GLOBAL_CONTEXT
+    if _GLOBAL_CONTEXT is None:
+        _GLOBAL_CONTEXT = init_zoo_context()
+    return _GLOBAL_CONTEXT
+
+
+def set_zoo_context(ctx: ZooContext) -> None:
+    global _GLOBAL_CONTEXT
+    _GLOBAL_CONTEXT = ctx

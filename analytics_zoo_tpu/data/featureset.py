@@ -1,0 +1,135 @@
+"""FeatureSet — host-side dataset abstraction with memory tiers.
+
+Reference capability: ``FeatureSet.rdd(memoryType=...)``
+(feature/FeatureSet.scala:690-722) with cached index-shuffled partitions
+(CachedDistributedFeatureSet:229), disk spilling (DiskFeatureSet:585,
+numSlice DISK_AND_DRAM), and PMEM tiers (feature/pmem/).
+
+TPU-native design: there is no RDD — data lives on the *host* as numpy
+arrays (DRAM) or memory-mapped .npy slices on disk (DISK_AND_DRAM /
+DIRECT), and is fed to the device mesh by the Estimator, which shards each
+batch along the data axis.  PMEM has no TPU-host equivalent; the capacity
+use-case is covered by the mmap tier.  Transform pipelines
+(``Preprocessing`` chains, feature/common/Preprocessing.scala) become
+``.transform(fn)`` stages applied lazily per batch on the host.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import tempfile
+from typing import Any, Callable, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+MemoryType = str  # "DRAM" | "DISK_AND_DRAM" | "DIRECT"
+
+
+class FeatureSet:
+    """A set of aligned arrays (inputs..., label) with lazy transforms.
+
+    ``batches(batch_size)`` yields tuples of numpy arrays; the final
+    element is the label (if present).
+    """
+
+    def __init__(self, arrays: Sequence[np.ndarray],
+                 memory_type: MemoryType = "DRAM",
+                 transforms: Optional[List[Callable]] = None,
+                 seed: int = 0):
+        if not arrays:
+            raise ValueError("FeatureSet needs at least one array")
+        n = len(arrays[0])
+        for a in arrays:
+            if len(a) != n:
+                raise ValueError("arrays must be aligned on dim 0")
+        self.memory_type = memory_type.upper()
+        self.transforms = list(transforms or [])
+        self.seed = seed
+        self._rng = np.random.RandomState(seed)
+        if self.memory_type in ("DISK_AND_DRAM", "DIRECT"):
+            self.arrays = [self._to_mmap(np.asarray(a)) for a in arrays]
+        else:
+            self.arrays = [np.asarray(a) for a in arrays]
+
+    # -- constructors (parity with FeatureSet.rdd / ImageSet / TextSet) ---
+    @staticmethod
+    def from_ndarrays(x, y=None, memory_type: MemoryType = "DRAM",
+                      seed: int = 0) -> "FeatureSet":
+        xs = list(x) if isinstance(x, (list, tuple)) else [x]
+        if y is not None:
+            xs = xs + [y]
+        return FeatureSet(xs, memory_type=memory_type, seed=seed)
+
+    @staticmethod
+    def from_npy_files(paths: Sequence[str],
+                       memory_type: MemoryType = "DISK_AND_DRAM"
+                       ) -> "FeatureSet":
+        mode = "r" if memory_type.upper() != "DRAM" else None
+        arrays = [np.load(p, mmap_mode=mode) for p in paths]
+        fs = FeatureSet.__new__(FeatureSet)
+        fs.memory_type = memory_type.upper()
+        fs.transforms = []
+        fs.seed = 0
+        fs._rng = np.random.RandomState(0)
+        fs.arrays = list(arrays)
+        return fs
+
+    @staticmethod
+    def from_parquet(path: str, feature_cols: Sequence[str], label_col: str,
+                     memory_type: MemoryType = "DRAM") -> "FeatureSet":
+        """Columnar ingestion (replaces the reference's Spark DataFrame
+        path, TextSet.readParquet feature/text/TextSet.scala:372)."""
+        import pandas as pd  # available via baked-in deps
+
+        df = pd.read_parquet(path)
+        arrays = [np.stack(df[c].to_numpy()) for c in feature_cols]
+        arrays.append(df[label_col].to_numpy())
+        return FeatureSet(arrays, memory_type=memory_type)
+
+    # -- transforms -------------------------------------------------------
+    def transform(self, fn: Callable[..., Tuple[np.ndarray, ...]]
+                  ) -> "FeatureSet":
+        """Append a per-batch transform ``fn(*arrays) -> arrays`` (lazy)."""
+        fs = FeatureSet.__new__(FeatureSet)
+        fs.arrays = self.arrays
+        fs.memory_type = self.memory_type
+        fs.transforms = self.transforms + [fn]
+        fs.seed = self.seed
+        fs._rng = self._rng
+        return fs
+
+    # -- iteration --------------------------------------------------------
+    def __len__(self) -> int:
+        return len(self.arrays[0])
+
+    @property
+    def size(self) -> int:
+        return len(self)
+
+    def batches(self, batch_size: int, shuffle: bool = False,
+                drop_remainder: bool = False, pad_to: int = 1
+                ) -> Iterator[Tuple[np.ndarray, ...]]:
+        """Yield batches; ``pad_to`` rounds batch_size up to a multiple
+        (device count) so every batch shards evenly over the mesh."""
+        n = len(self)
+        bs = int(math.ceil(batch_size / pad_to)) * pad_to
+        order = self._rng.permutation(n) if shuffle else np.arange(n)
+        steps = n // bs if drop_remainder else int(math.ceil(n / bs))
+        for s in range(steps):
+            idx = order[s * bs:(s + 1) * bs]
+            batch = tuple(np.asarray(a[idx]) for a in self.arrays)
+            for fn in self.transforms:
+                batch = fn(*batch)
+                if not isinstance(batch, tuple):
+                    batch = (batch,)
+            yield batch
+
+    # -- internals --------------------------------------------------------
+    @staticmethod
+    def _to_mmap(a: np.ndarray) -> np.ndarray:
+        """Spill an array to a disk-backed mmap (DISK_AND_DRAM tier)."""
+        fd, path = tempfile.mkstemp(suffix=".npy", prefix="zoo_featureset_")
+        os.close(fd)
+        np.save(path, a)
+        return np.load(path, mmap_mode="r")

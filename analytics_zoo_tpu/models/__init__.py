@@ -1,0 +1,8 @@
+from analytics_zoo_tpu.models.common import ZooModel, register_model  # noqa: F401
+from analytics_zoo_tpu.models.recommendation import (  # noqa: F401
+    NeuralCF,
+    Recommender,
+    SessionRecommender,
+    WideAndDeep,
+    negative_sample,
+)

@@ -1,0 +1,96 @@
+"""ZooModel base — save/load + predict conveniences for built-in models.
+
+Reference capability: models/common/ZooModel.scala (save/load with a model
+registry; KerasZooModel:183 wraps a KerasNet).  Here a ZooModel owns a
+``KerasNet`` (Sequential/Model) plus its hyper-parameters; persistence is
+the framework checkpoint format + a JSON config so ``ZooModel.load``
+reconstructs the architecture then restores weights.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+_MODEL_REGISTRY: Dict[str, type] = {}
+
+
+def register_model(cls):
+    _MODEL_REGISTRY[cls.__name__] = cls
+    return cls
+
+
+class ZooModel:
+    """Base for built-in zoo models."""
+
+    def __init__(self):
+        self.model = None  # KerasNet, set by subclass build()
+
+    # -- construction -----------------------------------------------------
+    def build(self):
+        raise NotImplementedError
+
+    def config(self) -> Dict[str, Any]:
+        """JSON-serializable constructor kwargs."""
+        raise NotImplementedError
+
+    # -- training facade --------------------------------------------------
+    def compile(self, *a, **kw):
+        self.model.compile(*a, **kw)
+        self._restore_pending_weights()
+        return self
+
+    def fit(self, *a, **kw):
+        return self.model.fit(*a, **kw)
+
+    def evaluate(self, *a, **kw):
+        return self.model.evaluate(*a, **kw)
+
+    def predict(self, *a, **kw):
+        return self.model.predict(*a, **kw)
+
+    @property
+    def estimator(self):
+        return self.model.estimator
+
+    # -- persistence ------------------------------------------------------
+    def save_model(self, path: str) -> None:
+        """Save config + weights (reference ZooModel.saveModel)."""
+        os.makedirs(path, exist_ok=True)
+        with open(os.path.join(path, "config.json"), "w") as f:
+            json.dump({"class": type(self).__name__, "config": self.config()},
+                      f, indent=2)
+        est = self.model._estimator
+        if est is not None and est.params is not None:
+            from analytics_zoo_tpu.train import checkpoint as ckpt
+            ckpt.save_pytree(os.path.join(path, "weights.npz"),
+                             {"params": est.params, "state": est.state})
+
+    @classmethod
+    def load_model(cls, path: str) -> "ZooModel":
+        with open(os.path.join(path, "config.json")) as f:
+            blob = json.load(f)
+        model_cls = _MODEL_REGISTRY.get(blob["class"])
+        if model_cls is None:
+            raise ValueError(f"unknown model class {blob['class']}; "
+                             f"registered: {sorted(_MODEL_REGISTRY)}")
+        inst = model_cls(**blob["config"])
+        wpath = os.path.join(path, "weights.npz")
+        if os.path.exists(wpath):
+            from analytics_zoo_tpu.train import checkpoint as ckpt
+            tree = ckpt.load_pytree(wpath)
+            inst._pending_weights = tree
+        return inst
+
+    def _restore_pending_weights(self):
+        """Hand loaded weights to the estimator (applied at first build,
+        or immediately if already built)."""
+        tree = getattr(self, "_pending_weights", None)
+        if tree is None:
+            return
+        self.model.estimator.set_initial_weights(tree["params"],
+                                                 tree.get("state", {}))
+        self._pending_weights = None

@@ -1,0 +1,11 @@
+from analytics_zoo_tpu.nn.module import Layer, StatelessLayer, reset_name_scope  # noqa: F401
+from analytics_zoo_tpu.nn.topology import KerasNet, Model, Sequential  # noqa: F401
+from analytics_zoo_tpu.nn.autograd import Input, Parameter, Variable  # noqa: F401
+from analytics_zoo_tpu.nn import (  # noqa: F401
+    activations,
+    autograd,
+    initializers,
+    metrics,
+    objectives,
+)
+from analytics_zoo_tpu.nn.layers import *  # noqa: F401,F403

@@ -1,0 +1,56 @@
+"""Activation registry (Keras-name compatible).
+
+Reference capability: api/keras/layers/Activation + the activation strings
+accepted by every layer's ``activation=`` arg.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+ActivationFn = Callable[[jnp.ndarray], jnp.ndarray]
+
+
+def hard_sigmoid(x):
+    """Keras-semantics hard sigmoid: clip(0.2x + 0.5, 0, 1).
+
+    (jax.nn.hard_sigmoid uses slope 1/6 — different function; the Keras
+    variant is required for golden parity with reference RNN gates.)
+    """
+    return jnp.clip(0.2 * x + 0.5, 0.0, 1.0)
+
+
+_REGISTRY = {
+    "relu": jax.nn.relu,
+    "relu6": jax.nn.relu6,
+    "tanh": jnp.tanh,
+    "sigmoid": jax.nn.sigmoid,
+    "hard_sigmoid": hard_sigmoid,
+    "softmax": jax.nn.softmax,
+    "log_softmax": jax.nn.log_softmax,
+    "softplus": jax.nn.softplus,
+    "softsign": jax.nn.soft_sign,
+    "elu": jax.nn.elu,
+    "selu": jax.nn.selu,
+    "gelu": jax.nn.gelu,
+    "swish": jax.nn.silu,
+    "silu": jax.nn.silu,
+    "leaky_relu": jax.nn.leaky_relu,
+    "exp": jnp.exp,
+    "linear": lambda x: x,
+    "identity": lambda x: x,
+}
+
+
+def get(act: Union[str, ActivationFn, None]) -> Optional[ActivationFn]:
+    if act is None:
+        return None
+    if callable(act):
+        return act
+    key = act.lower()
+    if key not in _REGISTRY:
+        raise ValueError(f"unknown activation {act!r}; known: {sorted(_REGISTRY)}")
+    return _REGISTRY[key]

@@ -1,0 +1,253 @@
+"""Symbolic autograd DSL: ``Variable`` graph nodes + free-function math.
+
+Reference capability: api/autograd/math.scala:32-363 (``AutoGrad`` free
+functions), Variable operator overloading (:365-620), CustomLoss, Lambda.
+
+TPU-native design: a ``Variable`` is a node in a lightweight DAG.  Layer
+nodes carry a ``Layer`` (params allocated at ``Model.init``); lambda nodes
+carry a pure jax function.  ``Model`` evaluates the DAG inside ``jit`` —
+the DAG is *built once in Python* and traced once by XLA, so there is zero
+per-step graph overhead.  Gradients come from ``jax.grad`` over the whole
+evaluated program (the reference needed an explicit backward graph).
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+_var_ids = itertools.count()
+
+
+class Variable:
+    """A symbolic tensor in the model DAG."""
+
+    def __init__(self, kind: str, parents: Sequence["Variable"] = (),
+                 layer=None, fn: Optional[Callable] = None,
+                 shape: Optional[Tuple[Optional[int], ...]] = None,
+                 name: Optional[str] = None, dtype=jnp.float32):
+        assert kind in ("input", "layer", "lambda", "param")
+        self.kind = kind
+        self.parents = tuple(parents)
+        self.layer = layer
+        self.fn = fn
+        self.shape = tuple(shape) if shape is not None else None
+        self.dtype = dtype
+        self.id = next(_var_ids)
+        self.name = name or f"var_{self.id}"
+
+    # -- arithmetic (reference Variable.scala:365-620) --------------------
+    def _binop(self, other, fn, name):
+        if isinstance(other, Variable):
+            return Variable("lambda", (self, other), fn=fn, name=name)
+        const = other
+        return Variable("lambda", (self,), fn=lambda a: fn(a, const), name=name)
+
+    def __add__(self, o): return self._binop(o, lambda a, b: a + b, "add")
+    def __radd__(self, o): return self._binop(o, lambda a, b: b + a, "radd")
+    def __sub__(self, o): return self._binop(o, lambda a, b: a - b, "sub")
+    def __rsub__(self, o): return self._binop(o, lambda a, b: b - a, "rsub")
+    def __mul__(self, o): return self._binop(o, lambda a, b: a * b, "mul")
+    def __rmul__(self, o): return self._binop(o, lambda a, b: b * a, "rmul")
+    def __truediv__(self, o): return self._binop(o, lambda a, b: a / b, "div")
+    def __rtruediv__(self, o): return self._binop(o, lambda a, b: b / a, "rdiv")
+    def __pow__(self, o): return self._binop(o, lambda a, b: a ** b, "pow")
+    def __neg__(self): return Variable("lambda", (self,), fn=lambda a: -a, name="neg")
+
+    def __getitem__(self, idx):
+        """Slicing on non-batch dims (reference Variable.slice/indexSelect)."""
+        return Variable("lambda", (self,), fn=lambda a: a[idx], name="slice")
+
+    def slice(self, dim: int, start: int, length: int):
+        return Variable(
+            "lambda", (self,),
+            fn=lambda a: jax.lax.slice_in_dim(a, start, start + length, axis=dim),
+            name="slice")
+
+    def index_select(self, dim: int, index: int):
+        return Variable("lambda", (self,),
+                        fn=lambda a: jnp.take(a, index, axis=dim), name="index_select")
+
+    def squeeze(self, dim: Optional[int] = None):
+        return Variable("lambda", (self,),
+                        fn=lambda a: jnp.squeeze(a, axis=dim), name="squeeze")
+
+    def expand_dims(self, axis: int):
+        return Variable("lambda", (self,),
+                        fn=lambda a: jnp.expand_dims(a, axis), name="expand_dims")
+
+    def reshape(self, *shape):
+        return Variable("lambda", (self,),
+                        fn=lambda a: a.reshape(shape), name="reshape")
+
+    def __repr__(self):
+        return f"Variable({self.name}, kind={self.kind}, shape={self.shape})"
+
+
+def Input(shape: Sequence[int], name: Optional[str] = None,
+          dtype=jnp.float32) -> Variable:
+    """Create an input placeholder; ``shape`` excludes the batch dim
+    (Keras convention, reference api/keras/models/Topology Input)."""
+    return Variable("input", shape=(None,) + tuple(shape), name=name,
+                    dtype=dtype)
+
+
+def apply_layer(layer, args: Sequence[Variable]) -> Variable:
+    return Variable("layer", args, layer=layer, name=layer.name)
+
+
+def Parameter(shape: Sequence[int], init="glorot_uniform",
+              name: Optional[str] = None) -> Variable:
+    """A trainable free tensor (reference api/autograd/KerasParameter.scala).
+
+    Realised as a zero-input layer node whose params are the tensor itself.
+    """
+    from analytics_zoo_tpu.nn import initializers
+    from analytics_zoo_tpu.nn.module import StatelessLayer
+
+    class _Param(StatelessLayer):
+        def __init__(self, shape, init, **kw):
+            super().__init__(**kw)
+            self.shape = tuple(shape)
+            self.initializer = initializers.get(init)
+
+        def build_params(self, rng, *unused):
+            return {"value": self.initializer(rng, self.shape, jnp.float32)}
+
+        def forward(self, params, *unused, training=False, rng=None):
+            return params["value"]
+
+    layer = _Param(shape, init, name=name)
+    return Variable("param", (), layer=layer, name=layer.name)
+
+
+# ----------------------------------------------------------------------
+# Free functions (reference AutoGrad object, api/autograd/math.scala:32-363)
+# ----------------------------------------------------------------------
+
+def _unary(v: Variable, fn, name) -> Variable:
+    return Variable("lambda", (v,), fn=fn, name=name)
+
+
+def abs(v): return _unary(v, jnp.abs, "abs")                 # noqa: A001
+def square(v): return _unary(v, jnp.square, "square")
+def sqrt(v): return _unary(v, jnp.sqrt, "sqrt")
+def log(v): return _unary(v, jnp.log, "log")                 # noqa: A001
+def exp(v): return _unary(v, jnp.exp, "exp")
+def erf(v): return _unary(v, jax.scipy.special.erf, "erf")
+def softsign(v): return _unary(v, jax.nn.soft_sign, "softsign")
+def softplus(v): return _unary(v, jax.nn.softplus, "softplus")
+
+
+def pow(v, a):                                               # noqa: A001
+    return _unary(v, lambda x: x ** a, "pow")
+
+
+def clip(v, min, max):                                       # noqa: A001
+    return _unary(v, lambda x: jnp.clip(x, min, max), "clip")
+
+
+def sum(v, axis: int = 0, keepdims: bool = False):           # noqa: A001
+    return _unary(v, lambda x: jnp.sum(x, axis=axis, keepdims=keepdims), "sum")
+
+
+def mean(v, axis: int = 0, keepdims: bool = False):
+    return _unary(v, lambda x: jnp.mean(x, axis=axis, keepdims=keepdims), "mean")
+
+
+def maximum(a, b):
+    if isinstance(a, Variable) and isinstance(b, Variable):
+        return Variable("lambda", (a, b), fn=jnp.maximum, name="maximum")
+    if isinstance(a, Variable):
+        return _unary(a, lambda x: jnp.maximum(x, b), "maximum")
+    return _unary(b, lambda x: jnp.maximum(a, x), "maximum")
+
+
+def stack(vars: Sequence[Variable], axis: int = 1) -> Variable:  # noqa: A002
+    return Variable("lambda", tuple(vars),
+                    fn=lambda *xs: jnp.stack(xs, axis=axis), name="stack")
+
+
+def expand_dims(v, axis: int):
+    return v.expand_dims(axis)
+
+
+def contiguous(v):
+    return v  # jax arrays are always "contiguous" values
+
+
+def mm(a: Variable, b: Variable, axes: Optional[Tuple[int, int]] = None):
+    """Batched matmul (reference AutoGrad.mm)."""
+    if axes is None:
+        return Variable("lambda", (a, b), fn=jnp.matmul, name="mm")
+
+    def fn(x, y):
+        return jax.lax.dot_general(
+            x, y, dimension_numbers=(((axes[0],), (axes[1],)), ((0,), (0,))))
+    return Variable("lambda", (a, b), fn=fn, name="mm")
+
+
+def batch_dot(a: Variable, b: Variable, axes: Tuple[int, int] = (1, 1)):
+    return mm(a, b, axes=axes)
+
+
+def l2_normalize(v, axis: int = -1):
+    return _unary(
+        v, lambda x: x / (jnp.linalg.norm(x, axis=axis, keepdims=True) + 1e-12),
+        "l2_normalize")
+
+
+# ----------------------------------------------------------------------
+# DAG evaluation (used by Model)
+# ----------------------------------------------------------------------
+
+def topo_sort(outputs: Sequence[Variable]) -> List[Variable]:
+    seen: Dict[int, Variable] = {}
+    order: List[Variable] = []
+
+    def visit(v: Variable):
+        if v.id in seen:
+            return
+        seen[v.id] = v
+        for p in v.parents:
+            visit(p)
+        order.append(v)
+
+    for out in outputs:
+        visit(out)
+    return order
+
+
+def evaluate(order: List[Variable], env: Dict[int, Any], params, state,
+             training: bool = False, rng=None) -> Tuple[Dict[int, Any], Dict]:
+    """Evaluate a topo-sorted DAG. ``env`` seeds input nodes (by var id).
+
+    Returns (full env, new_state).  ``params``/``state`` are dicts keyed by
+    layer name.
+    """
+    new_state = dict(state)
+    layer_nodes = [v for v in order if v.kind in ("layer", "param")]
+    rngs = {}
+    if rng is not None and layer_nodes:
+        keys = jax.random.split(rng, len(layer_nodes))
+        rngs = {v.id: k for v, k in zip(layer_nodes, keys)}
+
+    for v in order:
+        if v.id in env:
+            continue
+        if v.kind == "input":
+            raise ValueError(f"missing value for input {v.name}")
+        parent_vals = [env[p.id] for p in v.parents]
+        if v.kind in ("layer", "param"):
+            lp = params.get(v.layer.name, {})
+            ls = state.get(v.layer.name, {})
+            out, ns = v.layer.call(lp, ls, *parent_vals,
+                                   training=training, rng=rngs.get(v.id))
+            env[v.id] = out
+            new_state[v.layer.name] = ns
+        else:  # lambda
+            env[v.id] = v.fn(*parent_vals)
+    return env, new_state

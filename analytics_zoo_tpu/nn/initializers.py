@@ -1,0 +1,42 @@
+"""Weight initializers (Keras-name compatible).
+
+Reference capability: BigDL init methods exposed through the Keras layers'
+``init=`` string args (e.g. api/keras/layers/Dense — "glorot_uniform").
+Implemented directly over ``jax.nn.initializers``.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Union
+
+import jax
+import jax.numpy as jnp
+
+Initializer = Callable[..., jnp.ndarray]
+
+_REGISTRY = {
+    "glorot_uniform": jax.nn.initializers.glorot_uniform(),
+    "glorot_normal": jax.nn.initializers.glorot_normal(),
+    "xavier": jax.nn.initializers.glorot_uniform(),
+    "he_uniform": jax.nn.initializers.he_uniform(),
+    "he_normal": jax.nn.initializers.he_normal(),
+    "lecun_uniform": jax.nn.initializers.lecun_uniform(),
+    "lecun_normal": jax.nn.initializers.lecun_normal(),
+    "zero": jax.nn.initializers.zeros,
+    "zeros": jax.nn.initializers.zeros,
+    "one": jax.nn.initializers.ones,
+    "ones": jax.nn.initializers.ones,
+    "normal": jax.nn.initializers.normal(stddev=0.05),
+    "uniform": jax.nn.initializers.uniform(scale=0.05),
+    "orthogonal": jax.nn.initializers.orthogonal(),
+}
+
+
+def get(init: Union[str, Initializer]) -> Initializer:
+    if callable(init):
+        return init
+    key = init.lower()
+    if key not in _REGISTRY:
+        raise ValueError(f"unknown initializer {init!r}; "
+                         f"known: {sorted(_REGISTRY)}")
+    return _REGISTRY[key]

@@ -1,0 +1,31 @@
+from analytics_zoo_tpu.nn.layers.core import (  # noqa: F401
+    Activation,
+    Dense,
+    Dropout,
+    Flatten,
+    InputLayer,
+    Lambda,
+    Masking,
+    Permute,
+    RepeatVector,
+    Reshape,
+)
+from analytics_zoo_tpu.nn.layers.embedding import Embedding, WordEmbedding  # noqa: F401
+from analytics_zoo_tpu.nn.layers.recurrent import (  # noqa: F401
+    GRU,
+    LSTM,
+    Bidirectional,
+    Highway,
+    SimpleRNN,
+    TimeDistributed,
+)
+from analytics_zoo_tpu.nn.layers.merge import (  # noqa: F401
+    Add,
+    Average,
+    Concatenate,
+    Maximum,
+    Merge,
+    Minimum,
+    Multiply,
+    merge,
+)

@@ -1,0 +1,167 @@
+"""Core layers: Dense, Dropout, Flatten, Activation, Reshape, Permute, ...
+
+Reference capability: api/keras/layers/{Dense,Dropout,Flatten,Activation,
+Reshape,Permute,RepeatVector,Masking}.scala.  Design is TPU-first: Dense is
+a single ``jnp.dot`` (lowers to MXU), dropout uses threaded PRNG keys, and
+everything is shape-polymorphic over leading dims so the same layer works
+for 2D and sequence inputs (matching Keras semantics of operating on the
+last axis).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.nn import activations, initializers
+from analytics_zoo_tpu.nn.module import Layer, StatelessLayer
+
+
+class Dense(StatelessLayer):
+    """Fully connected layer: ``y = act(x @ W + b)``.
+
+    Operates on the last axis (Keras semantics — a 3D input is treated as a
+    batch of sequences and hits the MXU as one batched matmul).
+    Reference: api/keras/layers/Dense (via KerasUtils string lowering).
+    """
+
+    def __init__(self, output_dim: int, activation=None, use_bias: bool = True,
+                 init="glorot_uniform", w_regularizer=None, b_regularizer=None,
+                 dtype=jnp.float32, **kw):
+        super().__init__(**kw)
+        self.output_dim = output_dim
+        self.activation = activations.get(activation)
+        self.use_bias = use_bias
+        self.initializer = initializers.get(init)
+        self.dtype = dtype
+        self.w_regularizer = w_regularizer
+        self.b_regularizer = b_regularizer
+
+    def build_params(self, rng, input_shape):
+        in_dim = input_shape[-1]
+        k_w, _ = jax.random.split(rng)
+        params = {"kernel": self.initializer(k_w, (in_dim, self.output_dim), self.dtype)}
+        if self.use_bias:
+            params["bias"] = jnp.zeros((self.output_dim,), self.dtype)
+        return params
+
+    def forward(self, params, x, training=False, rng=None):
+        y = jnp.dot(x, params["kernel"])
+        if self.use_bias:
+            y = y + params["bias"]
+        if self.activation is not None:
+            y = self.activation(y)
+        return y
+
+    def regularization_loss(self, params):
+        loss = 0.0
+        if self.w_regularizer is not None:
+            loss = loss + self.w_regularizer(params["kernel"])
+        if self.b_regularizer is not None and self.use_bias:
+            loss = loss + self.b_regularizer(params["bias"])
+        return loss
+
+
+class Activation(StatelessLayer):
+    def __init__(self, activation, **kw):
+        super().__init__(**kw)
+        self.activation = activations.get(activation)
+
+    def forward(self, params, x, training=False, rng=None):
+        return self.activation(x)
+
+
+class Dropout(StatelessLayer):
+    """Inverted dropout; identity at inference.
+
+    Reference: api/keras/layers/Dropout.  Uses an explicit PRNG key threaded
+    by the container — no global RNG state (XLA-friendly determinism).
+    """
+
+    def __init__(self, p: float, **kw):
+        super().__init__(**kw)
+        self.rate = float(p)
+
+    def forward(self, params, x, training=False, rng=None):
+        if not training or self.rate <= 0.0:
+            return x
+        if rng is None:
+            raise ValueError(f"Dropout {self.name} needs an rng when training")
+        keep = 1.0 - self.rate
+        mask = jax.random.bernoulli(rng, keep, x.shape)
+        return jnp.where(mask, x / keep, 0.0)
+
+
+class Flatten(StatelessLayer):
+    """Flatten all dims after the batch dim."""
+
+    def forward(self, params, x, training=False, rng=None):
+        return x.reshape(x.shape[0], -1)
+
+
+class Reshape(StatelessLayer):
+    """Reshape non-batch dims to ``target_shape`` (one dim may be -1)."""
+
+    def __init__(self, target_shape: Sequence[int], **kw):
+        super().__init__(**kw)
+        self.target_shape = tuple(target_shape)
+
+    def forward(self, params, x, training=False, rng=None):
+        return x.reshape((x.shape[0],) + self.target_shape)
+
+
+class Permute(StatelessLayer):
+    """Permute non-batch dims; ``dims`` is 1-indexed like Keras."""
+
+    def __init__(self, dims: Sequence[int], **kw):
+        super().__init__(**kw)
+        self.dims = tuple(dims)
+
+    def forward(self, params, x, training=False, rng=None):
+        return jnp.transpose(x, (0,) + self.dims)
+
+
+class RepeatVector(StatelessLayer):
+    """(B, F) -> (B, n, F)."""
+
+    def __init__(self, n: int, **kw):
+        super().__init__(**kw)
+        self.n = n
+
+    def forward(self, params, x, training=False, rng=None):
+        return jnp.repeat(x[:, None, :], self.n, axis=1)
+
+
+class Masking(StatelessLayer):
+    """Zero out timesteps equal to ``mask_value`` (soft masking)."""
+
+    def __init__(self, mask_value: float = 0.0, **kw):
+        super().__init__(**kw)
+        self.mask_value = mask_value
+
+    def forward(self, params, x, training=False, rng=None):
+        mask = jnp.any(x != self.mask_value, axis=-1, keepdims=True)
+        return jnp.where(mask, x, 0.0)
+
+
+class Lambda(StatelessLayer):
+    """Wrap an arbitrary jax function as a layer.
+
+    Reference: api/autograd/Lambda.scala.  The function must be traceable.
+    """
+
+    def __init__(self, fn, **kw):
+        super().__init__(**kw)
+        self.fn = fn
+
+    def forward(self, params, *inputs, training=False, rng=None):
+        return self.fn(*inputs)
+
+
+class InputLayer(StatelessLayer):
+    """Identity marker layer (Keras InputLayer parity)."""
+
+    def forward(self, params, x, training=False, rng=None):
+        return x

@@ -1,0 +1,95 @@
+"""Embedding layers.
+
+Reference capability: api/keras/layers/{Embedding,SparseEmbedding},
+WordEmbedding.scala (pretrained GloVe tables).  TPU-first design decision
+(SURVEY.md §7 "hard parts"): recsys/NLP embeddings are **dense gather
+tables** — ``table[ids]`` lowers to an XLA gather that is fast on TPU and
+shardable over the model axis for very large vocabularies; there is no
+sparse-tensor path (BigDL's SparseEmbedding exists to save CPU memory
+traffic, which the gather already avoids on TPU).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from analytics_zoo_tpu.nn import initializers
+from analytics_zoo_tpu.nn.module import StatelessLayer
+
+
+class Embedding(StatelessLayer):
+    """Integer ids -> dense vectors. Input (B, ...) int -> (B, ..., dim)."""
+
+    def __init__(self, input_dim: int, output_dim: int, init="uniform",
+                 trainable: bool = True, weights: Optional[np.ndarray] = None,
+                 zero_based: bool = True, dtype=jnp.float32, **kw):
+        super().__init__(**kw)
+        self.input_dim = input_dim
+        self.output_dim = output_dim
+        self.initializer = initializers.get(init)
+        self.trainable = trainable
+        self.pretrained = weights
+        # The reference's Embedding is 1-based (Lua heritage); default here
+        # is 0-based, with an opt-in shift for API parity.
+        self.zero_based = zero_based
+        self.dtype = dtype
+
+    def build_params(self, rng, input_shape):
+        if self.pretrained is not None:
+            table = jnp.asarray(self.pretrained, self.dtype)
+            if table.shape != (self.input_dim, self.output_dim):
+                raise ValueError(
+                    f"pretrained weights {table.shape} != "
+                    f"({self.input_dim}, {self.output_dim})")
+        else:
+            table = self.initializer(rng, (self.input_dim, self.output_dim), self.dtype)
+        return {"table": table}
+
+    def forward(self, params, ids, training=False, rng=None):
+        table = params["table"]
+        if not self.trainable:
+            table = jax.lax.stop_gradient(table)
+        ids = ids.astype(jnp.int32)
+        if not self.zero_based:
+            ids = ids - 1
+        return jnp.take(table, ids, axis=0)
+
+
+class WordEmbedding(Embedding):
+    """Pretrained, frozen word embeddings (reference WordEmbedding.scala).
+
+    Use ``WordEmbedding.from_glove(path, word_index)`` to load a GloVe text
+    file filtered to a vocabulary.
+    """
+
+    def __init__(self, input_dim: int, output_dim: int,
+                 weights: Optional[np.ndarray] = None, trainable: bool = False,
+                 **kw):
+        super().__init__(input_dim, output_dim, weights=weights,
+                         trainable=trainable, **kw)
+
+    @staticmethod
+    def from_glove(path: str, word_index: dict, trainable: bool = False,
+                   **kw) -> "WordEmbedding":
+        dim = None
+        vectors = {}
+        with open(path, encoding="utf-8") as f:
+            for line in f:
+                parts = line.rstrip().split(" ")
+                word = parts[0]
+                if word in word_index:
+                    vec = np.asarray(parts[1:], dtype=np.float32)
+                    dim = len(vec)
+                    vectors[word] = vec
+        if dim is None:
+            raise ValueError(f"no vocabulary words found in {path}")
+        n = max(word_index.values()) + 1
+        table = np.zeros((n, dim), dtype=np.float32)
+        for word, idx in word_index.items():
+            if word in vectors:
+                table[idx] = vectors[word]
+        return WordEmbedding(n, dim, weights=table, trainable=trainable, **kw)

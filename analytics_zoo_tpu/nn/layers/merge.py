@@ -1,0 +1,90 @@
+"""Merge layers: concat/sum/mul/ave/max/min/dot over multiple inputs.
+
+Reference capability: api/keras/layers/Merge.scala and keras2's
+Maximum/Minimum/Average/... layers.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.nn.module import StatelessLayer
+
+
+class Merge(StatelessLayer):
+    """Merge a list of inputs. ``mode``: concat|sum|mul|ave|max|min|dot|cos."""
+
+    def __init__(self, mode: str = "sum", concat_axis: int = -1, **kw):
+        super().__init__(**kw)
+        self.mode = mode.lower()
+        self.concat_axis = concat_axis
+
+    def forward(self, params, *inputs, training=False, rng=None):
+        m = self.mode
+        if m == "concat":
+            return jnp.concatenate(inputs, axis=self.concat_axis)
+        if m == "sum":
+            return sum(inputs[1:], inputs[0])
+        if m == "mul":
+            out = inputs[0]
+            for x in inputs[1:]:
+                out = out * x
+            return out
+        if m in ("ave", "average"):
+            return sum(inputs[1:], inputs[0]) / len(inputs)
+        if m == "max":
+            out = inputs[0]
+            for x in inputs[1:]:
+                out = jnp.maximum(out, x)
+            return out
+        if m == "min":
+            out = inputs[0]
+            for x in inputs[1:]:
+                out = jnp.minimum(out, x)
+            return out
+        if m == "dot":
+            a, b = inputs
+            return jnp.sum(a * b, axis=-1, keepdims=True)
+        if m == "cos":
+            a, b = inputs
+            na = a / (jnp.linalg.norm(a, axis=-1, keepdims=True) + 1e-12)
+            nb = b / (jnp.linalg.norm(b, axis=-1, keepdims=True) + 1e-12)
+            return jnp.sum(na * nb, axis=-1, keepdims=True)
+        raise ValueError(f"unknown merge mode {self.mode!r}")
+
+
+def merge(inputs, mode="sum", concat_axis=-1, name=None):
+    """Functional merge over autograd Variables (reference api parity)."""
+    return Merge(mode=mode, concat_axis=concat_axis, name=name)(*inputs)
+
+
+class Concatenate(Merge):
+    def __init__(self, axis: int = -1, **kw):
+        super().__init__(mode="concat", concat_axis=axis, **kw)
+
+
+class Add(Merge):
+    def __init__(self, **kw):
+        super().__init__(mode="sum", **kw)
+
+
+class Multiply(Merge):
+    def __init__(self, **kw):
+        super().__init__(mode="mul", **kw)
+
+
+class Average(Merge):
+    def __init__(self, **kw):
+        super().__init__(mode="ave", **kw)
+
+
+class Maximum(Merge):
+    def __init__(self, **kw):
+        super().__init__(mode="max", **kw)
+
+
+class Minimum(Merge):
+    def __init__(self, **kw):
+        super().__init__(mode="min", **kw)

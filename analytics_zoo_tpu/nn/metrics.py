@@ -1,0 +1,179 @@
+"""Evaluation metrics.
+
+Reference capability: api/keras/metrics/ — top-1/top-5/sparse/binary/
+categorical accuracy, AUC (AUC.scala, 211 LoC), MAE.
+
+Design: a metric is a pair of pure functions so it can run *inside* the
+jitted eval step and aggregate across devices with a ``psum``-style sum:
+
+    update(y_true, y_pred) -> stats pytree   (summable across batches/devices)
+    finalize(stats)        -> scalar
+
+Accuracy carries (correct, total); AUC carries a fixed-resolution
+TP/FP histogram over thresholds (jit-friendly, no sorting of the full
+score list on host).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Union
+
+import jax
+import jax.numpy as jnp
+
+
+class Metric:
+    name = "metric"
+
+    def update(self, y_true, y_pred, mask=None) -> Any:
+        """``mask`` (B,) float 0/1 excludes padded rows (SPMD padding)."""
+        raise NotImplementedError
+
+    def finalize(self, stats) -> jnp.ndarray:
+        raise NotImplementedError
+
+
+class Accuracy(Metric):
+    """Top-1 accuracy with auto input handling (reference Accuracy +
+    SparseCategoricalAccuracy): integer labels vs class scores, or binary
+    labels vs single probability."""
+
+    name = "accuracy"
+
+    def __init__(self, zero_based_label: bool = True):
+        self.zero_based = zero_based_label
+
+    def update(self, y_true, y_pred, mask=None):
+        if y_pred.ndim >= 2 and y_pred.shape[-1] > 1:
+            pred = jnp.argmax(y_pred, axis=-1)
+            labels = y_true.astype(jnp.int32).reshape(y_true.shape[0], -1)[:, 0]
+            if not self.zero_based:
+                labels = labels - 1
+            if y_true.ndim >= 2 and y_true.shape[-1] == y_pred.shape[-1]:
+                labels = jnp.argmax(y_true, axis=-1)  # one-hot targets
+        else:
+            pred = (y_pred.reshape(y_pred.shape[0], -1)[:, 0] > 0.5).astype(jnp.int32)
+            labels = y_true.reshape(y_true.shape[0], -1)[:, 0].astype(jnp.int32)
+        if mask is None:
+            mask = jnp.ones((pred.shape[0],), jnp.float32)
+        correct = jnp.sum((pred == labels).astype(jnp.float32) * mask)
+        return {"correct": correct, "total": jnp.sum(mask)}
+
+    def finalize(self, stats):
+        return stats["correct"] / jnp.maximum(stats["total"], 1.0)
+
+
+class BinaryAccuracy(Accuracy):
+    name = "binary_accuracy"
+
+
+class CategoricalAccuracy(Accuracy):
+    name = "categorical_accuracy"
+
+
+class SparseCategoricalAccuracy(Accuracy):
+    name = "sparse_categorical_accuracy"
+
+
+class Top5Accuracy(Metric):
+    name = "top5_accuracy"
+
+    def __init__(self, zero_based_label: bool = True):
+        self.zero_based = zero_based_label
+
+    def update(self, y_true, y_pred, mask=None):
+        labels = y_true.astype(jnp.int32).reshape(y_true.shape[0], -1)[:, 0]
+        if not self.zero_based:
+            labels = labels - 1
+        _, top5 = jax.lax.top_k(y_pred, 5)
+        hit = jnp.any(top5 == labels[:, None], axis=-1).astype(jnp.float32)
+        if mask is None:
+            mask = jnp.ones((labels.shape[0],), jnp.float32)
+        return {"correct": jnp.sum(hit * mask), "total": jnp.sum(mask)}
+
+    def finalize(self, stats):
+        return stats["correct"] / jnp.maximum(stats["total"], 1.0)
+
+
+class MAE(Metric):
+    name = "mae"
+
+    def update(self, y_true, y_pred, mask=None):
+        err = jnp.abs(y_pred - y_true).reshape(y_true.shape[0], -1)
+        if mask is None:
+            mask = jnp.ones((y_true.shape[0],), jnp.float32)
+        per_row = err.shape[1]
+        return {"abs_sum": jnp.sum(err * mask[:, None]),
+                "total": jnp.sum(mask) * per_row}
+
+    def finalize(self, stats):
+        return stats["abs_sum"] / jnp.maximum(stats["total"], 1.0)
+
+
+class Loss(Metric):
+    """Wraps the model loss as a metric for eval reporting."""
+
+    name = "loss"
+
+    def __init__(self, loss_fn):
+        from analytics_zoo_tpu.nn import objectives
+        self.loss_fn = objectives.get(loss_fn)
+
+    def update(self, y_true, y_pred, mask=None):
+        n = jnp.asarray(y_true.shape[0], jnp.float32)
+        return {"loss_sum": self.loss_fn(y_true, y_pred) * n, "total": n}
+
+    def finalize(self, stats):
+        return stats["loss_sum"] / jnp.maximum(stats["total"], 1.0)
+
+
+class AUC(Metric):
+    """Area under the ROC curve via a threshold histogram
+    (reference api/keras/metrics/AUC.scala — same bucketed design, which is
+    the jit/SPMD-friendly formulation: stats are summable across devices)."""
+
+    name = "auc"
+
+    def __init__(self, num_thresholds: int = 200):
+        self.num_thresholds = num_thresholds
+
+    def update(self, y_true, y_pred, mask=None):
+        scores = y_pred.reshape(y_pred.shape[0], -1)[:, 0]
+        labels = y_true.reshape(y_true.shape[0], -1)[:, 0].astype(jnp.float32)
+        if mask is None:
+            mask = jnp.ones((labels.shape[0],), jnp.float32)
+        thresholds = jnp.linspace(0.0, 1.0, self.num_thresholds)
+        pred_pos = (scores[None, :] >= thresholds[:, None]) * mask[None, :]
+        tp = jnp.sum(pred_pos * labels[None, :], axis=1)
+        fp = jnp.sum(pred_pos * (1.0 - labels)[None, :], axis=1)
+        pos = jnp.sum(labels * mask)
+        neg = jnp.sum(mask) - pos
+        return {"tp": tp, "fp": fp, "pos": pos, "neg": neg}
+
+    def finalize(self, stats):
+        tpr = stats["tp"] / jnp.maximum(stats["pos"], 1.0)
+        fpr = stats["fp"] / jnp.maximum(stats["neg"], 1.0)
+        # thresholds ascend → fpr/tpr descend; integrate with trapezoids.
+        return jnp.sum((fpr[:-1] - fpr[1:]) * (tpr[:-1] + tpr[1:]) / 2.0)
+
+
+_REGISTRY: Dict[str, Callable[[], Metric]] = {
+    "accuracy": Accuracy,
+    "acc": Accuracy,
+    "binary_accuracy": BinaryAccuracy,
+    "categorical_accuracy": CategoricalAccuracy,
+    "sparse_categorical_accuracy": SparseCategoricalAccuracy,
+    "top5accuracy": Top5Accuracy,
+    "top5_accuracy": Top5Accuracy,
+    "mae": MAE,
+    "auc": AUC,
+}
+
+
+def get(metric: Union[str, Metric]) -> Metric:
+    if isinstance(metric, Metric):
+        return metric
+    key = metric.lower()
+    if key not in _REGISTRY:
+        raise ValueError(f"unknown metric {metric!r}; known: {sorted(_REGISTRY)}")
+    return _REGISTRY[key]()

@@ -1,0 +1,131 @@
+"""Layer protocol — the base of the Keras-style API.
+
+Reference capability: BigDL ``KerasLayer`` / ``AbstractModule`` with
+``forward``/``backward`` (SURVEY.md L3).  TPU-native design: a layer is a
+pair of *pure functions*
+
+    build(rng, *input_shapes)                      -> (params, state)
+    call(params, state, *inputs, training, rng)    -> (output, new_state)
+
+``params`` are differentiated; ``state`` carries non-differentiated buffers
+(BatchNorm moving stats).  Backward passes come from ``jax.grad`` — there is
+no hand-written backward anywhere.  Layers compose via containers
+(``Sequential``/``Model``) or symbolically via the autograd ``Variable`` DSL.
+
+Shapes: layer ``build`` receives *full* shapes including the batch dim.
+User-facing ``input_shape=`` kwargs follow Keras convention (no batch dim).
+"""
+
+from __future__ import annotations
+
+import collections
+import functools
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Params = Any   # pytree
+State = Any    # pytree
+
+_NAME_COUNTERS: Dict[str, int] = collections.defaultdict(int)
+
+
+def _auto_name(cls_name: str) -> str:
+    _NAME_COUNTERS[cls_name] += 1
+    return f"{cls_name.lower()}_{_NAME_COUNTERS[cls_name]}"
+
+
+def reset_name_scope() -> None:
+    """Reset auto-naming counters (test isolation)."""
+    _NAME_COUNTERS.clear()
+
+
+class Layer:
+    """Base class for all layers and containers."""
+
+    def __init__(self, name: Optional[str] = None,
+                 input_shape: Optional[Sequence[int]] = None):
+        self.name = name or _auto_name(type(self).__name__)
+        # Keras-style input_shape excludes the batch dim.
+        self.input_shape = tuple(input_shape) if input_shape is not None else None
+        self.built_shapes: Optional[Tuple[Tuple[int, ...], ...]] = None
+
+    # -- to be implemented by subclasses ---------------------------------
+    def build(self, rng, *input_shapes) -> Tuple[Params, State]:
+        """Allocate parameters/state for the given full input shapes."""
+        return {}, {}
+
+    def call(self, params: Params, state: State, *inputs,
+             training: bool = False, rng=None) -> Tuple[Any, State]:
+        raise NotImplementedError
+
+    # -- generic machinery ------------------------------------------------
+    def output_shape(self, params: Params, state: State,
+                     *input_shapes, training: bool = False):
+        """Infer the output shape abstractly (no FLOPs) via eval_shape."""
+        args = [jax.ShapeDtypeStruct(s, jnp.float32) for s in input_shapes]
+        rng = jax.ShapeDtypeStruct((2,), jnp.uint32)
+
+        def fn(params, state, rng, *xs):
+            out, _ = self.call(params, state, *xs, training=training, rng=rng)
+            return out
+
+        out = jax.eval_shape(fn, params, state, rng, *args)
+        return out.shape
+
+    def init(self, rng, *input_shapes) -> Tuple[Params, State]:
+        """User-facing build; records shapes for summary printing."""
+        self.built_shapes = tuple(tuple(s) for s in input_shapes)
+        return self.build(rng, *input_shapes)
+
+    def param_count(self, params: Params) -> int:
+        return sum(int(x.size) for x in jax.tree_util.tree_leaves(params))
+
+    # -- symbolic application (autograd DSL) ------------------------------
+    def __call__(self, *args):
+        """Apply to ``Variable``s → new ``Variable`` (graph building)."""
+        from analytics_zoo_tpu.nn.autograd import Variable, apply_layer
+
+        if args and all(isinstance(a, Variable) for a in args):
+            return apply_layer(self, args)
+        raise TypeError(
+            f"{type(self).__name__} called with {[type(a) for a in args]}; "
+            "layers are applied to autograd Variables (use Model DSL) or via "
+            "explicit .call(params, state, x)."
+        )
+
+    def __repr__(self):
+        return f"{type(self).__name__}(name={self.name!r})"
+
+
+class StatelessLayer(Layer):
+    """Convenience base for the ~90% of layers with no mutable state.
+
+    Subclasses implement ``build_params(rng, *shapes) -> params`` and
+    ``forward(params, *inputs, training, rng) -> out``.
+    """
+
+    def build_params(self, rng, *input_shapes) -> Params:
+        return {}
+
+    def forward(self, params, *inputs, training: bool = False, rng=None):
+        raise NotImplementedError
+
+    def build(self, rng, *input_shapes):
+        return self.build_params(rng, *input_shapes), {}
+
+    def call(self, params, state, *inputs, training: bool = False, rng=None):
+        return self.forward(params, *inputs, training=training, rng=rng), state
+
+
+def split_rng(rng, n: int):
+    """Split an optional rng into n optional rngs."""
+    if rng is None:
+        return [None] * n
+    return list(jax.random.split(rng, n))
+
+
+def full_shape(input_shape: Sequence[int], batch: int = 1) -> Tuple[int, ...]:
+    """Prepend a batch dim to a Keras-style (batch-less) shape."""
+    return (batch,) + tuple(input_shape)

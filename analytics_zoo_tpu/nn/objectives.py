@@ -1,0 +1,155 @@
+"""Loss functions (Keras-named), pure jax.
+
+Reference capability: api/keras/objectives/ — 15 Keras-named losses
+(BinaryCrossEntropy, CategoricalCrossEntropy, SparseCategoricalCrossEntropy,
+MeanSquaredError, ..., RankHinge) and ClassNLLCriterion.  All are pure
+``fn(y_true, y_pred) -> scalar`` reduced by mean over the batch; every one
+is trivially fusable by XLA into the backward pass.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Union
+
+import jax
+import jax.numpy as jnp
+
+LossFn = Callable[[jnp.ndarray, jnp.ndarray], jnp.ndarray]
+
+_EPS = 1e-7
+
+
+def mean_squared_error(y_true, y_pred):
+    return jnp.mean(jnp.square(y_pred - y_true))
+
+
+def mean_absolute_error(y_true, y_pred):
+    return jnp.mean(jnp.abs(y_pred - y_true))
+
+
+def mean_absolute_percentage_error(y_true, y_pred):
+    diff = jnp.abs((y_true - y_pred) / jnp.clip(jnp.abs(y_true), _EPS, None))
+    return 100.0 * jnp.mean(diff)
+
+
+def mean_squared_logarithmic_error(y_true, y_pred):
+    a = jnp.log(jnp.clip(y_pred, _EPS, None) + 1.0)
+    b = jnp.log(jnp.clip(y_true, _EPS, None) + 1.0)
+    return jnp.mean(jnp.square(a - b))
+
+
+def binary_crossentropy(y_true, y_pred):
+    """y_pred are probabilities in (0, 1) (post-sigmoid), Keras semantics."""
+    p = jnp.clip(y_pred, _EPS, 1.0 - _EPS)
+    return -jnp.mean(y_true * jnp.log(p) + (1.0 - y_true) * jnp.log1p(-p))
+
+
+def binary_crossentropy_with_logits(y_true, logits):
+    """Numerically stable BCE on logits (preferred on TPU)."""
+    return jnp.mean(
+        jnp.maximum(logits, 0) - logits * y_true + jnp.log1p(jnp.exp(-jnp.abs(logits))))
+
+
+def categorical_crossentropy(y_true, y_pred):
+    """One-hot targets vs probability outputs."""
+    p = jnp.clip(y_pred, _EPS, 1.0)
+    return -jnp.mean(jnp.sum(y_true * jnp.log(p), axis=-1))
+
+
+def sparse_categorical_crossentropy(y_true, y_pred, zero_based_label=True):
+    """Integer targets vs probability outputs
+    (reference SparseCategoricalCrossEntropy, 0/1-based switch)."""
+    labels = y_true.astype(jnp.int32).reshape(y_true.shape[0], -1)[:, 0]
+    if not zero_based_label:
+        labels = labels - 1
+    p = jnp.clip(y_pred, _EPS, 1.0)
+    ll = jnp.take_along_axis(jnp.log(p), labels[:, None], axis=-1)
+    return -jnp.mean(ll)
+
+
+def sparse_categorical_crossentropy_with_logits(y_true, logits):
+    """Integer targets vs raw logits (fused log-softmax; stable + fast)."""
+    labels = y_true.astype(jnp.int32).reshape(y_true.shape[0], -1)[:, 0]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, labels[:, None], axis=-1)
+    return -jnp.mean(ll)
+
+
+def class_nll(y_true, log_probs):
+    """NLL on log-probabilities (reference ClassNLLCriterion, 197 LoC)."""
+    labels = y_true.astype(jnp.int32).reshape(y_true.shape[0], -1)[:, 0]
+    ll = jnp.take_along_axis(log_probs, labels[:, None], axis=-1)
+    return -jnp.mean(ll)
+
+
+def kullback_leibler_divergence(y_true, y_pred):
+    yt = jnp.clip(y_true, _EPS, 1.0)
+    yp = jnp.clip(y_pred, _EPS, 1.0)
+    return jnp.mean(jnp.sum(yt * jnp.log(yt / yp), axis=-1))
+
+
+def poisson(y_true, y_pred):
+    return jnp.mean(y_pred - y_true * jnp.log(y_pred + _EPS))
+
+
+def cosine_proximity(y_true, y_pred):
+    yt = y_true / (jnp.linalg.norm(y_true, axis=-1, keepdims=True) + _EPS)
+    yp = y_pred / (jnp.linalg.norm(y_pred, axis=-1, keepdims=True) + _EPS)
+    return -jnp.mean(jnp.sum(yt * yp, axis=-1))
+
+
+def hinge(y_true, y_pred):
+    return jnp.mean(jnp.maximum(1.0 - y_true * y_pred, 0.0))
+
+
+def squared_hinge(y_true, y_pred):
+    return jnp.mean(jnp.square(jnp.maximum(1.0 - y_true * y_pred, 0.0)))
+
+
+def rank_hinge(y_true, y_pred, margin: float = 1.0):
+    """Pairwise ranking hinge for (pos, neg) interleaved batches
+    (reference objectives/RankHinge.scala; used by KNRM/Ranker)."""
+    pos = y_pred[0::2]
+    neg = y_pred[1::2]
+    return jnp.mean(jnp.maximum(margin - pos + neg, 0.0))
+
+
+# rank_hinge couples rows across the batch — eval must not vmap it per-row.
+rank_hinge.batch_structured = True
+
+
+_REGISTRY = {
+    "mse": mean_squared_error,
+    "mean_squared_error": mean_squared_error,
+    "mae": mean_absolute_error,
+    "mean_absolute_error": mean_absolute_error,
+    "mape": mean_absolute_percentage_error,
+    "mean_absolute_percentage_error": mean_absolute_percentage_error,
+    "msle": mean_squared_logarithmic_error,
+    "mean_squared_logarithmic_error": mean_squared_logarithmic_error,
+    "binary_crossentropy": binary_crossentropy,
+    "bce": binary_crossentropy,
+    "binary_crossentropy_with_logits": binary_crossentropy_with_logits,
+    "categorical_crossentropy": categorical_crossentropy,
+    "sparse_categorical_crossentropy": sparse_categorical_crossentropy,
+    "sparse_categorical_crossentropy_with_logits":
+        sparse_categorical_crossentropy_with_logits,
+    "class_nll": class_nll,
+    "kld": kullback_leibler_divergence,
+    "kullback_leibler_divergence": kullback_leibler_divergence,
+    "poisson": poisson,
+    "cosine_proximity": cosine_proximity,
+    "hinge": hinge,
+    "squared_hinge": squared_hinge,
+    "rank_hinge": rank_hinge,
+}
+
+
+def get(loss: Union[str, LossFn]) -> LossFn:
+    """String → loss lowering (reference KerasUtils.scala:165-167)."""
+    if callable(loss):
+        return loss
+    key = loss.lower()
+    if key not in _REGISTRY:
+        raise ValueError(f"unknown loss {loss!r}; known: {sorted(_REGISTRY)}")
+    return _REGISTRY[key]

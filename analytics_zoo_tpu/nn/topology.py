@@ -1,0 +1,246 @@
+"""Model containers: ``Sequential`` and graph ``Model`` + KerasNet facade.
+
+Reference capability: api/keras/models/Topology.scala — ``KerasNet``
+(compile:136 / fit:344 / evaluate:497 / predict), ``Model``:603,
+``Sequential``:826.  Training itself lives in
+``analytics_zoo_tpu.train.Estimator`` (one jitted SPMD step); KerasNet
+methods are thin façades over it, exactly inverting the reference where the
+optimizer was buried inside Topology.scala.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from analytics_zoo_tpu.nn import autograd
+from analytics_zoo_tpu.nn.autograd import Variable, evaluate, topo_sort
+from analytics_zoo_tpu.nn.module import Layer, split_rng
+
+
+class KerasNet(Layer):
+    """Shared compile/fit/evaluate/predict facade for Sequential and Model."""
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self._estimator = None  # created by compile()
+
+    # -- training facade (delegates to train.Estimator) -------------------
+    def compile(self, optimizer, loss, metrics=None):
+        """Configure training (reference Topology.scala:136-204).
+
+        ``optimizer``/``loss``/``metrics`` accept strings (Keras-style
+        lowering, reference KerasUtils.scala:165-167) or objects.
+        """
+        from analytics_zoo_tpu.train.estimator import Estimator
+
+        self._estimator = Estimator(self, optimizer=optimizer, loss=loss,
+                                    metrics=metrics)
+        # apply settings made before compile()
+        if getattr(self, "_tb_dir", None):
+            self._estimator.set_tensorboard(self._tb_dir)
+        if getattr(self, "_ckpt_dir", None):
+            self._estimator.set_checkpoint(self._ckpt_dir)
+        return self
+
+    @property
+    def estimator(self):
+        if self._estimator is None:
+            raise RuntimeError("call compile(optimizer, loss) before fit/evaluate")
+        return self._estimator
+
+    def fit(self, x, y=None, batch_size: int = 32, nb_epoch: int = 1,
+            validation_data=None, **kw):
+        return self.estimator.fit(x, y, batch_size=batch_size,
+                                  epochs=nb_epoch,
+                                  validation_data=validation_data, **kw)
+
+    def evaluate(self, x, y=None, batch_size: int = 32):
+        return self.estimator.evaluate(x, y, batch_size=batch_size)
+
+    def predict(self, x, batch_size: int = 32, distributed: bool = True):
+        return self.estimator.predict(x, batch_size=batch_size)
+
+    def set_tensorboard(self, log_dir: str, app_name: str = "zoo"):
+        """Reference Topology.scala:205-212."""
+        from analytics_zoo_tpu.train.estimator import Estimator
+        self._tb_dir = f"{log_dir.rstrip('/')}/{app_name}"
+        if self._estimator is not None:
+            self._estimator.set_tensorboard(self._tb_dir)
+        return self
+
+    def set_checkpoint(self, path: str, over_write: bool = True):
+        """Reference Topology.scala:246-256."""
+        self._ckpt_dir = path
+        if self._estimator is not None:
+            self._estimator.set_checkpoint(path, over_write=over_write)
+        return self
+
+    # -- persistence ------------------------------------------------------
+    def save_weights(self, path: str, params, state=None):
+        from analytics_zoo_tpu.train import checkpoint as ckpt
+        ckpt.save_pytree(path, {"params": params, "state": state or {}})
+
+    def load_weights(self, path: str):
+        from analytics_zoo_tpu.train import checkpoint as ckpt
+        tree = ckpt.load_pytree(path)
+        return tree["params"], tree.get("state", {})
+
+    # -- introspection ----------------------------------------------------
+    def summary(self, params=None) -> str:
+        lines = [f"Model: {self.name}", "-" * 64]
+        total = 0
+        for layer in self.layers:
+            shape = getattr(layer, "built_shapes", None)
+            n = layer.param_count(params.get(layer.name, {})) if params else 0
+            total += n
+            lines.append(f"{layer.name:<32}{str(shape):<24}{n:>8}")
+        lines.append("-" * 64)
+        if params is not None:
+            total = sum(int(x.size) for x in jax.tree_util.tree_leaves(params))
+        lines.append(f"Total params: {total}")
+        out = "\n".join(lines)
+        print(out)
+        return out
+
+    @property
+    def layers(self) -> List[Layer]:
+        raise NotImplementedError
+
+
+class Sequential(KerasNet):
+    """Linear stack of layers (reference Topology.scala:826)."""
+
+    def __init__(self, layers: Optional[Sequence[Layer]] = None, **kw):
+        super().__init__(**kw)
+        self._layers: List[Layer] = []
+        for l in layers or []:
+            self.add(l)
+
+    def add(self, layer: Layer) -> "Sequential":
+        self._layers.append(layer)
+        return self
+
+    @property
+    def layers(self) -> List[Layer]:
+        return self._layers
+
+    # -- functional protocol ----------------------------------------------
+    def build(self, rng, *input_shapes):
+        if len(input_shapes) == 1:
+            shape = input_shapes[0]
+        elif self._layers and self._layers[0].input_shape is not None:
+            shape = (2,) + self._layers[0].input_shape
+        else:
+            raise ValueError("Sequential.build needs an input shape")
+        params: Dict[str, Any] = {}
+        state: Dict[str, Any] = {}
+        rngs = split_rng(rng, len(self._layers))
+        shapes: Union[Tuple, List[Tuple]] = shape
+        for layer, r in zip(self._layers, rngs):
+            cur = shapes if isinstance(shapes, tuple) else tuple(shapes)
+            p, s = layer.init(r, cur)
+            params[layer.name] = p
+            state[layer.name] = s
+            shapes = layer.output_shape(p, s, cur)
+        self._output_shape = shapes
+        return params, state
+
+    def call(self, params, state, x, *, training: bool = False, rng=None):
+        new_state = dict(state)
+        rngs = split_rng(rng, len(self._layers))
+        for layer, r in zip(self._layers, rngs):
+            x, ns = layer.call(params.get(layer.name, {}),
+                               state.get(layer.name, {}), x,
+                               training=training, rng=r)
+            new_state[layer.name] = ns
+        return x, new_state
+
+
+class Model(KerasNet):
+    """Graph model over autograd Variables (reference Topology.scala:603).
+
+    >>> a = Input(shape=(8,)); b = Input(shape=(8,))
+    >>> h = Dense(16, activation="relu")(merge([a, b], mode="concat"))
+    >>> out = Dense(1, activation="sigmoid")(h)
+    >>> model = Model([a, b], out)
+    """
+
+    def __init__(self, inputs, outputs, **kw):
+        super().__init__(**kw)
+        self.inputs: List[Variable] = (
+            list(inputs) if isinstance(inputs, (list, tuple)) else [inputs])
+        self.single_output = not isinstance(outputs, (list, tuple))
+        self.outputs: List[Variable] = (
+            [outputs] if self.single_output else list(outputs))
+        self.order = topo_sort(self.outputs)
+        input_ids = {v.id for v in self.inputs}
+        for v in self.order:
+            if v.kind == "input" and v.id not in input_ids:
+                raise ValueError(f"graph uses input {v.name} not in inputs=")
+
+    @property
+    def layers(self) -> List[Layer]:
+        seen = {}
+        for v in self.order:
+            if v.kind in ("layer", "param") and v.layer.name not in seen:
+                seen[v.layer.name] = v.layer
+        return list(seen.values())
+
+    # -- functional protocol ----------------------------------------------
+    def build(self, rng, *input_shapes):
+        if not input_shapes:
+            input_shapes = tuple(
+                (2,) + tuple(d for d in v.shape[1:]) for v in self.inputs)
+        if len(input_shapes) != len(self.inputs):
+            raise ValueError(
+                f"expected {len(self.inputs)} input shapes, got {len(input_shapes)}")
+        params: Dict[str, Any] = {}
+        state: Dict[str, Any] = {}
+        # Abstract values per node, threaded through the DAG as we build.
+        absval: Dict[int, Any] = {
+            v.id: jax.ShapeDtypeStruct(tuple(s), v.dtype)
+            for v, s in zip(self.inputs, input_shapes)
+        }
+        layer_nodes = [v for v in self.order if v.kind in ("layer", "param")]
+        rngs = split_rng(rng, len(layer_nodes))
+        rng_map = {v.id: r for v, r in zip(layer_nodes, rngs)}
+        for v in self.order:
+            if v.id in absval:
+                continue
+            parent_abs = [absval[p.id] for p in v.parents]
+            if v.kind in ("layer", "param"):
+                if v.layer.name not in params:  # shared layers build once
+                    p, s = v.layer.init(rng_map[v.id],
+                                        *[tuple(a.shape) for a in parent_abs])
+                    params[v.layer.name] = p
+                    state[v.layer.name] = s
+
+                def absfn(lp, ls, *xs, _l=v.layer):
+                    out, _ = _l.call(lp, ls, *xs, training=False, rng=None)
+                    return out
+
+                absval[v.id] = jax.eval_shape(
+                    absfn, params[v.layer.name], state[v.layer.name], *parent_abs)
+            else:
+                absval[v.id] = jax.eval_shape(v.fn, *parent_abs)
+        self._output_shape = tuple(
+            absval[o.id].shape for o in self.outputs)
+        if self.single_output:
+            self._output_shape = self._output_shape[0]
+        return params, state
+
+    def call(self, params, state, *inputs, training: bool = False, rng=None):
+        if len(inputs) == 1 and isinstance(inputs[0], (list, tuple)):
+            inputs = tuple(inputs[0])
+        if len(inputs) != len(self.inputs):
+            raise ValueError(
+                f"expected {len(self.inputs)} inputs, got {len(inputs)}")
+        env = {v.id: x for v, x in zip(self.inputs, inputs)}
+        env, new_state = evaluate(self.order, env, params, state,
+                                  training=training, rng=rng)
+        outs = [env[o.id] for o in self.outputs]
+        return (outs[0] if self.single_output else outs), new_state

@@ -1,0 +1,2 @@
+from analytics_zoo_tpu.train import checkpoint, optimizers  # noqa: F401
+from analytics_zoo_tpu.train.estimator import Estimator  # noqa: F401

@@ -1,0 +1,462 @@
+"""Estimator — the training/eval/predict engine.
+
+Reference capability: ``InternalDistriOptimizer`` + ``Estimator``
+(api/keras/models/Topology.scala:962-1598, pipeline/estimator/Estimator.scala:65).
+The reference runs 2 Spark jobs per iteration (forward/backward tasks, then
+a block-manager gradient shuffle + weight re-broadcast, wp-bigdl.md:113-160).
+
+TPU-native design: ONE jitted SPMD step.  Parameters/optimizer state are
+replicated over the mesh; the batch is sharded along the ``data`` axis;
+``jax.grad`` of a sharded-batch loss makes XLA insert a single fused
+all-reduce (psum) over ICI for the gradients.  The whole iteration —
+forward, backward, allreduce, optimizer update — is one XLA program with
+donated buffers, so there is no parameter server, no task launch overhead,
+and no host round-trip in the hot loop.
+
+Also carried over, re-designed:
+- trigger-driven validation/checkpointing (`ZooTrigger` → core.triggers)
+- failure retry from latest checkpoint (Topology.scala:1179-1261)
+- LocalEstimator (LocalEstimator.scala:39) collapses into this same class
+  on a 1-device mesh.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import time
+from functools import partial
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from analytics_zoo_tpu.core.context import ZooContext, get_zoo_context
+from analytics_zoo_tpu.core.triggers import (EveryEpoch, Trigger, TriggerState)
+from analytics_zoo_tpu.nn import metrics as metrics_lib
+from analytics_zoo_tpu.nn import objectives
+from analytics_zoo_tpu.train import checkpoint as ckpt_lib
+from analytics_zoo_tpu.train import optimizers as optim_lib
+
+logger = logging.getLogger("analytics_zoo_tpu.train")
+
+
+def _as_list(x) -> List[np.ndarray]:
+    if x is None:
+        return []
+    if isinstance(x, (list, tuple)):
+        return list(x)
+    return [x]
+
+
+class Estimator:
+    """fit/evaluate/predict over a model following the Layer protocol."""
+
+    def __init__(self, model, optimizer="adam", loss="mse",
+                 metrics: Optional[Sequence] = None,
+                 ctx: Optional[ZooContext] = None,
+                 grad_clip_norm: Optional[float] = None,
+                 grad_clip_value: Optional[float] = None):
+        self.model = model
+        self.tx = optim_lib.get(optimizer)
+        if grad_clip_norm is not None:
+            self.tx = optax.chain(optax.clip_by_global_norm(grad_clip_norm), self.tx)
+        elif grad_clip_value is not None:
+            self.tx = optax.chain(optax.clip(grad_clip_value), self.tx)
+        self.loss_fn = objectives.get(loss)
+        self.metrics = [metrics_lib.get(m) for m in (metrics or [])]
+        self.ctx = ctx or get_zoo_context()
+
+        # mutable training state (host handles to device arrays)
+        self.params = None
+        self.state = None
+        self.opt_state = None
+        self.global_step = 0
+        self.finished_epochs = 0
+        self.history: List[Dict[str, float]] = []
+
+        self._ckpt_mgr: Optional[ckpt_lib.CheckpointManager] = None
+        self._ckpt_trigger: Trigger = EveryEpoch()
+        self._tb_writer = None
+        self._rng = jax.random.PRNGKey(self.ctx.config.seed)
+
+        self._train_step = None
+        self._eval_step = None
+        self._predict_step = None
+
+    # ------------------------------------------------------------------
+    # configuration
+    # ------------------------------------------------------------------
+    def set_checkpoint(self, path: str, over_write: bool = True,
+                       trigger: Optional[Trigger] = None, keep: int = 3):
+        self._ckpt_mgr = ckpt_lib.CheckpointManager(path, keep=keep)
+        if trigger is not None:
+            self._ckpt_trigger = trigger
+        return self
+
+    def set_tensorboard(self, log_dir: str):
+        from analytics_zoo_tpu.core.summary import SummaryWriter
+        self._tb_writer = SummaryWriter(log_dir)
+        return self
+
+    # ------------------------------------------------------------------
+    # initialization & compiled steps
+    # ------------------------------------------------------------------
+    def set_initial_weights(self, params, state=None):
+        """Weights applied instead of random init at first build
+        (used by ZooModel.load_model)."""
+        self._initial_weights = (params, state or {})
+        if self.params is not None:
+            rep = self.ctx.replicated_sharding()
+            self.params = jax.device_put(params, rep)
+            self.state = jax.device_put(state or {}, rep)
+            self.opt_state = self.tx.init(self.params)
+        return self
+
+    def _ensure_built(self, inputs: List[np.ndarray]):
+        if self.params is not None:
+            return
+        self._rng, init_rng = jax.random.split(self._rng)
+        shapes = [(2,) + tuple(x.shape[1:]) for x in inputs]
+        self.params, self.state = self.model.init(init_rng, *shapes)
+        pending = getattr(self, "_initial_weights", None)
+        if pending is not None:
+            self.params, self.state = pending
+        self.opt_state = self.tx.init(self.params)
+        # replicate across the mesh
+        rep = self.ctx.replicated_sharding()
+        self.params = jax.device_put(self.params, rep)
+        self.state = jax.device_put(self.state, rep)
+        self.opt_state = jax.device_put(self.opt_state, rep)
+
+    def _build_train_step(self):
+        model, loss_fn, tx = self.model, self.loss_fn, self.tx
+        data_shard = self.ctx.data_sharding()
+        rep = self.ctx.replicated_sharding()
+
+        def step(params, state, opt_state, rng, step_i, xs, y):
+            rng = jax.random.fold_in(rng, step_i)
+
+            def lossf(p):
+                preds, new_state = model.call(p, state, *xs, training=True,
+                                              rng=rng)
+                loss = loss_fn(y, preds)
+                return loss, new_state
+
+            (loss, new_state), grads = jax.value_and_grad(
+                lossf, has_aux=True)(params)
+            updates, new_opt = tx.update(grads, opt_state, params)
+            new_params = optax.apply_updates(params, updates)
+            return new_params, new_state, new_opt, loss
+
+        self._train_step = jax.jit(
+            step,
+            in_shardings=(rep, rep, rep, rep, None, data_shard, data_shard),
+            out_shardings=(rep, rep, rep, None),
+            donate_argnums=(0, 1, 2),
+        )
+
+    def _build_eval_step(self):
+        model, loss_fn, mets = self.model, self.loss_fn, self.metrics
+        data_shard = self.ctx.data_sharding()
+        rep = self.ctx.replicated_sharding()
+
+        batch_structured = getattr(loss_fn, "batch_structured", False)
+
+        def step(params, state, xs, y, mask):
+            preds, _ = model.call(params, state, *xs, training=False, rng=None)
+            if batch_structured:
+                # Loss couples rows across the batch (e.g. rank_hinge):
+                # compute over the whole batch; padded rows are a small
+                # approximation on the final partial batch only.
+                stats = {"loss_sum": loss_fn(y, preds) * jnp.sum(mask),
+                         "count": jnp.sum(mask)}
+            else:
+                # Per-sample losses (vmap over the mean-reduced loss, B=1)
+                # so padded rows are excluded exactly via the mask.
+                per = jax.vmap(
+                    lambda yt, yp: loss_fn(yt[None], yp[None]))(y, preds)
+                stats = {"loss_sum": jnp.sum(per * mask),
+                         "count": jnp.sum(mask)}
+            out = {"__loss": stats}
+            for m in mets:
+                out[m.name] = m.update(y, preds, mask)
+            return out
+
+        self._eval_step = jax.jit(
+            step, in_shardings=(rep, rep, data_shard, data_shard, data_shard),
+            out_shardings=rep)
+
+    def _build_predict_step(self):
+        model = self.model
+        data_shard = self.ctx.data_sharding()
+        rep = self.ctx.replicated_sharding()
+
+        def step(params, state, xs):
+            preds, _ = model.call(params, state, *xs, training=False, rng=None)
+            return preds
+
+        self._predict_step = jax.jit(
+            step, in_shardings=(rep, rep, data_shard), out_shardings=data_shard)
+
+    # ------------------------------------------------------------------
+    # data plumbing
+    # ------------------------------------------------------------------
+    def _pad_to_devices(self, arrs: List[np.ndarray], batch: int
+                        ) -> Tuple[List[np.ndarray], int]:
+        """Pad batch dim up to ``batch`` (already a mesh-size multiple) so
+        every step sees ONE static shape (no per-remainder recompiles);
+        returns the real row count."""
+        n = arrs[0].shape[0]
+        d = self.ctx.num_devices
+        target = max(batch, d, int(math.ceil(n / d)) * d)
+        if target == n:
+            return arrs, n
+        padded = []
+        for a in arrs:
+            pad = np.zeros((target - n,) + a.shape[1:], a.dtype)
+            padded.append(np.concatenate([a, pad], axis=0))
+        return padded, n
+
+    def _shard_batch(self, arrs: List[np.ndarray]):
+        shard = self.ctx.data_sharding()
+        return [jax.device_put(jnp.asarray(a), shard) for a in arrs]
+
+    # ------------------------------------------------------------------
+    # fit
+    # ------------------------------------------------------------------
+    def fit(self, x, y=None, batch_size: int = 32, epochs: int = 1,
+            validation_data=None, end_trigger: Optional[Trigger] = None,
+            shuffle: bool = True, verbose: bool = True):
+        """Synchronous SPMD training with retry-from-checkpoint.
+
+        ``x`` — array or list of arrays (multi-input models); or a
+        FeatureSet/dataset yielding ``(inputs..., y)`` batches.
+        """
+        from analytics_zoo_tpu.data.featureset import FeatureSet
+
+        if isinstance(x, FeatureSet):
+            return self._fit_featureset(x, batch_size, epochs,
+                                        validation_data, end_trigger, verbose)
+
+        xs = _as_list(x)
+        assert y is not None, "y required for array training"
+        n = xs[0].shape[0]
+        d = self.ctx.num_devices
+        eff_batch = max(batch_size, d)
+        if batch_size % d != 0:
+            eff_batch = int(math.ceil(batch_size / d)) * d
+            logger.warning("batch_size %d not divisible by %d devices; "
+                           "using %d", batch_size, d, eff_batch)
+        steps_per_epoch = n // eff_batch
+        if steps_per_epoch == 0:
+            raise ValueError(f"dataset ({n}) smaller than batch ({eff_batch})")
+        dropped = n - steps_per_epoch * eff_batch
+        if dropped:
+            logger.warning(
+                "dropping %d/%d samples per epoch (dataset not a multiple of "
+                "batch %d); reshuffling each epoch varies which are dropped",
+                dropped, n, eff_batch)
+
+        self._ensure_built(xs)
+        if self._train_step is None:
+            self._build_train_step()
+
+        retries = 0
+        cfg = self.ctx.config
+        epoch = self.finished_epochs
+        rng_np = np.random.RandomState(cfg.seed)
+
+        while epoch < epochs:
+            try:
+                t0 = time.time()
+                perm = rng_np.permutation(n) if shuffle else np.arange(n)
+                losses = []
+                for s in range(steps_per_epoch):
+                    idx = perm[s * eff_batch:(s + 1) * eff_batch]
+                    batch_x = self._shard_batch([a[idx] for a in xs])
+                    batch_y = self._shard_batch([np.asarray(y)[idx]])[0]
+                    self.params, self.state, self.opt_state, loss = (
+                        self._train_step(self.params, self.state,
+                                         self.opt_state, self._rng,
+                                         jnp.asarray(self.global_step), batch_x,
+                                         batch_y))
+                    self.global_step += 1
+                    losses.append(loss)
+                epoch += 1
+                self.finished_epochs = epoch
+                mean_loss = float(jnp.mean(jnp.stack(losses)))
+                dt = time.time() - t0
+                rec = {"epoch": epoch, "loss": mean_loss,
+                       "throughput": steps_per_epoch * eff_batch / dt}
+                tstate = TriggerState(epoch=epoch, iteration=self.global_step,
+                                      epoch_finished=True, loss=mean_loss)
+                if validation_data is not None:
+                    val = self.evaluate(validation_data[0], validation_data[1],
+                                        batch_size=eff_batch)
+                    rec.update({f"val_{k}": v for k, v in val.items()})
+                    tstate.score = val.get(
+                        self.metrics[0].name if self.metrics else "loss")
+                self.history.append(rec)
+                if self._tb_writer is not None:
+                    for k, v in rec.items():
+                        if k != "epoch":
+                            self._tb_writer.add_scalar(k, v, self.global_step)
+                    self._tb_writer.flush()
+                if verbose:
+                    logger.info("epoch %d: %s", epoch,
+                                {k: round(v, 5) for k, v in rec.items()
+                                 if k != "epoch"})
+                if self._ckpt_mgr is not None and self._ckpt_trigger(tstate):
+                    self._save_checkpoint()
+                if end_trigger is not None and end_trigger(tstate):
+                    break
+            except (KeyboardInterrupt,):
+                raise
+            except Exception as e:  # failure-retry (Topology.scala:1179-1261)
+                retries += 1
+                if (self._ckpt_mgr is None
+                        or self._ckpt_mgr.latest_step() is None
+                        or retries > cfg.failure_retry_times):
+                    raise
+                logger.warning("step failed (%s); retry %d/%d from checkpoint",
+                               e, retries, cfg.failure_retry_times)
+                self._restore_checkpoint()
+        return self.history
+
+    def _fit_featureset(self, fs, batch_size, epochs, validation_data,
+                        end_trigger, verbose):
+        """Train from a FeatureSet (iterator-based, supports DISK_AND_DRAM)."""
+        first = True
+        for epoch in range(self.finished_epochs, epochs):
+            t0 = time.time()
+            losses = []
+            count = 0
+            for batch in fs.batches(batch_size, shuffle=True,
+                                    drop_remainder=True,
+                                    pad_to=self.ctx.num_devices):
+                *bx, by = batch
+                if first:
+                    self._ensure_built(bx)
+                    if self._train_step is None:
+                        self._build_train_step()
+                    first = False
+                batch_x = self._shard_batch(bx)
+                batch_y = self._shard_batch([by])[0]
+                self.params, self.state, self.opt_state, loss = (
+                    self._train_step(self.params, self.state, self.opt_state,
+                                     self._rng, jnp.asarray(self.global_step),
+                                     batch_x, batch_y))
+                self.global_step += 1
+                count += by.shape[0]
+                losses.append(loss)
+            self.finished_epochs = epoch + 1
+            mean_loss = float(jnp.mean(jnp.stack(losses)))
+            dt = time.time() - t0
+            rec = {"epoch": epoch + 1, "loss": mean_loss,
+                   "throughput": count / dt}
+            tstate = TriggerState(epoch=epoch + 1, iteration=self.global_step,
+                                  epoch_finished=True, loss=mean_loss)
+            if validation_data is not None:
+                val = self.evaluate(validation_data[0], validation_data[1],
+                                    batch_size=batch_size)
+                rec.update({f"val_{k}": v for k, v in val.items()})
+                tstate.score = val.get(
+                    self.metrics[0].name if self.metrics else "loss")
+            self.history.append(rec)
+            if self._tb_writer is not None:
+                for k, v in rec.items():
+                    if k != "epoch":
+                        self._tb_writer.add_scalar(k, v, self.global_step)
+                self._tb_writer.flush()
+            if verbose:
+                logger.info("epoch %d: %s", epoch + 1, rec)
+            if self._ckpt_mgr is not None and self._ckpt_trigger(tstate):
+                self._save_checkpoint()
+            if end_trigger is not None and end_trigger(tstate):
+                break
+        return self.history
+
+    # ------------------------------------------------------------------
+    # evaluate / predict
+    # ------------------------------------------------------------------
+    def evaluate(self, x, y=None, batch_size: int = 32) -> Dict[str, float]:
+        xs = _as_list(x)
+        self._ensure_built(xs)
+        if self._eval_step is None:
+            self._build_eval_step()
+        n = xs[0].shape[0]
+        d = self.ctx.num_devices
+        eff_batch = int(math.ceil(max(batch_size, d) / d)) * d
+        y = np.asarray(y)
+        agg = None
+        for s in range(int(math.ceil(n / eff_batch))):
+            sl = slice(s * eff_batch, min((s + 1) * eff_batch, n))
+            bx = [a[sl] for a in xs]
+            by = y[sl]
+            mask = np.ones((by.shape[0],), np.float32)
+            (bx_p, real) = self._pad_to_devices(bx, eff_batch)
+            (by_p, _) = self._pad_to_devices([by], eff_batch)
+            (mask_p, _) = self._pad_to_devices([mask], eff_batch)
+            stats = self._eval_step(self.params, self.state,
+                                    self._shard_batch(bx_p),
+                                    self._shard_batch(by_p)[0],
+                                    self._shard_batch(mask_p)[0])
+            stats = jax.device_get(stats)
+            agg = stats if agg is None else jax.tree_util.tree_map(
+                np.add, agg, stats)
+        out = {"loss": float(agg["__loss"]["loss_sum"] / agg["__loss"]["count"])}
+        for m in self.metrics:
+            out[m.name] = float(m.finalize(agg[m.name]))
+        return out
+
+    def predict(self, x, batch_size: int = 32) -> np.ndarray:
+        xs = _as_list(x)
+        self._ensure_built(xs)
+        if self._predict_step is None:
+            self._build_predict_step()
+        n = xs[0].shape[0]
+        d = self.ctx.num_devices
+        eff_batch = int(math.ceil(max(batch_size, d) / d)) * d
+        outs = []
+        for s in range(int(math.ceil(n / eff_batch))):
+            sl = slice(s * eff_batch, min((s + 1) * eff_batch, n))
+            bx = [a[sl] for a in xs]
+            bx_p, real = self._pad_to_devices(bx, eff_batch)
+            preds = self._predict_step(self.params, self.state,
+                                       self._shard_batch(bx_p))
+            preds = jax.device_get(preds)
+            if isinstance(preds, (list, tuple)):
+                preds = preds[0]
+            outs.append(np.asarray(preds)[:real])
+        return np.concatenate(outs, axis=0)
+
+    # ------------------------------------------------------------------
+    # checkpoint plumbing
+    # ------------------------------------------------------------------
+    def _snapshot(self):
+        return {"params": self.params, "state": self.state,
+                "opt_state": self.opt_state,
+                "meta": {"global_step": np.asarray(self.global_step),
+                         "finished_epochs": np.asarray(self.finished_epochs)}}
+
+    def _save_checkpoint(self):
+        path = self._ckpt_mgr.save(self.global_step, self._snapshot())
+        logger.info("checkpoint saved: %s", path)
+
+    def _restore_checkpoint(self):
+        step, tree = self._ckpt_mgr.restore()
+        rep = self.ctx.replicated_sharding()
+        self.params = jax.device_put(tree["params"], rep)
+        self.state = jax.device_put(tree["state"], rep)
+        self.opt_state = jax.device_put(tree["opt_state"], rep)
+        self.global_step = int(tree["meta"]["global_step"])
+        self.finished_epochs = int(tree["meta"]["finished_epochs"])
+        logger.info("restored checkpoint step %d", step)
+
+    def load_checkpoint(self, directory: str):
+        self._ckpt_mgr = ckpt_lib.CheckpointManager(directory)
+        self._restore_checkpoint()
+        return self

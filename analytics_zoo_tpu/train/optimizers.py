@@ -1,0 +1,136 @@
+"""Optimizers — optax-based, with the reference's Zoo-specific extras.
+
+Reference capability: api/keras/optimizers/Adam.scala (147 LoC, Adam with
+pluggable LR schedules) and AdamWeightDecay.scala (155 LoC, BERT-style
+decoupled weight decay + linear warmup/decay), plus the BigDL optimizers
+reachable through string lowering (sgd/rmsprop/adagrad/adadelta/adamax).
+
+Everything returns an ``optax.GradientTransformation`` so the train step is
+one fused XLA program (no per-parameter Python loops).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Union
+
+import optax
+
+ScheduleOrFloat = Union[float, Callable[[int], float]]
+
+
+def make_schedule(lr: ScheduleOrFloat, schedule: Optional[str] = None,
+                  decay: float = 0.0, warmup_steps: int = 0,
+                  total_steps: Optional[int] = None):
+    """Build an optax schedule from Keras/Zoo-style knobs.
+
+    ``decay`` replicates Keras' ``lr / (1 + decay * iterations)``;
+    ``schedule`` in {poly, cosine, exponential} covers the Zoo SGD
+    schedules; warmup covers AdamWeightDecay's warmup portion.
+    """
+    if callable(lr):
+        return lr
+    base = float(lr)
+
+    if schedule is None:
+        if decay:
+            sched = lambda step: base / (1.0 + decay * step)  # noqa: E731
+        else:
+            sched = optax.constant_schedule(base)
+    elif schedule == "poly":
+        assert total_steps, "poly schedule needs total_steps"
+        sched = optax.polynomial_schedule(base, 0.0, power=1.0,
+                                          transition_steps=total_steps)
+    elif schedule == "cosine":
+        assert total_steps, "cosine schedule needs total_steps"
+        sched = optax.cosine_decay_schedule(base, decay_steps=total_steps)
+    elif schedule == "exponential":
+        sched = optax.exponential_decay(base, transition_steps=1000,
+                                        decay_rate=0.96)
+    else:
+        raise ValueError(f"unknown schedule {schedule!r}")
+
+    if warmup_steps > 0:
+        warm = optax.linear_schedule(0.0, base, warmup_steps)
+        sched = optax.join_schedules([warm, sched], [warmup_steps])
+    return sched
+
+
+def Adam(lr: ScheduleOrFloat = 1e-3, beta_1: float = 0.9,
+         beta_2: float = 0.999, epsilon: float = 1e-8, decay: float = 0.0,
+         schedule: Optional[str] = None, warmup_steps: int = 0,
+         total_steps: Optional[int] = None) -> optax.GradientTransformation:
+    """Reference api/keras/optimizers/Adam.scala (schedule-aware Adam)."""
+    sched = make_schedule(lr, schedule, decay, warmup_steps, total_steps)
+    return optax.adam(sched, b1=beta_1, b2=beta_2, eps=epsilon)
+
+
+def AdamWeightDecay(lr: ScheduleOrFloat = 1e-3, warmup_portion: float = -1.0,
+                    total: int = -1, schedule: str = "linear",
+                    beta_1: float = 0.9, beta_2: float = 0.999,
+                    epsilon: float = 1e-6, weight_decay: float = 0.01
+                    ) -> optax.GradientTransformation:
+    """BERT-style AdamW (reference AdamWeightDecay.scala:
+    linear warmup over ``warmup_portion * total`` steps, then linear decay
+    to zero over ``total`` steps, decoupled weight decay)."""
+    if total > 0:
+        warmup = int(max(warmup_portion, 0.0) * total)
+        sched = optax.join_schedules(
+            [optax.linear_schedule(0.0, float(lr), max(warmup, 1)),
+             optax.linear_schedule(float(lr), 0.0, max(total - warmup, 1))],
+            [max(warmup, 1)])
+    else:
+        sched = make_schedule(lr)
+    return optax.adamw(sched, b1=beta_1, b2=beta_2, eps=epsilon,
+                       weight_decay=weight_decay)
+
+
+def SGD(lr: ScheduleOrFloat = 0.01, momentum: float = 0.0,
+        decay: float = 0.0, nesterov: bool = False,
+        schedule: Optional[str] = None, warmup_steps: int = 0,
+        total_steps: Optional[int] = None) -> optax.GradientTransformation:
+    sched = make_schedule(lr, schedule, decay, warmup_steps, total_steps)
+    return optax.sgd(sched, momentum=momentum or None, nesterov=nesterov)
+
+
+def RMSprop(lr: ScheduleOrFloat = 1e-3, rho: float = 0.9,
+            epsilon: float = 1e-8, decay: float = 0.0):
+    return optax.rmsprop(make_schedule(lr, decay=decay), decay=rho, eps=epsilon)
+
+
+def Adagrad(lr: ScheduleOrFloat = 0.01):
+    return optax.adagrad(make_schedule(lr))
+
+
+def Adadelta(lr: ScheduleOrFloat = 1.0, rho: float = 0.95,
+             epsilon: float = 1e-8):
+    return optax.adadelta(make_schedule(lr), rho=rho, eps=epsilon)
+
+
+def Adamax(lr: ScheduleOrFloat = 2e-3, beta_1: float = 0.9,
+           beta_2: float = 0.999, epsilon: float = 1e-8):
+    return optax.adamax(make_schedule(lr), b1=beta_1, b2=beta_2, eps=epsilon)
+
+
+_REGISTRY = {
+    "adam": Adam,
+    "adamweightdecay": AdamWeightDecay,
+    "adamw": AdamWeightDecay,
+    "sgd": SGD,
+    "rmsprop": RMSprop,
+    "adagrad": Adagrad,
+    "adadelta": Adadelta,
+    "adamax": Adamax,
+}
+
+
+def get(optimizer) -> optax.GradientTransformation:
+    """String → optimizer lowering (reference KerasUtils.scala:165-167)."""
+    if isinstance(optimizer, optax.GradientTransformation):
+        return optimizer
+    if callable(optimizer) and not isinstance(optimizer, str):
+        return optimizer()
+    key = str(optimizer).lower()
+    if key not in _REGISTRY:
+        raise ValueError(f"unknown optimizer {optimizer!r}; "
+                         f"known: {sorted(_REGISTRY)}")
+    return _REGISTRY[key]()

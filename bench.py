@@ -1,0 +1,107 @@
+"""Benchmark: NCF MovieLens-1M training throughput (samples/sec/chip).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The reference publishes no absolute NCF numbers (BASELINE.md), so the
+baseline here is the *same training step on the host CPU* — the honest
+stand-in for "BigDL-on-CPU on this machine" given BigDL targets CPU.  The
+north-star is vs_baseline ≥ 10.
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+def build_step(model, tx, loss_fn):
+    import jax
+    import optax
+
+    def step(params, state, opt_state, users, items, labels):
+        def lossf(p):
+            preds, ns = model.call(p, state, users, items, training=True)
+            return loss_fn(labels, preds), ns
+
+        (loss, new_state), grads = jax.value_and_grad(
+            lossf, has_aux=True)(params)
+        updates, new_opt = tx.update(grads, opt_state, params)
+        return (optax.apply_updates(params, updates), new_state, new_opt,
+                loss)
+
+    return step
+
+
+def measure(device, batch=8192, warmup=3, iters=20):
+    import jax
+    import jax.numpy as jnp
+
+    from analytics_zoo_tpu.models import NeuralCF
+    from analytics_zoo_tpu.nn import reset_name_scope
+    from analytics_zoo_tpu.nn.objectives import (
+        sparse_categorical_crossentropy)
+    from analytics_zoo_tpu.train.optimizers import Adam
+
+    reset_name_scope()
+    # MovieLens-1M shape, reference default hyper-params
+    # (NeuralCF.scala:45: userEmbed/itemEmbed/mfEmbed=20, hidden 40/20/10)
+    ncf = NeuralCF(user_count=6040, item_count=3706, class_num=5,
+                   user_embed=20, item_embed=20, hidden_layers=(40, 20, 10),
+                   mf_embed=20)
+    model = ncf.model
+    rs = np.random.RandomState(0)
+    users = rs.randint(1, 6041, (batch, 1)).astype(np.int32)
+    items = rs.randint(1, 3707, (batch, 1)).astype(np.int32)
+    labels = rs.randint(0, 5, batch).astype(np.int32)
+
+    with jax.default_device(device):
+        params, state = model.init(jax.random.PRNGKey(0))
+        tx = Adam(lr=1e-3)
+        opt_state = tx.init(params)
+        step = jax.jit(build_step(model, tx, sparse_categorical_crossentropy),
+                       donate_argnums=(0, 1, 2))
+        u = jax.device_put(jnp.asarray(users), device)
+        i = jax.device_put(jnp.asarray(items), device)
+        y = jax.device_put(jnp.asarray(labels), device)
+        params = jax.device_put(params, device)
+        state = jax.device_put(state, device)
+        opt_state = jax.device_put(opt_state, device)
+
+        for _ in range(warmup):
+            params, state, opt_state, loss = step(params, state, opt_state,
+                                                  u, i, y)
+        jax.block_until_ready(loss)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            params, state, opt_state, loss = step(params, state, opt_state,
+                                                  u, i, y)
+        jax.block_until_ready(loss)
+        dt = time.perf_counter() - t0
+    return batch * iters / dt
+
+
+def main():
+    import jax
+
+    accel = jax.devices()[0]
+    value = measure(accel)
+
+    vs_baseline = None
+    try:
+        cpu = jax.local_devices(backend="cpu")[0]
+        cpu_tput = measure(cpu, batch=8192, warmup=1, iters=5)
+        if cpu_tput > 0:
+            vs_baseline = value / cpu_tput
+    except Exception:
+        pass
+
+    print(json.dumps({
+        "metric": "ncf_movielens1m_train_samples_per_sec_per_chip",
+        "value": round(value, 1),
+        "unit": "samples/sec/chip",
+        "vs_baseline": round(vs_baseline, 3) if vs_baseline else None,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,11 @@
+from setuptools import find_packages, setup
+
+setup(
+    name="analytics-zoo-tpu",
+    version="0.1.0",
+    description="TPU-native deep-learning framework (JAX/XLA/Pallas) with "
+                "Analytics Zoo capabilities",
+    packages=find_packages(include=["analytics_zoo_tpu*"]),
+    python_requires=">=3.10",
+    install_requires=["jax", "numpy", "optax"],
+)

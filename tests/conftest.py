@@ -1,0 +1,38 @@
+"""Test configuration: force an 8-device virtual CPU mesh.
+
+All tests exercise the SPMD code paths on a virtual 8-device CPU topology
+(mirrors the reference's strategy of running distributed specs on
+``local[4]`` Spark — SURVEY.md §4.4) so sharding/collective logic is tested
+without TPU hardware.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# The axon TPU plugin (sitecustomize) overrides JAX_PLATFORMS via jax
+# config, so the env var alone is not enough — force CPU explicitly.
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def zoo_ctx():
+    from analytics_zoo_tpu import init_zoo_context
+
+    return init_zoo_context()
+
+
+@pytest.fixture
+def rng():
+    import jax
+
+    return jax.random.PRNGKey(0)

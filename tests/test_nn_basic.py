@@ -1,0 +1,160 @@
+"""Tests for the nn layer protocol, Sequential, Model/autograd DSL."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def fresh_names():
+    from analytics_zoo_tpu.nn import reset_name_scope
+
+    reset_name_scope()
+
+
+def test_dense_shapes_and_forward(rng):
+    from analytics_zoo_tpu.nn.layers.core import Dense
+
+    layer = Dense(4, activation="relu")
+    params, state = layer.init(rng, (2, 3))
+    assert params["kernel"].shape == (3, 4)
+    assert params["bias"].shape == (4,)
+    x = jnp.ones((2, 3))
+    y, _ = layer.call(params, state, x)
+    assert y.shape == (2, 4)
+    assert (np.asarray(y) >= 0).all()
+    # matches manual computation
+    expect = np.maximum(np.asarray(x) @ np.asarray(params["kernel"])
+                        + np.asarray(params["bias"]), 0)
+    np.testing.assert_allclose(np.asarray(y), expect, rtol=1e-6)
+
+
+def test_dense_3d_input(rng):
+    from analytics_zoo_tpu.nn.layers.core import Dense
+
+    layer = Dense(5)
+    params, state = layer.init(rng, (2, 7, 3))
+    y, _ = layer.call(params, state, jnp.ones((2, 7, 3)))
+    assert y.shape == (2, 7, 5)
+
+
+def test_dropout_train_vs_eval(rng):
+    from analytics_zoo_tpu.nn.layers.core import Dropout
+
+    layer = Dropout(0.5)
+    params, state = layer.init(rng, (4, 100))
+    x = jnp.ones((4, 100))
+    y_eval, _ = layer.call(params, state, x, training=False)
+    np.testing.assert_allclose(np.asarray(y_eval), 1.0)
+    y_train, _ = layer.call(params, state, x, training=True, rng=rng)
+    arr = np.asarray(y_train)
+    assert (arr == 0).any() and (arr == 2.0).any()
+
+
+def test_embedding_gather(rng):
+    from analytics_zoo_tpu.nn.layers.embedding import Embedding
+
+    layer = Embedding(10, 4)
+    params, state = layer.init(rng, (2, 3))
+    ids = jnp.asarray([[0, 1, 2], [9, 9, 0]])
+    y, _ = layer.call(params, state, ids)
+    assert y.shape == (2, 3, 4)
+    np.testing.assert_allclose(np.asarray(y[0, 1]),
+                               np.asarray(params["table"][1]))
+
+
+def test_sequential_mlp(rng):
+    from analytics_zoo_tpu.nn import Sequential
+    from analytics_zoo_tpu.nn.layers.core import Dense, Dropout, Flatten
+
+    model = Sequential([
+        Flatten(),
+        Dense(16, activation="relu"),
+        Dropout(0.1),
+        Dense(3, activation="softmax"),
+    ])
+    params, state = model.init(rng, (4, 2, 5))
+    y, _ = model.call(params, state, jnp.ones((4, 2, 5)))
+    assert y.shape == (4, 3)
+    np.testing.assert_allclose(np.asarray(y).sum(axis=-1), 1.0, rtol=1e-5)
+
+
+def test_model_dsl_two_tower(rng):
+    """NCF-shaped graph: two embeddings, concat, MLP."""
+    from analytics_zoo_tpu.nn import Input, Model
+    from analytics_zoo_tpu.nn.layers.core import Dense, Flatten
+    from analytics_zoo_tpu.nn.layers.embedding import Embedding
+    from analytics_zoo_tpu.nn.layers.merge import merge
+
+    user = Input(shape=(1,), dtype=jnp.int32, name="user")
+    item = Input(shape=(1,), dtype=jnp.int32, name="item")
+    ue = Flatten()(Embedding(100, 8)(user))
+    ie = Flatten()(Embedding(50, 8)(item))
+    h = Dense(16, activation="relu")(merge([ue, ie], mode="concat"))
+    out = Dense(1, activation="sigmoid")(h)
+    model = Model([user, item], out)
+
+    params, state = model.init(rng)
+    u = jnp.asarray(np.random.randint(0, 100, (6, 1)))
+    i = jnp.asarray(np.random.randint(0, 50, (6, 1)))
+    y, _ = model.call(params, state, u, i)
+    assert y.shape == (6, 1)
+    assert ((np.asarray(y) > 0) & (np.asarray(y) < 1)).all()
+
+
+def test_variable_arithmetic(rng):
+    from analytics_zoo_tpu.nn import Input, Model, autograd
+
+    a = Input(shape=(4,))
+    b = Input(shape=(4,))
+    out = autograd.square(a) + b * 2.0 - 1.0
+    model = Model([a, b], out)
+    params, state = model.init(rng)
+    x1 = jnp.arange(4.0).reshape(1, 4)
+    x2 = jnp.ones((1, 4))
+    y, _ = model.call(params, state, x1, x2)
+    np.testing.assert_allclose(np.asarray(y),
+                               np.asarray(x1) ** 2 + 2.0 - 1.0)
+
+
+def test_shared_layer_builds_once(rng):
+    from analytics_zoo_tpu.nn import Input, Model
+    from analytics_zoo_tpu.nn.layers.core import Dense
+
+    shared = Dense(4)
+    a = Input(shape=(3,))
+    b = Input(shape=(3,))
+    out = shared(a) + shared(b)
+    model = Model([a, b], out)
+    params, state = model.init(rng)
+    assert len(params) == 1  # one entry for the shared layer
+    y, _ = model.call(params, state, jnp.ones((2, 3)), jnp.zeros((2, 3)))
+    assert y.shape == (2, 4)
+
+
+def test_parameter_variable(rng):
+    from analytics_zoo_tpu.nn import Input, Model, Parameter
+
+    x = Input(shape=(4,))
+    w = Parameter((4,), init="ones")
+    model = Model([x], x * w)
+    params, state = model.init(rng)
+    y, _ = model.call(params, state, jnp.full((2, 4), 3.0))
+    np.testing.assert_allclose(np.asarray(y), 3.0)
+
+
+def test_gradients_flow_through_model(rng):
+    from analytics_zoo_tpu.nn import Sequential
+    from analytics_zoo_tpu.nn.layers.core import Dense
+
+    model = Sequential([Dense(8, activation="tanh"), Dense(1)])
+    params, state = model.init(rng, (4, 3))
+
+    def loss(p, x, y):
+        pred, _ = model.call(p, state, x)
+        return jnp.mean((pred - y) ** 2)
+
+    g = jax.grad(loss)(params, jnp.ones((4, 3)), jnp.zeros((4, 1)))
+    norms = [float(jnp.abs(x).sum()) for x in jax.tree_util.tree_leaves(g)]
+    assert all(n > 0 for n in norms)

@@ -1,0 +1,130 @@
+"""Recurrent layer tests, incl. golden parity against handwritten numpy RNNs
+(the reference's KerasBaseSpec golden-test strategy, SURVEY.md §4.1 —
+here the golden is a straightforward numpy reimplementation)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def fresh_names():
+    from analytics_zoo_tpu.nn import reset_name_scope
+
+    reset_name_scope()
+
+
+def _hard_sigmoid(x):
+    return np.clip(0.2 * x + 0.5, 0.0, 1.0)
+
+
+def test_simple_rnn_matches_numpy(rng):
+    from analytics_zoo_tpu.nn.layers.recurrent import SimpleRNN
+
+    layer = SimpleRNN(4, return_sequences=True)
+    params, state = layer.init(rng, (2, 5, 3))
+    x = np.random.RandomState(0).randn(2, 5, 3).astype(np.float32)
+    y, _ = layer.call(params, state, jnp.asarray(x))
+
+    W = np.asarray(params["kernel"])
+    U = np.asarray(params["recurrent"])
+    b = np.asarray(params["bias"])
+    h = np.zeros((2, 4), np.float32)
+    for t in range(5):
+        h = np.tanh(x[:, t] @ W + h @ U + b)
+        np.testing.assert_allclose(np.asarray(y[:, t]), h, rtol=1e-5,
+                                   atol=1e-5)
+
+
+def test_lstm_matches_numpy(rng):
+    from analytics_zoo_tpu.nn.layers.recurrent import LSTM
+
+    layer = LSTM(4)
+    params, state = layer.init(rng, (2, 6, 3))
+    x = np.random.RandomState(1).randn(2, 6, 3).astype(np.float32)
+    y, _ = layer.call(params, state, jnp.asarray(x))
+
+    W = np.asarray(params["kernel"])
+    U = np.asarray(params["recurrent"])
+    b = np.asarray(params["bias"])
+    h = np.zeros((2, 4), np.float32)
+    c = np.zeros((2, 4), np.float32)
+    for t in range(6):
+        z = x[:, t] @ W + h @ U + b
+        i = _hard_sigmoid(z[:, :4])
+        f = _hard_sigmoid(z[:, 4:8])
+        g = np.tanh(z[:, 8:12])
+        o = _hard_sigmoid(z[:, 12:])
+        c = f * c + i * g
+        h = o * np.tanh(c)
+    np.testing.assert_allclose(np.asarray(y), h, rtol=1e-4, atol=1e-5)
+
+
+def test_gru_matches_numpy(rng):
+    from analytics_zoo_tpu.nn.layers.recurrent import GRU
+
+    layer = GRU(4)
+    params, state = layer.init(rng, (2, 5, 3))
+    x = np.random.RandomState(2).randn(2, 5, 3).astype(np.float32)
+    y, _ = layer.call(params, state, jnp.asarray(x))
+
+    W = np.asarray(params["kernel"])
+    U = np.asarray(params["recurrent"])
+    b = np.asarray(params["bias"])
+    h = np.zeros((2, 4), np.float32)
+    for t in range(5):
+        zx = x[:, t] @ W + b
+        z = _hard_sigmoid(zx[:, :4] + h @ U[:, :4])
+        r = _hard_sigmoid(zx[:, 4:8] + h @ U[:, 4:8])
+        hh = np.tanh(zx[:, 8:] + (r * h) @ U[:, 8:])
+        h = z * h + (1 - z) * hh
+    np.testing.assert_allclose(np.asarray(y), h, rtol=1e-4, atol=1e-5)
+
+
+def test_return_sequences_shapes(rng):
+    from analytics_zoo_tpu.nn.layers.recurrent import GRU, LSTM
+
+    for cls in (LSTM, GRU):
+        seq = cls(7, return_sequences=True)
+        p, s = seq.init(rng, (3, 5, 2))
+        y, _ = seq.call(p, s, jnp.ones((3, 5, 2)))
+        assert y.shape == (3, 5, 7)
+        last = cls(7)
+        p, s = last.init(rng, (3, 5, 2))
+        y, _ = last.call(p, s, jnp.ones((3, 5, 2)))
+        assert y.shape == (3, 7)
+
+
+def test_bidirectional_concat(rng):
+    from analytics_zoo_tpu.nn.layers.recurrent import Bidirectional, LSTM
+
+    layer = Bidirectional(LSTM(4, return_sequences=True))
+    params, state = layer.init(rng, (2, 5, 3))
+    y, _ = layer.call(params, state, jnp.ones((2, 5, 3)))
+    assert y.shape == (2, 5, 8)
+
+
+def test_time_distributed_dense(rng):
+    from analytics_zoo_tpu.nn.layers.core import Dense
+    from analytics_zoo_tpu.nn.layers.recurrent import TimeDistributed
+
+    layer = TimeDistributed(Dense(6))
+    params, state = layer.init(rng, (2, 4, 3))
+    y, _ = layer.call(params, state, jnp.ones((2, 4, 3)))
+    assert y.shape == (2, 4, 6)
+
+
+def test_lstm_gradients(rng):
+    from analytics_zoo_tpu.nn.layers.recurrent import LSTM
+
+    layer = LSTM(4)
+    params, state = layer.init(rng, (2, 5, 3))
+
+    def loss(p):
+        y, _ = layer.call(p, state, jnp.ones((2, 5, 3)))
+        return jnp.sum(y ** 2)
+
+    g = jax.grad(loss)(params)
+    for leaf in jax.tree_util.tree_leaves(g):
+        assert float(jnp.abs(leaf).sum()) > 0
