@@ -1,0 +1,145 @@
+"""Parametric / advanced activation layers and noise layers.
+
+Reference capability: api/keras/layers/{LeakyReLU,ELU,PReLU,SReLU,
+ThresholdedReLU,GaussianNoise,GaussianDropout,SpatialDropout1D/2D/3D}.scala.
+All elementwise — XLA fuses them into neighbouring ops for free.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.nn.module import StatelessLayer
+
+
+class LeakyReLU(StatelessLayer):
+    def __init__(self, alpha: float = 0.3, **kw):
+        super().__init__(**kw)
+        self.alpha = alpha
+
+    def forward(self, params, x, training=False, rng=None):
+        return jnp.where(x >= 0, x, self.alpha * x)
+
+
+class ELU(StatelessLayer):
+    def __init__(self, alpha: float = 1.0, **kw):
+        super().__init__(**kw)
+        self.alpha = alpha
+
+    def forward(self, params, x, training=False, rng=None):
+        return jnp.where(x >= 0, x, self.alpha * (jnp.exp(x) - 1.0))
+
+
+class ThresholdedReLU(StatelessLayer):
+    def __init__(self, theta: float = 1.0, **kw):
+        super().__init__(**kw)
+        self.theta = theta
+
+    def forward(self, params, x, training=False, rng=None):
+        return jnp.where(x > self.theta, x, 0.0)
+
+
+class PReLU(StatelessLayer):
+    """ReLU with a learned per-channel negative slope
+    (reference api/keras/layers/PReLU.scala)."""
+
+    def build_params(self, rng, input_shape):
+        return {"alpha": jnp.zeros(input_shape[1:], jnp.float32)}
+
+    def forward(self, params, x, training=False, rng=None):
+        return jnp.where(x >= 0, x, params["alpha"] * x)
+
+
+class SReLU(StatelessLayer):
+    """S-shaped ReLU with four learned per-element tensors
+    (reference api/keras/layers/SReLU.scala; Jin et al. 2016):
+
+        y = t_r + a_r (x - t_r)   if x >= t_r
+        y = x                     if t_l < x < t_r
+        y = t_l + a_l (x - t_l)   if x <= t_l
+    """
+
+    def build_params(self, rng, input_shape):
+        shape = tuple(input_shape[1:])
+        return {
+            "t_left": jnp.zeros(shape, jnp.float32),
+            "a_left": jnp.zeros(shape, jnp.float32),
+            "t_right": jnp.ones(shape, jnp.float32),
+            "a_right": jnp.ones(shape, jnp.float32),
+        }
+
+    def forward(self, params, x, training=False, rng=None):
+        tl, al = params["t_left"], params["a_left"]
+        tr, ar = params["t_right"], params["a_right"]
+        y_right = tr + ar * (x - tr)
+        y_left = tl + al * (x - tl)
+        return jnp.where(x >= tr, y_right, jnp.where(x <= tl, y_left, x))
+
+
+class GaussianNoise(StatelessLayer):
+    """Additive zero-mean Gaussian noise at train time
+    (reference api/keras/layers/GaussianNoise.scala)."""
+
+    def __init__(self, sigma: float, **kw):
+        super().__init__(**kw)
+        self.sigma = sigma
+
+    def forward(self, params, x, training=False, rng=None):
+        if not training:
+            return x
+        if rng is None:
+            raise ValueError(f"GaussianNoise {self.name} needs rng in training")
+        return x + self.sigma * jax.random.normal(rng, x.shape, x.dtype)
+
+
+class GaussianDropout(StatelessLayer):
+    """Multiplicative 1-mean Gaussian noise
+    (reference api/keras/layers/GaussianDropout.scala)."""
+
+    def __init__(self, p: float, **kw):
+        super().__init__(**kw)
+        self.rate = p
+
+    def forward(self, params, x, training=False, rng=None):
+        if not training or self.rate <= 0:
+            return x
+        if rng is None:
+            raise ValueError(f"GaussianDropout {self.name} needs rng in training")
+        stddev = (self.rate / (1.0 - self.rate)) ** 0.5
+        return x * (1.0 + stddev * jax.random.normal(rng, x.shape, x.dtype))
+
+
+class SpatialDropoutND(StatelessLayer):
+    """Drop entire feature maps (channels-last interior)."""
+
+    spatial = 2
+
+    def __init__(self, p: float = 0.5, dim_ordering: str = "tf", **kw):
+        super().__init__(**kw)
+        self.rate = p
+        self.dim_ordering = dim_ordering
+
+    def forward(self, params, x, training=False, rng=None):
+        if not training or self.rate <= 0:
+            return x
+        if rng is None:
+            raise ValueError(f"{type(self).__name__} {self.name} needs rng")
+        keep = 1.0 - self.rate
+        ch_axis = 1 if self.dim_ordering == "th" else x.ndim - 1
+        shape = [x.shape[0]] + [1] * (x.ndim - 1)
+        shape[ch_axis] = x.shape[ch_axis]
+        mask = jax.random.bernoulli(rng, keep, tuple(shape))
+        return jnp.where(mask, x / keep, 0.0)
+
+
+class SpatialDropout1D(SpatialDropoutND):
+    spatial = 1
+
+
+class SpatialDropout2D(SpatialDropoutND):
+    spatial = 2
+
+
+class SpatialDropout3D(SpatialDropoutND):
+    spatial = 3

@@ -1,0 +1,495 @@
+"""Convolution layers: Conv1D/2D/3D, atrous, separable, transposed, locally
+connected, padding/cropping/upsampling.
+
+Reference capability: api/keras/layers/{Convolution1D,Convolution2D,
+Convolution3D,AtrousConvolution1D,AtrousConvolution2D,SeparableConvolution2D,
+Deconvolution2D,LocallyConnected1D,LocallyConnected2D,Cropping*,ZeroPadding*,
+UpSampling*}.scala (SURVEY.md §2.1 Keras-style API).
+
+TPU-first design: the native data layout is **channels-last** (NWC/NHWC/NDHWC)
+— the layout XLA tiles onto the MXU without relayout copies — and every conv
+is a single ``lax.conv_general_dilated`` call so XLA fuses bias+activation
+into the convolution epilogue.  The reference's BigDL layers default to
+channels-first ("th"); here ``dim_ordering="th"`` is accepted for API parity
+and handled by transposing at the layer boundary (the interior always runs
+channels-last).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from analytics_zoo_tpu.nn import activations, initializers
+from analytics_zoo_tpu.nn.module import StatelessLayer
+
+IntOrPair = Union[int, Sequence[int]]
+
+
+def _tuple(v: IntOrPair, n: int) -> Tuple[int, ...]:
+    if isinstance(v, int):
+        return (v,) * n
+    t = tuple(v)
+    assert len(t) == n, f"expected {n} values, got {t}"
+    return t
+
+
+def _to_channels_last(x, dim_ordering: str, spatial: int):
+    """(B, C, *S) -> (B, *S, C) when dim_ordering='th'."""
+    if dim_ordering != "th":
+        return x
+    perm = (0,) + tuple(range(2, 2 + spatial)) + (1,)
+    return jnp.transpose(x, perm)
+
+
+def _from_channels_last(x, dim_ordering: str, spatial: int):
+    if dim_ordering != "th":
+        return x
+    perm = (0, 1 + spatial) + tuple(range(1, 1 + spatial))
+    return jnp.transpose(x, perm)
+
+
+def _dim_numbers(spatial: int):
+    s = "".join("DHW"[-spatial:])
+    return (f"N{s}C", f"{s}IO", f"N{s}C")
+
+
+class ConvND(StatelessLayer):
+    """Shared N-dimensional convolution machinery (channels-last interior)."""
+
+    spatial: int = 2
+
+    def __init__(self, nb_filter: int, kernel_size: Sequence[int],
+                 activation=None, border_mode: str = "valid",
+                 subsample: IntOrPair = 1, dilation: IntOrPair = 1,
+                 init="glorot_uniform", bias: bool = True,
+                 dim_ordering: str = "tf", w_regularizer=None,
+                 b_regularizer=None, dtype=jnp.float32, **kw):
+        super().__init__(**kw)
+        self.nb_filter = nb_filter
+        self.kernel_size = _tuple(kernel_size, self.spatial)
+        self.activation = activations.get(activation)
+        if border_mode not in ("valid", "same"):
+            raise ValueError(f"border_mode must be valid|same, got {border_mode}")
+        self.border_mode = border_mode.upper()
+        self.strides = _tuple(subsample, self.spatial)
+        self.dilation = _tuple(dilation, self.spatial)
+        self.initializer = initializers.get(init)
+        self.use_bias = bias
+        self.dim_ordering = dim_ordering
+        self.dtype = dtype
+        self.w_regularizer = w_regularizer
+        self.b_regularizer = b_regularizer
+
+    def _in_channels(self, input_shape) -> int:
+        return (input_shape[1] if self.dim_ordering == "th"
+                else input_shape[-1])
+
+    def build_params(self, rng, input_shape):
+        in_ch = self._in_channels(input_shape)
+        params = {"kernel": self.initializer(
+            rng, self.kernel_size + (in_ch, self.nb_filter), self.dtype)}
+        if self.use_bias:
+            params["bias"] = jnp.zeros((self.nb_filter,), self.dtype)
+        return params
+
+    def _convolve(self, params, x):
+        return lax.conv_general_dilated(
+            x, params["kernel"], window_strides=self.strides,
+            padding=self.border_mode, rhs_dilation=self.dilation,
+            dimension_numbers=_dim_numbers(self.spatial))
+
+    def forward(self, params, x, training=False, rng=None):
+        x = _to_channels_last(x, self.dim_ordering, self.spatial)
+        y = self._convolve(params, x)
+        if self.use_bias:
+            y = y + params["bias"]
+        if self.activation is not None:
+            y = self.activation(y)
+        return _from_channels_last(y, self.dim_ordering, self.spatial)
+
+    def regularization_loss(self, params):
+        loss = 0.0
+        if self.w_regularizer is not None:
+            loss = loss + self.w_regularizer(params["kernel"])
+        if self.b_regularizer is not None and self.use_bias:
+            loss = loss + self.b_regularizer(params["bias"])
+        return loss
+
+
+class Convolution1D(ConvND):
+    """1D convolution over (B, L, C).  Reference: Convolution1D.scala."""
+
+    spatial = 1
+
+    def __init__(self, nb_filter: int, filter_length: int, **kw):
+        super().__init__(nb_filter, (filter_length,), **kw)
+
+
+class Convolution2D(ConvND):
+    """2D convolution.  Reference: Convolution2D.scala."""
+
+    spatial = 2
+
+    def __init__(self, nb_filter: int, nb_row: int, nb_col: int, **kw):
+        super().__init__(nb_filter, (nb_row, nb_col), **kw)
+
+
+class Convolution3D(ConvND):
+    """3D convolution.  Reference: Convolution3D.scala."""
+
+    spatial = 3
+
+    def __init__(self, nb_filter: int, kernel_dim1: int, kernel_dim2: int,
+                 kernel_dim3: int, **kw):
+        super().__init__(nb_filter, (kernel_dim1, kernel_dim2, kernel_dim3),
+                         **kw)
+
+
+class AtrousConvolution1D(Convolution1D):
+    """Dilated 1D conv.  Reference: AtrousConvolution1D.scala."""
+
+    def __init__(self, nb_filter: int, filter_length: int,
+                 atrous_rate: int = 1, **kw):
+        kw.setdefault("dilation", atrous_rate)
+        super().__init__(nb_filter, filter_length, **kw)
+
+
+class AtrousConvolution2D(Convolution2D):
+    """Dilated 2D conv.  Reference: AtrousConvolution2D.scala."""
+
+    def __init__(self, nb_filter: int, nb_row: int, nb_col: int,
+                 atrous_rate: IntOrPair = (1, 1), **kw):
+        kw.setdefault("dilation", atrous_rate)
+        super().__init__(nb_filter, nb_row, nb_col, **kw)
+
+
+class SeparableConvolution2D(StatelessLayer):
+    """Depthwise conv followed by 1x1 pointwise conv.
+
+    Reference: SeparableConvolution2D.scala.  The depthwise stage uses
+    ``feature_group_count`` so XLA emits one grouped conv.
+    """
+
+    def __init__(self, nb_filter: int, nb_row: int, nb_col: int,
+                 activation=None, border_mode: str = "valid",
+                 subsample: IntOrPair = (1, 1), depth_multiplier: int = 1,
+                 init="glorot_uniform", bias: bool = True,
+                 dim_ordering: str = "tf", dtype=jnp.float32, **kw):
+        super().__init__(**kw)
+        self.nb_filter = nb_filter
+        self.kernel_size = (nb_row, nb_col)
+        self.activation = activations.get(activation)
+        self.border_mode = border_mode.upper()
+        self.strides = _tuple(subsample, 2)
+        self.depth_multiplier = depth_multiplier
+        self.initializer = initializers.get(init)
+        self.use_bias = bias
+        self.dim_ordering = dim_ordering
+        self.dtype = dtype
+
+    def build_params(self, rng, input_shape):
+        in_ch = (input_shape[1] if self.dim_ordering == "th"
+                 else input_shape[-1])
+        self.in_ch = in_ch
+        k1, k2 = jax.random.split(rng)
+        params = {
+            "depthwise": self.initializer(
+                k1, self.kernel_size + (1, in_ch * self.depth_multiplier),
+                self.dtype),
+            "pointwise": self.initializer(
+                k2, (1, 1, in_ch * self.depth_multiplier, self.nb_filter),
+                self.dtype),
+        }
+        if self.use_bias:
+            params["bias"] = jnp.zeros((self.nb_filter,), self.dtype)
+        return params
+
+    def forward(self, params, x, training=False, rng=None):
+        x = _to_channels_last(x, self.dim_ordering, 2)
+        in_ch = x.shape[-1]
+        dn = _dim_numbers(2)
+        y = lax.conv_general_dilated(
+            x, params["depthwise"], window_strides=self.strides,
+            padding=self.border_mode, dimension_numbers=dn,
+            feature_group_count=in_ch)
+        y = lax.conv_general_dilated(
+            y, params["pointwise"], window_strides=(1, 1),
+            padding="VALID", dimension_numbers=dn)
+        if self.use_bias:
+            y = y + params["bias"]
+        if self.activation is not None:
+            y = self.activation(y)
+        return _from_channels_last(y, self.dim_ordering, 2)
+
+
+class Deconvolution2D(StatelessLayer):
+    """Transposed 2D convolution.  Reference: Deconvolution2D.scala."""
+
+    def __init__(self, nb_filter: int, nb_row: int, nb_col: int,
+                 activation=None, subsample: IntOrPair = (1, 1),
+                 border_mode: str = "valid", init="glorot_uniform",
+                 bias: bool = True, dim_ordering: str = "tf",
+                 dtype=jnp.float32, **kw):
+        super().__init__(**kw)
+        self.nb_filter = nb_filter
+        self.kernel_size = (nb_row, nb_col)
+        self.activation = activations.get(activation)
+        self.strides = _tuple(subsample, 2)
+        self.border_mode = border_mode.upper()
+        self.initializer = initializers.get(init)
+        self.use_bias = bias
+        self.dim_ordering = dim_ordering
+        self.dtype = dtype
+
+    def build_params(self, rng, input_shape):
+        in_ch = (input_shape[1] if self.dim_ordering == "th"
+                 else input_shape[-1])
+        params = {"kernel": self.initializer(
+            rng, self.kernel_size + (in_ch, self.nb_filter), self.dtype)}
+        if self.use_bias:
+            params["bias"] = jnp.zeros((self.nb_filter,), self.dtype)
+        return params
+
+    def forward(self, params, x, training=False, rng=None):
+        x = _to_channels_last(x, self.dim_ordering, 2)
+        y = lax.conv_transpose(
+            x, params["kernel"], strides=self.strides,
+            padding=self.border_mode, dimension_numbers=_dim_numbers(2))
+        if self.use_bias:
+            y = y + params["bias"]
+        if self.activation is not None:
+            y = self.activation(y)
+        return _from_channels_last(y, self.dim_ordering, 2)
+
+
+class LocallyConnected1D(StatelessLayer):
+    """Conv1D with *unshared* weights per output position.
+
+    Reference: LocallyConnected1D.scala.  Implemented as a patch-extract +
+    batched matmul (one einsum → MXU) rather than a per-position loop.
+    """
+
+    def __init__(self, nb_filter: int, filter_length: int, activation=None,
+                 subsample_length: int = 1, border_mode: str = "valid",
+                 init="glorot_uniform", bias: bool = True,
+                 dtype=jnp.float32, **kw):
+        super().__init__(**kw)
+        if border_mode != "valid":
+            raise ValueError("LocallyConnected1D supports only border_mode='valid'")
+        self.nb_filter = nb_filter
+        self.filter_length = filter_length
+        self.activation = activations.get(activation)
+        self.stride = subsample_length
+        self.initializer = initializers.get(init)
+        self.use_bias = bias
+        self.dtype = dtype
+
+    def _out_len(self, length: int) -> int:
+        return (length - self.filter_length) // self.stride + 1
+
+    def build_params(self, rng, input_shape):
+        _, length, in_ch = input_shape
+        out_len = self._out_len(length)
+        params = {"kernel": self.initializer(
+            rng, (out_len, self.filter_length * in_ch, self.nb_filter),
+            self.dtype)}
+        if self.use_bias:
+            params["bias"] = jnp.zeros((out_len, self.nb_filter), self.dtype)
+        return params
+
+    def forward(self, params, x, training=False, rng=None):
+        b, length, in_ch = x.shape
+        out_len = self._out_len(length)
+        idx = (jnp.arange(out_len)[:, None] * self.stride
+               + jnp.arange(self.filter_length)[None, :])
+        patches = x[:, idx, :].reshape(b, out_len, -1)     # (B, O, K*C)
+        y = jnp.einsum("bok,okf->bof", patches, params["kernel"])
+        if self.use_bias:
+            y = y + params["bias"]
+        if self.activation is not None:
+            y = self.activation(y)
+        return y
+
+
+class LocallyConnected2D(StatelessLayer):
+    """Conv2D with unshared weights.  Reference: LocallyConnected2D.scala."""
+
+    def __init__(self, nb_filter: int, nb_row: int, nb_col: int,
+                 activation=None, subsample: IntOrPair = (1, 1),
+                 border_mode: str = "valid", init="glorot_uniform",
+                 bias: bool = True, dim_ordering: str = "tf",
+                 dtype=jnp.float32, **kw):
+        super().__init__(**kw)
+        if border_mode != "valid":
+            raise ValueError("LocallyConnected2D supports only border_mode='valid'")
+        self.nb_filter = nb_filter
+        self.kernel_size = (nb_row, nb_col)
+        self.activation = activations.get(activation)
+        self.strides = _tuple(subsample, 2)
+        self.initializer = initializers.get(init)
+        self.use_bias = bias
+        self.dim_ordering = dim_ordering
+        self.dtype = dtype
+
+    def _out_hw(self, h: int, w: int) -> Tuple[int, int]:
+        kh, kw_ = self.kernel_size
+        sh, sw = self.strides
+        return (h - kh) // sh + 1, (w - kw_) // sw + 1
+
+    def build_params(self, rng, input_shape):
+        if self.dim_ordering == "th":
+            _, in_ch, h, w = input_shape
+        else:
+            _, h, w, in_ch = input_shape
+        oh, ow = self._out_hw(h, w)
+        kh, kw_ = self.kernel_size
+        params = {"kernel": self.initializer(
+            rng, (oh * ow, kh * kw_ * in_ch, self.nb_filter), self.dtype)}
+        if self.use_bias:
+            params["bias"] = jnp.zeros((oh, ow, self.nb_filter), self.dtype)
+        return params
+
+    def forward(self, params, x, training=False, rng=None):
+        x = _to_channels_last(x, self.dim_ordering, 2)
+        b, h, w, c = x.shape
+        kh, kw_ = self.kernel_size
+        sh, sw = self.strides
+        oh, ow = self._out_hw(h, w)
+        ridx = jnp.arange(oh)[:, None] * sh + jnp.arange(kh)[None, :]
+        cidx = jnp.arange(ow)[:, None] * sw + jnp.arange(kw_)[None, :]
+        # (B, oh, kh, W, C) -> (B, oh, kh, ow, kw, C)
+        patches = x[:, ridx, :, :][:, :, :, cidx, :]
+        patches = jnp.transpose(patches, (0, 1, 3, 2, 4, 5)).reshape(
+            b, oh * ow, kh * kw_ * c)
+        y = jnp.einsum("bok,okf->bof", patches, params["kernel"]).reshape(
+            b, oh, ow, self.nb_filter)
+        if self.use_bias:
+            y = y + params["bias"]
+        if self.activation is not None:
+            y = self.activation(y)
+        return _from_channels_last(y, self.dim_ordering, 2)
+
+
+class ZeroPaddingND(StatelessLayer):
+    spatial = 2
+
+    def __init__(self, padding, dim_ordering: str = "tf", **kw):
+        super().__init__(**kw)
+        self.padding = padding
+        self.dim_ordering = dim_ordering
+
+    def forward(self, params, x, training=False, rng=None):
+        x = _to_channels_last(x, self.dim_ordering, self.spatial)
+        pad = [(0, 0)] + [(p, p) if isinstance(p, int) else tuple(p)
+                          for p in self.padding] + [(0, 0)]
+        y = jnp.pad(x, pad)
+        return _from_channels_last(y, self.dim_ordering, self.spatial)
+
+
+class ZeroPadding1D(ZeroPaddingND):
+    spatial = 1
+
+    def __init__(self, padding: int = 1, **kw):
+        super().__init__([padding], **kw)
+
+
+class ZeroPadding2D(ZeroPaddingND):
+    spatial = 2
+
+    def __init__(self, padding: IntOrPair = (1, 1), **kw):
+        p = _tuple(padding, 2)
+        super().__init__(list(p), **kw)
+
+
+class ZeroPadding3D(ZeroPaddingND):
+    spatial = 3
+
+    def __init__(self, padding: IntOrPair = (1, 1, 1), **kw):
+        p = _tuple(padding, 3)
+        super().__init__(list(p), **kw)
+
+
+class CroppingND(StatelessLayer):
+    spatial = 2
+
+    def __init__(self, cropping, dim_ordering: str = "tf", **kw):
+        super().__init__(**kw)
+        self.cropping = cropping
+        self.dim_ordering = dim_ordering
+
+    def forward(self, params, x, training=False, rng=None):
+        x = _to_channels_last(x, self.dim_ordering, self.spatial)
+        idx = [slice(None)]
+        for (lo, hi) in self.cropping:
+            idx.append(slice(lo, x.shape[len(idx)] - hi or None))
+        idx.append(slice(None))
+        y = x[tuple(idx)]
+        return _from_channels_last(y, self.dim_ordering, self.spatial)
+
+
+class Cropping1D(CroppingND):
+    spatial = 1
+
+    def __init__(self, cropping=(1, 1), **kw):
+        super().__init__([tuple(cropping)], **kw)
+
+
+class Cropping2D(CroppingND):
+    spatial = 2
+
+    def __init__(self, heightCrop=(0, 0), widthCrop=(0, 0), **kw):
+        super().__init__([tuple(heightCrop), tuple(widthCrop)], **kw)
+
+
+class Cropping3D(CroppingND):
+    spatial = 3
+
+    def __init__(self, dim1Crop=(1, 1), dim2Crop=(1, 1), dim3Crop=(1, 1), **kw):
+        super().__init__([tuple(dim1Crop), tuple(dim2Crop), tuple(dim3Crop)],
+                         **kw)
+
+
+class UpSamplingND(StatelessLayer):
+    spatial = 2
+
+    def __init__(self, size, dim_ordering: str = "tf", **kw):
+        super().__init__(**kw)
+        self.size = size
+        self.dim_ordering = dim_ordering
+
+    def forward(self, params, x, training=False, rng=None):
+        x = _to_channels_last(x, self.dim_ordering, self.spatial)
+        for axis, s in enumerate(self.size, start=1):
+            x = jnp.repeat(x, s, axis=axis)
+        return _from_channels_last(x, self.dim_ordering, self.spatial)
+
+
+class UpSampling1D(UpSamplingND):
+    spatial = 1
+
+    def __init__(self, length: int = 2, **kw):
+        super().__init__((length,), **kw)
+
+
+class UpSampling2D(UpSamplingND):
+    spatial = 2
+
+    def __init__(self, size: IntOrPair = (2, 2), **kw):
+        super().__init__(_tuple(size, 2), **kw)
+
+
+class UpSampling3D(UpSamplingND):
+    spatial = 3
+
+    def __init__(self, size: IntOrPair = (2, 2, 2), **kw):
+        super().__init__(_tuple(size, 3), **kw)
+
+
+# Keras-2 style aliases (reference keras2 package exposes Conv1D/Conv2D names)
+Conv1D = Convolution1D
+Conv2D = Convolution2D
+Conv3D = Convolution3D

@@ -1,0 +1,142 @@
+"""Pooling layers: max/average, local and global, 1D/2D/3D.
+
+Reference capability: api/keras/layers/{MaxPooling1D,MaxPooling2D,
+MaxPooling3D,AveragePooling*,GlobalMaxPooling*,GlobalAveragePooling*}.scala.
+
+TPU-first: local pools are single ``lax.reduce_window`` calls (XLA lowers
+these to fused vector-unit reductions); global pools are plain axis
+reductions.  Channels-last interior, ``dim_ordering="th"`` handled at the
+boundary as in convolutional.py.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple, Union
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from analytics_zoo_tpu.nn.layers.convolutional import (
+    _from_channels_last, _to_channels_last, _tuple)
+from analytics_zoo_tpu.nn.module import StatelessLayer
+
+IntOrPair = Union[int, Sequence[int]]
+
+
+class PoolND(StatelessLayer):
+    spatial = 2
+    mode = "max"  # or "avg"
+
+    def __init__(self, pool_size, strides=None, border_mode: str = "valid",
+                 dim_ordering: str = "tf", **kw):
+        super().__init__(**kw)
+        self.pool_size = _tuple(pool_size, self.spatial)
+        self.strides = (_tuple(strides, self.spatial) if strides is not None
+                        else self.pool_size)
+        if border_mode not in ("valid", "same"):
+            raise ValueError(f"border_mode must be valid|same, got {border_mode}")
+        self.border_mode = border_mode.upper()
+        self.dim_ordering = dim_ordering
+
+    def forward(self, params, x, training=False, rng=None):
+        x = _to_channels_last(x, self.dim_ordering, self.spatial)
+        window = (1,) + self.pool_size + (1,)
+        strides = (1,) + self.strides + (1,)
+        if self.mode == "max":
+            y = lax.reduce_window(x, -jnp.inf, lax.max, window, strides,
+                                  self.border_mode)
+        else:
+            summed = lax.reduce_window(x, 0.0, lax.add, window, strides,
+                                       self.border_mode)
+            if self.border_mode == "VALID":
+                y = summed / float(np.prod(self.pool_size))
+            else:
+                # SAME: divide by the actual window size at each position
+                counts = lax.reduce_window(jnp.ones_like(x), 0.0, lax.add,
+                                           window, strides, self.border_mode)
+                y = summed / counts
+        return _from_channels_last(y, self.dim_ordering, self.spatial)
+
+
+class MaxPooling1D(PoolND):
+    spatial, mode = 1, "max"
+
+    def __init__(self, pool_length: int = 2, stride=None, **kw):
+        super().__init__((pool_length,),
+                         (stride,) if stride is not None else None, **kw)
+
+
+class MaxPooling2D(PoolND):
+    spatial, mode = 2, "max"
+
+    def __init__(self, pool_size: IntOrPair = (2, 2), strides=None, **kw):
+        super().__init__(pool_size, strides, **kw)
+
+
+class MaxPooling3D(PoolND):
+    spatial, mode = 3, "max"
+
+    def __init__(self, pool_size: IntOrPair = (2, 2, 2), strides=None, **kw):
+        super().__init__(pool_size, strides, **kw)
+
+
+class AveragePooling1D(PoolND):
+    spatial, mode = 1, "avg"
+
+    def __init__(self, pool_length: int = 2, stride=None, **kw):
+        super().__init__((pool_length,),
+                         (stride,) if stride is not None else None, **kw)
+
+
+class AveragePooling2D(PoolND):
+    spatial, mode = 2, "avg"
+
+    def __init__(self, pool_size: IntOrPair = (2, 2), strides=None, **kw):
+        super().__init__(pool_size, strides, **kw)
+
+
+class AveragePooling3D(PoolND):
+    spatial, mode = 3, "avg"
+
+    def __init__(self, pool_size: IntOrPair = (2, 2, 2), strides=None, **kw):
+        super().__init__(pool_size, strides, **kw)
+
+
+class GlobalPoolND(StatelessLayer):
+    spatial = 2
+    mode = "max"
+
+    def __init__(self, dim_ordering: str = "tf", **kw):
+        super().__init__(**kw)
+        self.dim_ordering = dim_ordering
+
+    def forward(self, params, x, training=False, rng=None):
+        x = _to_channels_last(x, self.dim_ordering, self.spatial)
+        axes = tuple(range(1, 1 + self.spatial))
+        return (jnp.max(x, axis=axes) if self.mode == "max"
+                else jnp.mean(x, axis=axes))
+
+
+class GlobalMaxPooling1D(GlobalPoolND):
+    spatial, mode = 1, "max"
+
+
+class GlobalMaxPooling2D(GlobalPoolND):
+    spatial, mode = 2, "max"
+
+
+class GlobalMaxPooling3D(GlobalPoolND):
+    spatial, mode = 3, "max"
+
+
+class GlobalAveragePooling1D(GlobalPoolND):
+    spatial, mode = 1, "avg"
+
+
+class GlobalAveragePooling2D(GlobalPoolND):
+    spatial, mode = 2, "avg"
+
+
+class GlobalAveragePooling3D(GlobalPoolND):
+    spatial, mode = 3, "avg"

@@ -1,0 +1,303 @@
+"""Conv / pooling / normalization / advanced-activation layer tests.
+
+Mirrors the reference's golden-parity strategy (SURVEY.md §4.1): numerics
+are checked against hand-computed values or closed forms; every layer gets
+shape + grad coverage.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.nn.layers.advanced_activations import (
+    ELU, GaussianDropout, GaussianNoise, LeakyReLU, PReLU, SReLU,
+    SpatialDropout2D, ThresholdedReLU)
+from analytics_zoo_tpu.nn.layers.convolutional import (
+    AtrousConvolution2D, Convolution1D, Convolution2D, Convolution3D,
+    Cropping2D, Deconvolution2D, LocallyConnected1D, LocallyConnected2D,
+    SeparableConvolution2D, UpSampling2D, ZeroPadding2D)
+from analytics_zoo_tpu.nn.layers.normalization import (
+    LRN2D, BatchNormalization, LayerNorm, WithinChannelLRN2D)
+from analytics_zoo_tpu.nn.layers.pooling import (
+    AveragePooling2D, GlobalAveragePooling2D, GlobalMaxPooling1D,
+    MaxPooling2D)
+
+KEY = jax.random.PRNGKey(0)
+
+
+def _init_call(layer, x, training=False, rng=None):
+    params, state = layer.init(KEY, x.shape)
+    out, _ = layer.call(params, state, jnp.asarray(x), training=training,
+                        rng=rng)
+    return params, np.asarray(out)
+
+
+class TestConv:
+    def test_conv2d_identity_kernel(self):
+        """A 1x1 kernel of ones with one input channel = identity."""
+        layer = Convolution2D(1, 1, 1, init="one", bias=False)
+        x = np.random.RandomState(0).randn(2, 5, 5, 1).astype(np.float32)
+        _, out = _init_call(layer, x)
+        np.testing.assert_allclose(out, x, rtol=1e-6)
+
+    def test_conv2d_known_sum(self):
+        """3x3 all-ones kernel over all-ones input, valid: every output = 9."""
+        layer = Convolution2D(1, 3, 3, init="one", bias=False)
+        x = np.ones((1, 5, 5, 1), np.float32)
+        _, out = _init_call(layer, x)
+        assert out.shape == (1, 3, 3, 1)
+        np.testing.assert_allclose(out, 9.0)
+
+    def test_conv2d_same_stride2(self):
+        layer = Convolution2D(4, 3, 3, border_mode="same", subsample=(2, 2))
+        x = np.random.randn(2, 8, 8, 3).astype(np.float32)
+        _, out = _init_call(layer, x)
+        assert out.shape == (2, 4, 4, 4)
+
+    def test_conv2d_channels_first(self):
+        """dim_ordering='th' matches transposed channels-last result."""
+        rs = np.random.RandomState(1)
+        x = rs.randn(2, 3, 6, 6).astype(np.float32)
+        th = Convolution2D(5, 3, 3, dim_ordering="th")
+        params, state = th.init(KEY, x.shape)
+        out_th, _ = th.call(params, state, jnp.asarray(x))
+        tf_ = Convolution2D(5, 3, 3)
+        xl = np.transpose(x, (0, 2, 3, 1))
+        out_tf, _ = tf_.call(params, state, jnp.asarray(xl))
+        np.testing.assert_allclose(
+            np.asarray(out_th), np.transpose(np.asarray(out_tf), (0, 3, 1, 2)),
+            rtol=1e-5, atol=1e-5)
+
+    def test_conv1d_and_3d_shapes(self):
+        c1 = Convolution1D(8, 3)
+        _, out = _init_call(c1, np.random.randn(2, 10, 4).astype(np.float32))
+        assert out.shape == (2, 8, 8)
+        c3 = Convolution3D(2, 2, 2, 2)
+        _, out = _init_call(
+            c3, np.random.randn(1, 4, 4, 4, 3).astype(np.float32))
+        assert out.shape == (1, 3, 3, 3, 2)
+
+    def test_atrous_dilation_shape(self):
+        layer = AtrousConvolution2D(2, 3, 3, atrous_rate=(2, 2))
+        _, out = _init_call(
+            layer, np.random.randn(1, 9, 9, 1).astype(np.float32))
+        # effective kernel 5 -> 9-5+1 = 5
+        assert out.shape == (1, 5, 5, 2)
+
+    def test_separable_equals_depthwise_then_pointwise(self):
+        layer = SeparableConvolution2D(6, 3, 3)
+        x = np.random.randn(2, 8, 8, 4).astype(np.float32)
+        _, out = _init_call(layer, x)
+        assert out.shape == (2, 6, 6, 6)
+
+    def test_deconv_upsamples(self):
+        layer = Deconvolution2D(3, 2, 2, subsample=(2, 2))
+        x = np.random.randn(1, 4, 4, 2).astype(np.float32)
+        _, out = _init_call(layer, x)
+        assert out.shape == (1, 8, 8, 3)
+
+    def test_locally_connected_1d_unshared(self):
+        layer = LocallyConnected1D(2, 3)
+        x = np.random.randn(2, 7, 4).astype(np.float32)
+        params, out = _init_call(layer, x)
+        assert out.shape == (2, 5, 2)
+        assert params["kernel"].shape == (5, 12, 2)  # per-position weights
+
+    def test_locally_connected_2d_matches_conv_when_weights_tied(self):
+        """With identical weights at every position, LC2D == Conv2D."""
+        rs = np.random.RandomState(2)
+        x = rs.randn(1, 5, 5, 2).astype(np.float32)
+        lc = LocallyConnected2D(3, 3, 3, bias=False)
+        params, state = lc.init(KEY, x.shape)
+        k = np.asarray(params["kernel"])
+        k_tied = np.broadcast_to(k[:1], k.shape).copy()
+        out_lc, _ = lc.call({"kernel": jnp.asarray(k_tied)}, state,
+                            jnp.asarray(x))
+        conv = Convolution2D(3, 3, 3, bias=False)
+        # conv kernel layout (kh, kw, in, out) from LC row-major (kh*kw*in, out)
+        ck = k_tied[0].reshape(3, 3, 2, 3)
+        out_conv, _ = conv.call({"kernel": jnp.asarray(ck)}, {},
+                                jnp.asarray(x))
+        np.testing.assert_allclose(np.asarray(out_lc), np.asarray(out_conv),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_pad_crop_upsample(self):
+        x = np.random.randn(1, 4, 4, 2).astype(np.float32)
+        _, out = _init_call(ZeroPadding2D((1, 2)), x)
+        assert out.shape == (1, 6, 8, 2)
+        _, out = _init_call(Cropping2D((1, 1), (0, 2)), x)
+        assert out.shape == (1, 2, 2, 2)
+        _, out = _init_call(UpSampling2D((2, 3)), x)
+        assert out.shape == (1, 8, 12, 2)
+        np.testing.assert_allclose(out[0, 0, 0], x[0, 0, 0])
+        np.testing.assert_allclose(out[0, 1, 2], x[0, 0, 0])
+
+    def test_conv_grads_flow(self):
+        layer = Convolution2D(2, 3, 3, activation="relu")
+        x = jnp.asarray(np.random.randn(2, 6, 6, 1).astype(np.float32))
+        params, state = layer.init(KEY, x.shape)
+
+        def loss(p):
+            out, _ = layer.call(p, state, x)
+            return jnp.sum(out ** 2)
+
+        grads = jax.grad(loss)(params)
+        assert np.isfinite(np.asarray(grads["kernel"])).all()
+        assert float(jnp.abs(grads["kernel"]).sum()) > 0
+
+
+class TestPooling:
+    def test_max_pool_known(self):
+        x = np.arange(16, dtype=np.float32).reshape(1, 4, 4, 1)
+        _, out = _init_call(MaxPooling2D((2, 2)), x)
+        np.testing.assert_allclose(out[0, :, :, 0],
+                                   [[5, 7], [13, 15]])
+
+    def test_avg_pool_known(self):
+        x = np.arange(16, dtype=np.float32).reshape(1, 4, 4, 1)
+        _, out = _init_call(AveragePooling2D((2, 2)), x)
+        np.testing.assert_allclose(out[0, :, :, 0],
+                                   [[2.5, 4.5], [10.5, 12.5]])
+
+    def test_avg_pool_same_edge_counts(self):
+        """SAME avg-pool divides by the true window size at edges."""
+        x = np.ones((1, 3, 3, 1), np.float32)
+        _, out = _init_call(
+            AveragePooling2D((2, 2), strides=(1, 1), border_mode="same"), x)
+        np.testing.assert_allclose(out, 1.0, rtol=1e-6)
+
+    def test_global_pools(self):
+        x = np.random.RandomState(0).randn(2, 5, 6, 3).astype(np.float32)
+        _, out = _init_call(GlobalAveragePooling2D(), x)
+        np.testing.assert_allclose(out, x.mean(axis=(1, 2)), rtol=1e-5)
+        x1 = np.random.randn(2, 7, 3).astype(np.float32)
+        _, out = _init_call(GlobalMaxPooling1D(), x1)
+        np.testing.assert_allclose(out, x1.max(axis=1), rtol=1e-6)
+
+
+class TestNormalization:
+    def test_batchnorm_train_normalizes(self):
+        layer = BatchNormalization(momentum=0.9)
+        x = np.random.RandomState(0).randn(64, 8).astype(np.float32) * 3 + 5
+        params, state = layer.init(KEY, x.shape)
+        out, new_state = layer.call(params, state, jnp.asarray(x),
+                                    training=True)
+        out = np.asarray(out)
+        np.testing.assert_allclose(out.mean(0), 0.0, atol=1e-4)
+        np.testing.assert_allclose(out.std(0), 1.0, atol=1e-2)
+        # moving stats moved toward batch stats
+        assert float(jnp.abs(new_state["moving_mean"]).sum()) > 0
+
+    def test_batchnorm_eval_uses_moving_stats(self):
+        layer = BatchNormalization()
+        x = np.random.RandomState(1).randn(32, 4).astype(np.float32)
+        params, state = layer.init(KEY, x.shape)
+        out, new_state = layer.call(params, state, jnp.asarray(x),
+                                    training=False)
+        # with moving_mean=0, moving_var=1, eval output ≈ input (eps small)
+        np.testing.assert_allclose(np.asarray(out), x, atol=1e-2, rtol=1e-2)
+        assert new_state is state  # unchanged at eval
+
+    def test_batchnorm_4d_channel_axis(self):
+        layer = BatchNormalization()
+        x = np.random.RandomState(2).randn(8, 5, 5, 3).astype(np.float32)
+        params, state = layer.init(KEY, x.shape)
+        out, _ = layer.call(params, state, jnp.asarray(x), training=True)
+        out = np.asarray(out)
+        np.testing.assert_allclose(out.mean(axis=(0, 1, 2)), 0.0, atol=1e-4)
+
+    def test_layernorm(self):
+        layer = LayerNorm()
+        x = np.random.RandomState(0).randn(4, 10).astype(np.float32)
+        _, out = _init_call(layer, x)
+        np.testing.assert_allclose(out.mean(-1), 0.0, atol=1e-5)
+        np.testing.assert_allclose(out.std(-1), 1.0, atol=1e-2)
+
+    def test_lrn_closed_form_uniform(self):
+        """For constant input c over C>=n channels, interior channels see
+        denom = (k + alpha/n * n*c^2)^beta."""
+        c, n, alpha, beta, k = 2.0, 3, 0.5, 0.75, 1.0
+        layer = LRN2D(alpha=alpha, k=k, beta=beta, n=n)
+        x = np.full((1, 4, 4, 5), c, np.float32)
+        _, out = _init_call(layer, x)
+        expected = c / (k + alpha / n * n * c * c) ** beta
+        np.testing.assert_allclose(out[0, :, :, 2], expected, rtol=1e-5)
+
+    def test_within_channel_lrn_shape(self):
+        layer = WithinChannelLRN2D(size=3)
+        x = np.random.randn(1, 6, 6, 2).astype(np.float32)
+        _, out = _init_call(layer, x)
+        assert out.shape == x.shape
+
+
+class TestAdvancedActivations:
+    def test_leaky_elu_threshold(self):
+        x = np.array([[-2.0, -0.5, 0.0, 1.5]], np.float32)
+        _, out = _init_call(LeakyReLU(0.1), x)
+        np.testing.assert_allclose(out, [[-0.2, -0.05, 0.0, 1.5]], rtol=1e-6)
+        _, out = _init_call(ELU(1.0), x)
+        np.testing.assert_allclose(
+            out, [[np.expm1(-2.0), np.expm1(-0.5), 0.0, 1.5]], rtol=1e-5)
+        _, out = _init_call(ThresholdedReLU(1.0), x)
+        np.testing.assert_allclose(out, [[0, 0, 0, 1.5]])
+
+    def test_prelu_learns_slope(self):
+        layer = PReLU()
+        x = np.array([[-1.0, 2.0]], np.float32)
+        params, state = layer.init(KEY, x.shape)
+        out, _ = layer.call({"alpha": jnp.array([0.25, 0.25])}, state,
+                            jnp.asarray(x))
+        np.testing.assert_allclose(np.asarray(out), [[-0.25, 2.0]])
+
+    def test_srelu_identity_at_init_between_thresholds(self):
+        layer = SReLU()
+        x = np.array([[0.2, 0.8]], np.float32)
+        _, out = _init_call(layer, x)
+        np.testing.assert_allclose(out, x, rtol=1e-6)
+
+    def test_noise_layers_inference_identity(self):
+        x = np.random.randn(3, 4).astype(np.float32)
+        for layer in (GaussianNoise(0.5), GaussianDropout(0.3),
+                      SpatialDropout2D(0.5)):
+            xx = x if not isinstance(layer, SpatialDropout2D) else \
+                np.random.randn(2, 4, 4, 3).astype(np.float32)
+            _, out = _init_call(layer, xx, training=False)
+            np.testing.assert_allclose(out, xx)
+
+    def test_spatial_dropout_drops_whole_channels(self):
+        layer = SpatialDropout2D(0.5)
+        x = np.ones((1, 6, 6, 16), np.float32)
+        params, state = layer.init(KEY, x.shape)
+        out, _ = layer.call(params, state, jnp.asarray(x), training=True,
+                            rng=jax.random.PRNGKey(3))
+        out = np.asarray(out)
+        per_channel = out.reshape(-1, 16)
+        for ch in range(16):
+            vals = np.unique(per_channel[:, ch])
+            assert len(vals) == 1  # whole map kept or dropped
+
+
+class TestSequentialIntegration:
+    def test_small_cnn_trains(self):
+        from analytics_zoo_tpu.nn import Sequential
+        from analytics_zoo_tpu.nn.layers.core import Dense, Flatten
+        from analytics_zoo_tpu.train.optimizers import Adam
+
+        model = Sequential([
+            Convolution2D(4, 3, 3, activation="relu",
+                          input_shape=(8, 8, 1)),
+            BatchNormalization(),
+            MaxPooling2D((2, 2)),
+            Flatten(),
+            Dense(3),
+        ])
+        model.compile(optimizer=Adam(1e-2),
+                      loss="sparse_categorical_crossentropy_with_logits",
+                      metrics=["accuracy"])
+        rs = np.random.RandomState(0)
+        x = rs.randn(32, 8, 8, 1).astype(np.float32)
+        y = rs.randint(0, 3, 32).astype(np.int32)
+        model.fit(x, y, batch_size=16, nb_epoch=2, verbose=False)
+        res = model.evaluate(x, y, batch_size=16)
+        assert np.isfinite(res["loss"])
