@@ -1,1 +1,6 @@
 from analytics_zoo_tpu.data.featureset import FeatureSet  # noqa: F401
+from analytics_zoo_tpu.data.image import (  # noqa: F401
+    ImageFeature,
+    ImagePreprocessing,
+    ImageSet,
+)

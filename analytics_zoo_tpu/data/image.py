@@ -1,0 +1,423 @@
+"""Image pipeline: ``ImageSet`` + OpenCV-backed preprocessors.
+
+Reference capability: feature/image/ — ``ImageSet`` (ImageSet.scala:46,98,
+119; read:236) and the ~33 ``Image*`` preprocessors (Resize, CenterCrop,
+RandomCrop, Flip, Brightness/Contrast/Hue/Saturation, ChannelNormalize,
+ChannelOrder, Expand, AspectScale, PixelNormalizer, MatToTensor...).
+
+TPU-native design: preprocessing runs on the **host CPU** (cv2/numpy — the
+same OpenCV the reference reaches through JNI) producing dense NHWC float32
+batches that feed the device infeed.  There is no Spark: a "distributed"
+ImageSet is a host-sharded list; multi-host sharding slices the file list
+by ``jax.process_index()``.  Transform chaining keeps the reference's
+``->`` combinator as ``|`` / ``.chain()``.
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import os
+from typing import Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+try:
+    import cv2
+    _HAS_CV2 = True
+except Exception:  # pragma: no cover
+    cv2 = None
+    _HAS_CV2 = False
+
+
+class ImageFeature(dict):
+    """Mutable record for one image flowing through the pipeline
+    (reference feature/image ImageFeature: keys bytes/mat/label/path...)."""
+
+    @property
+    def image(self) -> np.ndarray:
+        return self["image"]
+
+    @image.setter
+    def image(self, v) -> None:
+        self["image"] = v
+
+    @property
+    def label(self):
+        return self.get("label")
+
+
+class ImagePreprocessing:
+    """Chainable per-image transform (reference Preprocessing[A,B] with
+    ``->``, feature/common/Preprocessing.scala)."""
+
+    def apply(self, feat: ImageFeature, rng: np.random.RandomState
+              ) -> ImageFeature:
+        raise NotImplementedError
+
+    def __or__(self, other: "ImagePreprocessing") -> "ChainedPreprocessing":
+        return ChainedPreprocessing([self, other])
+
+    def chain(self, other: "ImagePreprocessing") -> "ChainedPreprocessing":
+        return self | other
+
+    def __call__(self, feat, rng=None):
+        rng = rng or np.random.RandomState()
+        return self.apply(feat, rng)
+
+
+class ChainedPreprocessing(ImagePreprocessing):
+    def __init__(self, stages: Sequence[ImagePreprocessing]):
+        self.stages = []
+        for s in stages:
+            if isinstance(s, ChainedPreprocessing):
+                self.stages.extend(s.stages)
+            else:
+                self.stages.append(s)
+
+    def apply(self, feat, rng):
+        for s in self.stages:
+            feat = s.apply(feat, rng)
+        return feat
+
+
+class ImageResize(ImagePreprocessing):
+    """Reference: feature/image/ImageResize.scala."""
+
+    def __init__(self, resize_h: int, resize_w: int, mode: str = "bilinear"):
+        self.h, self.w = resize_h, resize_w
+        self.interp = (cv2.INTER_NEAREST if mode == "nearest"
+                       else cv2.INTER_LINEAR) if _HAS_CV2 else mode
+
+    def apply(self, feat, rng):
+        feat.image = cv2.resize(feat.image, (self.w, self.h),
+                                interpolation=self.interp)
+        return feat
+
+
+class ImageAspectScale(ImagePreprocessing):
+    """Scale the short edge to ``min_size`` keeping aspect ratio, cap the
+    long edge (reference ImageAspectScale.scala)."""
+
+    def __init__(self, min_size: int, max_size: int = 1000,
+                 scale_multiple_of: int = 1):
+        self.min_size, self.max_size = min_size, max_size
+        self.multiple = scale_multiple_of
+
+    def apply(self, feat, rng):
+        img = feat.image
+        h, w = img.shape[:2]
+        short, long_ = min(h, w), max(h, w)
+        scale = self.min_size / short
+        if scale * long_ > self.max_size:
+            scale = self.max_size / long_
+        nh, nw = int(round(h * scale)), int(round(w * scale))
+        if self.multiple > 1:
+            nh = (nh // self.multiple) * self.multiple
+            nw = (nw // self.multiple) * self.multiple
+        feat.image = cv2.resize(img, (nw, nh))
+        feat["scale"] = scale
+        return feat
+
+
+class ImageRandomAspectScale(ImagePreprocessing):
+    """Pick a random short-edge size from ``scales``
+    (reference ImageRandomAspectScale.scala)."""
+
+    def __init__(self, scales: Sequence[int], max_size: int = 1000):
+        self.scales = list(scales)
+        self.max_size = max_size
+
+    def apply(self, feat, rng):
+        size = self.scales[rng.randint(len(self.scales))]
+        return ImageAspectScale(size, self.max_size).apply(feat, rng)
+
+
+class ImageCenterCrop(ImagePreprocessing):
+    def __init__(self, crop_height: int, crop_width: int):
+        self.ch, self.cw = crop_height, crop_width
+
+    def apply(self, feat, rng):
+        img = feat.image
+        h, w = img.shape[:2]
+        top = max((h - self.ch) // 2, 0)
+        left = max((w - self.cw) // 2, 0)
+        feat.image = img[top:top + self.ch, left:left + self.cw]
+        return feat
+
+
+class ImageRandomCrop(ImagePreprocessing):
+    def __init__(self, crop_height: int, crop_width: int):
+        self.ch, self.cw = crop_height, crop_width
+
+    def apply(self, feat, rng):
+        img = feat.image
+        h, w = img.shape[:2]
+        top = rng.randint(0, max(h - self.ch, 0) + 1)
+        left = rng.randint(0, max(w - self.cw, 0) + 1)
+        feat.image = img[top:top + self.ch, left:left + self.cw]
+        return feat
+
+
+class ImageHFlip(ImagePreprocessing):
+    def apply(self, feat, rng):
+        feat.image = feat.image[:, ::-1]
+        return feat
+
+
+class ImageRandomHFlip(ImagePreprocessing):
+    def __init__(self, p: float = 0.5):
+        self.p = p
+
+    def apply(self, feat, rng):
+        if rng.rand() < self.p:
+            feat.image = feat.image[:, ::-1]
+        return feat
+
+
+class ImageChannelOrder(ImagePreprocessing):
+    """BGR <-> RGB swap (reference ImageChannelOrder)."""
+
+    def apply(self, feat, rng):
+        feat.image = feat.image[..., ::-1]
+        return feat
+
+
+class ImageBrightness(ImagePreprocessing):
+    """Add a uniform delta in [delta_low, delta_high]
+    (reference image/Brightness)."""
+
+    def __init__(self, delta_low: float, delta_high: float):
+        self.lo, self.hi = delta_low, delta_high
+
+    def apply(self, feat, rng):
+        delta = rng.uniform(self.lo, self.hi)
+        feat.image = feat.image.astype(np.float32) + delta
+        return feat
+
+
+class ImageContrast(ImagePreprocessing):
+    def __init__(self, delta_low: float, delta_high: float):
+        self.lo, self.hi = delta_low, delta_high
+
+    def apply(self, feat, rng):
+        factor = rng.uniform(self.lo, self.hi)
+        feat.image = feat.image.astype(np.float32) * factor
+        return feat
+
+
+class ImageSaturation(ImagePreprocessing):
+    def __init__(self, delta_low: float, delta_high: float):
+        self.lo, self.hi = delta_low, delta_high
+
+    def apply(self, feat, rng):
+        factor = rng.uniform(self.lo, self.hi)
+        img = feat.image.astype(np.float32)
+        gray = img.mean(axis=-1, keepdims=True)
+        feat.image = gray + (img - gray) * factor
+        return feat
+
+
+class ImageHue(ImagePreprocessing):
+    def __init__(self, delta_low: float = -18.0, delta_high: float = 18.0):
+        self.lo, self.hi = delta_low, delta_high
+
+    def apply(self, feat, rng):
+        delta = rng.uniform(self.lo, self.hi)
+        img = np.clip(feat.image, 0, 255).astype(np.uint8)
+        hsv = cv2.cvtColor(img, cv2.COLOR_BGR2HSV).astype(np.int32)
+        hsv[..., 0] = (hsv[..., 0] + int(delta)) % 180
+        feat.image = cv2.cvtColor(hsv.astype(np.uint8),
+                                  cv2.COLOR_HSV2BGR).astype(np.float32)
+        return feat
+
+
+class ImageColorJitter(ImagePreprocessing):
+    """Random brightness/contrast/saturation in random order
+    (reference ImageColorJitter.scala)."""
+
+    def __init__(self, brightness=(-32, 32), contrast=(0.5, 1.5),
+                 saturation=(0.5, 1.5)):
+        self.stages = [ImageBrightness(*brightness),
+                       ImageContrast(*contrast),
+                       ImageSaturation(*saturation)]
+
+    def apply(self, feat, rng):
+        for i in rng.permutation(len(self.stages)):
+            feat = self.stages[i].apply(feat, rng)
+        return feat
+
+
+class ImageExpand(ImagePreprocessing):
+    """Randomly place the image on a larger mean-filled canvas
+    (reference ImageExpand.scala, SSD augmentation)."""
+
+    def __init__(self, means=(123.0, 117.0, 104.0), max_expand_ratio: float = 4.0):
+        self.means = np.asarray(means, np.float32)
+        self.max_ratio = max_expand_ratio
+
+    def apply(self, feat, rng):
+        img = feat.image.astype(np.float32)
+        h, w = img.shape[:2]
+        ratio = rng.uniform(1.0, self.max_ratio)
+        nh, nw = int(h * ratio), int(w * ratio)
+        top = rng.randint(0, nh - h + 1)
+        left = rng.randint(0, nw - w + 1)
+        canvas = np.ones((nh, nw, img.shape[2]), np.float32) * self.means
+        canvas[top:top + h, left:left + w] = img
+        feat.image = canvas
+        feat["expand"] = (top, left, ratio)
+        return feat
+
+
+class ImageChannelNormalize(ImagePreprocessing):
+    """Per-channel (x - mean) / std.
+
+    Means/stds are given in R,G,B order but applied reversed (B,G,R)
+    because pipeline images are OpenCV BGR — exactly as the reference does
+    (ImageChannelNormalize.scala builds Array(meanB, meanG, meanR)).
+    """
+
+    def __init__(self, mean_r: float, mean_g: float, mean_b: float,
+                 std_r: float = 1.0, std_g: float = 1.0, std_b: float = 1.0):
+        self.mean = np.asarray([mean_b, mean_g, mean_r], np.float32)
+        self.std = np.asarray([std_b, std_g, std_r], np.float32)
+
+    def apply(self, feat, rng):
+        feat.image = (feat.image.astype(np.float32) - self.mean) / self.std
+        return feat
+
+
+class ImagePixelNormalizer(ImagePreprocessing):
+    """Subtract a full per-pixel mean image (reference ImagePixelNormalizer)."""
+
+    def __init__(self, means: np.ndarray):
+        self.means = np.asarray(means, np.float32)
+
+    def apply(self, feat, rng):
+        feat.image = feat.image.astype(np.float32) - self.means
+        return feat
+
+
+class ImageSetToSample(ImagePreprocessing):
+    """Finalize: ensure float32 HWC tensor (reference ImageSetToSample /
+    ImageMatToTensor — with NHWC, the TPU-native layout, not NCHW)."""
+
+    def apply(self, feat, rng):
+        img = np.asarray(feat.image, np.float32)
+        if img.ndim == 2:
+            img = img[..., None]
+        feat["sample"] = np.ascontiguousarray(img)
+        return feat
+
+
+ImageMatToTensor = ImageSetToSample
+
+
+class ImageSet:
+    """Collection of ImageFeatures + lazy transform chain.
+
+    Reference: feature/image/ImageSet.scala (read:236 local/distributed).
+    ``to_feature_set`` materializes into batchable arrays once every image
+    has a fixed shape.
+    """
+
+    def __init__(self, features: List[ImageFeature],
+                 transforms: Optional[ImagePreprocessing] = None,
+                 seed: int = 0):
+        self.features = features
+        self.transforms = transforms
+        self.seed = seed
+
+    # -- constructors ------------------------------------------------------
+    @staticmethod
+    def read(path: str, with_label: bool = False,
+             one_based_label: bool = True, max_images: Optional[int] = None,
+             num_shards: int = 1, shard_index: int = 0) -> "ImageSet":
+        """Read images from a directory (or glob).  With ``with_label``,
+        immediate subdirectory names become class labels (sorted order),
+        matching the reference's folder-per-class convention.
+        Multi-host: pass num_shards=jax.process_count()."""
+        if os.path.isdir(path):
+            pats = [os.path.join(path, "**", "*.*")]
+        else:
+            pats = [path]
+        files = sorted(f for p in pats for f in _glob.glob(p, recursive=True)
+                       if f.lower().endswith((".jpg", ".jpeg", ".png", ".bmp")))
+        label_map: Dict[str, int] = {}
+        if with_label:
+            # Build labels from the FULL listing (before shard/truncate) so
+            # every host agrees on class→id even with uneven shards.
+            classes = sorted({os.path.basename(os.path.dirname(f))
+                              for f in files})
+            base = 1 if one_based_label else 0
+            label_map = {c: i + base for i, c in enumerate(classes)}
+        files = files[shard_index::num_shards]
+        if max_images:
+            files = files[:max_images]
+        feats = []
+        for f in files:
+            img = cv2.imread(f, cv2.IMREAD_COLOR)
+            if img is None:
+                continue
+            feat = ImageFeature(image=img, path=f)
+            if with_label:
+                feat["label"] = label_map[os.path.basename(os.path.dirname(f))]
+            feats.append(feat)
+        im = ImageSet(feats)
+        im.label_map = label_map
+        return im
+
+    @staticmethod
+    def from_arrays(images: Sequence[np.ndarray],
+                    labels: Optional[Sequence] = None) -> "ImageSet":
+        feats = []
+        for i, img in enumerate(images):
+            f = ImageFeature(image=np.asarray(img))
+            if labels is not None:
+                f["label"] = labels[i]
+            feats.append(f)
+        return ImageSet(feats)
+
+    # -- transform ---------------------------------------------------------
+    def transform(self, preprocessing: ImagePreprocessing) -> "ImageSet":
+        t = (preprocessing if self.transforms is None
+             else self.transforms | preprocessing)
+        return ImageSet(self.features, t, self.seed)
+
+    def get_image(self, idx: int = 0) -> np.ndarray:
+        """Apply the chain to one image (debug/peek)."""
+        rng = np.random.RandomState(self.seed + idx)
+        feat = ImageFeature(self.features[idx])
+        if self.transforms is not None:
+            feat = self.transforms.apply(feat, rng)
+        return feat.get("sample", feat.image)
+
+    def __len__(self):
+        return len(self.features)
+
+    # -- materialization ---------------------------------------------------
+    def to_arrays(self, epoch_seed: int = 0
+                  ) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+        imgs, labels = [], []
+        for idx, raw in enumerate(self.features):
+            rng = np.random.RandomState(
+                (self.seed + epoch_seed * 1_000_003 + idx) % (2 ** 31))
+            feat = ImageFeature(raw)
+            if self.transforms is not None:
+                feat = self.transforms.apply(feat, rng)
+            imgs.append(np.asarray(feat.get("sample", feat.image), np.float32))
+            if feat.label is not None:
+                labels.append(feat.label)
+        x = np.stack(imgs)
+        if labels and len(labels) != len(imgs):
+            raise ValueError(
+                f"{len(imgs) - len(labels)} of {len(imgs)} images have no "
+                "label — refusing to silently misalign images and labels")
+        y = np.asarray(labels) if labels else None
+        return x, y
+
+    def to_feature_set(self, memory_type: str = "DRAM"):
+        from analytics_zoo_tpu.data.featureset import FeatureSet
+
+        x, y = self.to_arrays()
+        return FeatureSet.from_ndarrays(x, y, memory_type=memory_type)

@@ -1,0 +1,7 @@
+from analytics_zoo_tpu.models.image.imageclassification import (  # noqa: F401
+    ImageClassifier,
+    inception_v1,
+    mobilenet,
+    resnet50,
+    vgg16,
+)

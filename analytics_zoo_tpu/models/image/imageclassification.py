@@ -1,0 +1,230 @@
+"""Image classification: ImageClassifier + ResNet/Inception-v1/MobileNet/VGG.
+
+Reference capability: models/image/imageclassification/ — ``ImageClassifier``
+with per-model preprocessing configs (ImageClassificationConfig.scala:190)
+and the Scala examples' Inception-v1 (examples/inception/Train.scala) and
+ResNet trainers.
+
+TPU-first: all nets are NHWC, every conv+BN+relu block is left for XLA to
+fuse, and the default width/batch guidance targets MXU-friendly shapes
+(channels multiples of 128 at the wide layers of ResNet-50).  Builders
+return graph ``Model``s over the autograd DSL.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+from analytics_zoo_tpu.models.common import ZooModel, register_model
+from analytics_zoo_tpu.nn import Input, Model, Sequential
+from analytics_zoo_tpu.nn.layers.convolutional import Convolution2D, SeparableConvolution2D
+from analytics_zoo_tpu.nn.layers.core import Activation, Dense, Dropout, Flatten
+from analytics_zoo_tpu.nn.layers.merge import merge
+from analytics_zoo_tpu.nn.layers.normalization import BatchNormalization
+from analytics_zoo_tpu.nn.layers.pooling import (
+    AveragePooling2D, GlobalAveragePooling2D, MaxPooling2D)
+
+
+def _conv_bn(x, filters, k, strides=1, activation="relu", name=None,
+             border_mode="same"):
+    x = Convolution2D(filters, k, k, subsample=(strides, strides),
+                      border_mode=border_mode, bias=False,
+                      name=None if name is None else f"{name}_conv")(x)
+    x = BatchNormalization(name=None if name is None else f"{name}_bn")(x)
+    if activation:
+        x = Activation(activation)(x)
+    return x
+
+
+# ---------------------------------------------------------------- ResNet --
+
+def _bottleneck(x, filters, strides=1, downsample=False, name=""):
+    shortcut = x
+    if downsample:
+        shortcut = Convolution2D(filters * 4, 1, 1,
+                                 subsample=(strides, strides),
+                                 border_mode="same", bias=False,
+                                 name=f"{name}_proj")(x)
+        shortcut = BatchNormalization(name=f"{name}_proj_bn")(shortcut)
+    y = _conv_bn(x, filters, 1, strides=strides, name=f"{name}_a")
+    y = _conv_bn(y, filters, 3, name=f"{name}_b")
+    y = Convolution2D(filters * 4, 1, 1, border_mode="same", bias=False,
+                      name=f"{name}_c_conv")(y)
+    y = BatchNormalization(name=f"{name}_c_bn")(y)
+    out = merge([y, shortcut], mode="sum")
+    return Activation("relu")(out)
+
+
+def resnet50(class_num: int = 1000,
+             input_shape: Sequence[int] = (224, 224, 3)) -> Model:
+    """ResNet-50 (bottleneck [3,4,6,3]).  Reference: examples/resnet/ and
+    ImageClassificationConfig 'resnet-50' entry."""
+    inp = Input(shape=tuple(input_shape), name="input")
+    x = Convolution2D(64, 7, 7, subsample=(2, 2), border_mode="same",
+                      bias=False, name="stem_conv")(inp)
+    x = BatchNormalization(name="stem_bn")(x)
+    x = Activation("relu")(x)
+    x = MaxPooling2D((3, 3), strides=(2, 2), border_mode="same")(x)
+    for stage, (blocks, filters) in enumerate(
+            [(3, 64), (4, 128), (6, 256), (3, 512)]):
+        for b in range(blocks):
+            strides = 2 if (b == 0 and stage > 0) else 1
+            x = _bottleneck(x, filters, strides=strides, downsample=(b == 0),
+                            name=f"s{stage}b{b}")
+    x = GlobalAveragePooling2D()(x)
+    x = Dense(class_num, name="fc")(x)
+    return Model(inp, x, name="resnet50")
+
+
+# ------------------------------------------------------------- Inception --
+
+def _inception_block(x, c1, c3r, c3, c5r, c5, pp, name=""):
+    b1 = _conv_bn(x, c1, 1, name=f"{name}_1x1")
+    b3 = _conv_bn(x, c3r, 1, name=f"{name}_3x3r")
+    b3 = _conv_bn(b3, c3, 3, name=f"{name}_3x3")
+    b5 = _conv_bn(x, c5r, 1, name=f"{name}_5x5r")
+    b5 = _conv_bn(b5, c5, 5, name=f"{name}_5x5")
+    bp = MaxPooling2D((3, 3), strides=(1, 1), border_mode="same")(x)
+    bp = _conv_bn(bp, pp, 1, name=f"{name}_pool")
+    return merge([b1, b3, b5, bp], mode="concat", concat_axis=-1)
+
+
+def inception_v1(class_num: int = 1000,
+                 input_shape: Sequence[int] = (224, 224, 3)) -> Model:
+    """GoogLeNet / Inception-v1 (reference examples/inception/Train.scala,
+    BN variant for stable large-batch TPU training)."""
+    inp = Input(shape=tuple(input_shape), name="input")
+    x = _conv_bn(inp, 64, 7, strides=2, name="stem1")
+    x = MaxPooling2D((3, 3), strides=(2, 2), border_mode="same")(x)
+    x = _conv_bn(x, 64, 1, name="stem2")
+    x = _conv_bn(x, 192, 3, name="stem3")
+    x = MaxPooling2D((3, 3), strides=(2, 2), border_mode="same")(x)
+    x = _inception_block(x, 64, 96, 128, 16, 32, 32, name="3a")
+    x = _inception_block(x, 128, 128, 192, 32, 96, 64, name="3b")
+    x = MaxPooling2D((3, 3), strides=(2, 2), border_mode="same")(x)
+    x = _inception_block(x, 192, 96, 208, 16, 48, 64, name="4a")
+    x = _inception_block(x, 160, 112, 224, 24, 64, 64, name="4b")
+    x = _inception_block(x, 128, 128, 256, 24, 64, 64, name="4c")
+    x = _inception_block(x, 112, 144, 288, 32, 64, 64, name="4d")
+    x = _inception_block(x, 256, 160, 320, 32, 128, 128, name="4e")
+    x = MaxPooling2D((3, 3), strides=(2, 2), border_mode="same")(x)
+    x = _inception_block(x, 256, 160, 320, 32, 128, 128, name="5a")
+    x = _inception_block(x, 384, 192, 384, 48, 128, 128, name="5b")
+    x = GlobalAveragePooling2D()(x)
+    x = Dropout(0.4)(x)
+    x = Dense(class_num, name="fc")(x)
+    return Model(inp, x, name="inception_v1")
+
+
+# -------------------------------------------------------------- MobileNet --
+
+def mobilenet(class_num: int = 1000,
+              input_shape: Sequence[int] = (224, 224, 3),
+              alpha: float = 1.0) -> Model:
+    """MobileNet-v1 via separable convs (reference ImageClassificationConfig
+    'mobilenet' entries)."""
+    def c(f):
+        return max(8, int(f * alpha))
+
+    inp = Input(shape=tuple(input_shape), name="input")
+    x = _conv_bn(inp, c(32), 3, strides=2, name="stem")
+    cfg = [(64, 1), (128, 2), (128, 1), (256, 2), (256, 1), (512, 2),
+           (512, 1), (512, 1), (512, 1), (512, 1), (512, 1), (1024, 2),
+           (1024, 1)]
+    for i, (f, s) in enumerate(cfg):
+        x = SeparableConvolution2D(c(f), 3, 3, subsample=(s, s),
+                                   border_mode="same", bias=False,
+                                   name=f"sep{i}")(x)
+        x = BatchNormalization(name=f"sep{i}_bn")(x)
+        x = Activation("relu")(x)
+    x = GlobalAveragePooling2D()(x)
+    x = Dense(class_num, name="fc")(x)
+    return Model(inp, x, name="mobilenet")
+
+
+# ------------------------------------------------------------------- VGG --
+
+def vgg16(class_num: int = 1000,
+          input_shape: Sequence[int] = (224, 224, 3)) -> Model:
+    """VGG-16 (reference ImageClassificationConfig 'vgg-16')."""
+    inp = Input(shape=tuple(input_shape), name="input")
+    x = inp
+    for block, (reps, f) in enumerate(
+            [(2, 64), (2, 128), (3, 256), (3, 512), (3, 512)]):
+        for r in range(reps):
+            x = Convolution2D(f, 3, 3, border_mode="same", activation="relu",
+                              name=f"b{block}c{r}")(x)
+        x = MaxPooling2D((2, 2))(x)
+    x = Flatten()(x)
+    x = Dense(4096, activation="relu")(x)
+    x = Dropout(0.5)(x)
+    x = Dense(4096, activation="relu")(x)
+    x = Dropout(0.5)(x)
+    x = Dense(class_num, name="fc")(x)
+    return Model(inp, x, name="vgg16")
+
+
+_BUILDERS = {
+    "resnet-50": resnet50,
+    "inception-v1": inception_v1,
+    "mobilenet": mobilenet,
+    "vgg-16": vgg16,
+}
+
+# Per-model preprocessing configs (reference ImageClassificationConfig.scala:
+# mean/std + crop sizes per architecture).
+PREPROCESS_CONFIG = {
+    "resnet-50": {"size": 224, "mean": (123.68, 116.779, 103.939),
+                  "std": (1.0, 1.0, 1.0)},
+    "inception-v1": {"size": 224, "mean": (123.68, 116.779, 103.939),
+                     "std": (1.0, 1.0, 1.0)},
+    "mobilenet": {"size": 224, "mean": (123.68, 116.78, 103.94),
+                  "std": (58.624, 57.344, 57.6)},
+    "vgg-16": {"size": 224, "mean": (123.68, 116.779, 103.939),
+               "std": (1.0, 1.0, 1.0)},
+}
+
+
+@register_model
+class ImageClassifier(ZooModel):
+    """Built-in image-classification model with bundled preprocessing
+    (reference models/image/imageclassification/ImageClassifier.scala)."""
+
+    def __init__(self, model_name: str = "resnet-50", class_num: int = 1000,
+                 input_shape: Optional[Sequence[int]] = None):
+        super().__init__()
+        if model_name not in _BUILDERS:
+            raise ValueError(f"unknown model {model_name}; "
+                             f"available: {sorted(_BUILDERS)}")
+        self.model_name = model_name
+        self.class_num = class_num
+        cfg = PREPROCESS_CONFIG[model_name]
+        self.input_shape = tuple(input_shape or (cfg["size"], cfg["size"], 3))
+        self.model = _BUILDERS[model_name](class_num, self.input_shape)
+
+    def config(self):
+        return {"model_name": self.model_name, "class_num": self.class_num,
+                "input_shape": list(self.input_shape)}
+
+    def preprocessing(self):
+        """Default inference preprocessing chain for this architecture."""
+        from analytics_zoo_tpu.data.image import (
+            ImageAspectScale, ImageCenterCrop, ImageChannelNormalize,
+            ImageSetToSample)
+
+        cfg = PREPROCESS_CONFIG[self.model_name]
+        size = self.input_shape[0]
+        return (ImageAspectScale(int(size * 256 / 224))
+                | ImageCenterCrop(size, size)
+                | ImageChannelNormalize(*cfg["mean"], *cfg["std"])
+                | ImageSetToSample())
+
+    def predict_image_set(self, image_set, batch_size: int = 32,
+                          top_k: int = 1) -> np.ndarray:
+        """Classify an ImageSet → (N, top_k) class indices (0-based)."""
+        ims = image_set.transform(self.preprocessing())
+        x, _ = ims.to_arrays()
+        logits = self.model.predict(x, batch_size=batch_size)
+        return np.argsort(-logits, axis=-1)[:, :top_k]

@@ -1,0 +1,307 @@
+"""Attention layers: MultiHeadAttention, TransformerLayer, BERT.
+
+Reference capability: api/keras/layers/TransformerLayer.scala:56 (GPT-style
+decoder stack: token+position embedding, n blocks of attention+FFN with
+residuals and LayerNorm) and api/keras/layers/BERT.scala:66 (encoder stack
+with word/position/segment embeddings, attention mask, pooler).
+
+TPU-first: attention lowers to ``ops.attention.dot_product_attention`` —
+blockwise online-softmax (flash) rather than the reference's materialized
+O(L²) score matrix; projections are fused batched matmuls (MXU); dropout
+uses threaded PRNG keys.  Long-context via ring attention plugs in here
+through the same op interface (parallel/sequence.py).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.nn import activations, initializers
+from analytics_zoo_tpu.nn.module import Layer, StatelessLayer, split_rng
+from analytics_zoo_tpu.ops.attention import dot_product_attention
+
+
+def _dense_params(rng, d_in, d_out, init, dtype=jnp.float32):
+    return {"kernel": init(rng, (d_in, d_out), dtype),
+            "bias": jnp.zeros((d_out,), dtype)}
+
+
+def _dense(p, x):
+    return jnp.dot(x, p["kernel"]) + p["bias"]
+
+
+# Single source of LayerNorm math: the canonical layer from normalization.py
+from analytics_zoo_tpu.nn.layers.normalization import LayerNorm as _LayerNorm
+
+_LN = _LayerNorm(name="attention_shared_ln")
+
+
+def _layernorm_params(d):
+    return _LN.build_params(None, (1, d))
+
+
+def _layernorm(p, x):
+    return _LN.forward(p, x)
+
+
+def _dropout(rng, x, rate, training):
+    if not training or rate <= 0 or rng is None:
+        return x
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(rng, keep, x.shape)
+    return jnp.where(mask, x / keep, 0.0)
+
+
+class MultiHeadAttention(StatelessLayer):
+    """Multi-head (self or cross) attention with fused QKV projection.
+
+    Single input → self-attention; two inputs (q, kv) → cross-attention.
+    An optional third input is the attention mask (1 = attend),
+    broadcastable to (B, 1, Lq, Lk).
+    """
+
+    def __init__(self, nhead: int, hidden_size: Optional[int] = None,
+                 attn_drop: float = 0.0, output_drop: float = 0.0,
+                 causal: bool = False, init="glorot_uniform", **kw):
+        super().__init__(**kw)
+        self.nhead = nhead
+        self.hidden_size = hidden_size
+        self.attn_drop = attn_drop
+        self.output_drop = output_drop
+        self.causal = causal
+        self.initializer = initializers.get(init)
+
+    def build_params(self, rng, q_shape, *rest):
+        d = self.hidden_size or q_shape[-1]
+        if d % self.nhead:
+            raise ValueError(f"hidden {d} not divisible by nhead {self.nhead}")
+        kv_d = rest[0][-1] if rest else q_shape[-1]
+        ks = jax.random.split(rng, 4)
+        return {
+            "q": _dense_params(ks[0], q_shape[-1], d, self.initializer),
+            "k": _dense_params(ks[1], kv_d, d, self.initializer),
+            "v": _dense_params(ks[2], kv_d, d, self.initializer),
+            "o": _dense_params(ks[3], d, d, self.initializer),
+        }
+
+    def _split_heads(self, x):
+        b, l, d = x.shape
+        return x.reshape(b, l, self.nhead, d // self.nhead).transpose(
+            0, 2, 1, 3)
+
+    def forward(self, params, *inputs, training=False, rng=None):
+        # Input forms: (x) self-attn; (q, kv) cross-attn with kv 3D;
+        # (x, mask) self-attn with a 2D key-padding or 4D full mask;
+        # (q, kv, mask).  A 3D (B, Lq, Lk) mask needs the 3-arg form.
+        mask = None
+        if len(inputs) == 1:
+            q_in = kv_in = inputs[0]
+        elif len(inputs) == 2:
+            if inputs[1].ndim == 3:
+                q_in, kv_in = inputs
+            else:
+                q_in = kv_in = inputs[0]
+                mask = inputs[1]
+        else:
+            q_in, kv_in, mask = inputs
+        q = self._split_heads(_dense(params["q"], q_in))
+        k = self._split_heads(_dense(params["k"], kv_in))
+        v = self._split_heads(_dense(params["v"], kv_in))
+        if mask is not None:
+            if mask.ndim == 2:      # (B, Lk) key padding mask
+                mask = mask[:, None, None, :]
+            elif mask.ndim == 3:    # (B, Lq, Lk) full mask
+                mask = mask[:, None, :, :]
+        r1, r2 = split_rng(rng, 2)
+        out = dot_product_attention(q, k, v, mask=mask, causal=self.causal)
+        if self.attn_drop > 0:
+            out = _dropout(r1, out, self.attn_drop, training)
+        b, h, l, hd = out.shape
+        out = out.transpose(0, 2, 1, 3).reshape(b, l, h * hd)
+        out = _dense(params["o"], out)
+        return _dropout(r2, out, self.output_drop, training)
+
+
+class TransformerBlock(StatelessLayer):
+    """One attention + FFN block with residuals.
+
+    ``after_norm=False`` → post-LN (original Transformer / BERT / the
+    reference's TransformerLayer); ``True`` → pre-LN (more stable deep).
+    """
+
+    def __init__(self, nhead: int, hidden_size: int,
+                 intermediate_size: Optional[int] = None,
+                 hidden_drop: float = 0.1, attn_drop: float = 0.1,
+                 causal: bool = False, activation="gelu",
+                 after_norm: bool = False, init="glorot_uniform", **kw):
+        super().__init__(**kw)
+        self.attn = MultiHeadAttention(nhead, hidden_size,
+                                       attn_drop=attn_drop, causal=causal,
+                                       init=init,
+                                       name=f"{self.name}_attn")
+        self.hidden_size = hidden_size
+        self.intermediate = intermediate_size or 4 * hidden_size
+        self.hidden_drop = hidden_drop
+        self.act = activations.get(activation)
+        self.pre_ln = after_norm
+        self.initializer = initializers.get(init)
+
+    def build_params(self, rng, x_shape, *rest):
+        d = self.hidden_size
+        ks = jax.random.split(rng, 3)
+        return {
+            "attn": self.attn.build_params(ks[0], x_shape),
+            "ln1": _layernorm_params(d),
+            "ln2": _layernorm_params(d),
+            "ffn1": _dense_params(ks[1], d, self.intermediate,
+                                  self.initializer),
+            "ffn2": _dense_params(ks[2], self.intermediate, d,
+                                  self.initializer),
+        }
+
+    def forward(self, params, x, *rest, training=False, rng=None):
+        mask = rest[0] if rest else None
+        r1, r2, r3 = split_rng(rng, 3)
+        attn_in = _layernorm(params["ln1"], x) if self.pre_ln else x
+        a_args = (attn_in,) if mask is None else (attn_in, mask)
+        a = self.attn.forward(params["attn"], *a_args, training=training,
+                              rng=r1)
+        x = x + _dropout(r2, a, self.hidden_drop, training)
+        if not self.pre_ln:
+            x = _layernorm(params["ln1"], x)
+        ffn_in = _layernorm(params["ln2"], x) if self.pre_ln else x
+        h = self.act(_dense(params["ffn1"], ffn_in))
+        h = _dense(params["ffn2"], h)
+        x = x + _dropout(r3, h, self.hidden_drop, training)
+        if not self.pre_ln:
+            x = _layernorm(params["ln2"], x)
+        return x
+
+
+class TransformerLayer(StatelessLayer):
+    """GPT-style decoder stack over token ids
+    (reference api/keras/layers/TransformerLayer.scala:56).
+
+    Input: int32 token ids (B, L) [+ optional position ids (B, L)].
+    Output: hidden states (B, L, hidden_size).
+    """
+
+    def __init__(self, vocab: int = 40990, seq_len: int = 77,
+                 n_block: int = 12, nhead: int = 12, hidden_size: int = 768,
+                 intermediate_size: Optional[int] = None,
+                 hidden_drop: float = 0.1, attn_drop: float = 0.1,
+                 embedding_drop: float = 0.1, causal: bool = True,
+                 after_norm: bool = False, init="glorot_uniform", **kw):
+        super().__init__(**kw)
+        self.vocab, self.seq_len = vocab, seq_len
+        self.hidden_size = hidden_size
+        self.embedding_drop = embedding_drop
+        self.blocks = [
+            TransformerBlock(nhead, hidden_size, intermediate_size,
+                             hidden_drop, attn_drop, causal=causal,
+                             after_norm=after_norm, init=init,
+                             name=f"{self.name}_block{i}")
+            for i in range(n_block)]
+        self.initializer = initializers.get(init)
+
+    def build_params(self, rng, ids_shape, *rest):
+        ks = jax.random.split(rng, 2 + len(self.blocks))
+        d = self.hidden_size
+        params = {
+            "tok_embed": self.initializer(ks[0], (self.vocab, d),
+                                          jnp.float32) * 0.1,
+            "pos_embed": self.initializer(ks[1], (self.seq_len, d),
+                                          jnp.float32) * 0.1,
+        }
+        hshape = tuple(ids_shape) + (d,)
+        for i, blk in enumerate(self.blocks):
+            params[f"block{i}"] = blk.build_params(ks[2 + i], hshape)
+        return params
+
+    def forward(self, params, ids, *rest, training=False, rng=None):
+        pos_ids = rest[0] if rest else None
+        ids = ids.astype(jnp.int32)  # container abstract-eval passes f32
+        l = ids.shape[1]
+        x = params["tok_embed"][ids]
+        if pos_ids is None:
+            x = x + params["pos_embed"][None, :l]
+        else:
+            x = x + params["pos_embed"][pos_ids.astype(jnp.int32)]
+        rngs = split_rng(rng, 1 + len(self.blocks))
+        x = _dropout(rngs[0], x, self.embedding_drop, training)
+        for i, blk in enumerate(self.blocks):
+            x = blk.forward(params[f"block{i}"], x, training=training,
+                            rng=rngs[1 + i])
+        return x
+
+
+class BERT(StatelessLayer):
+    """BERT encoder (reference api/keras/layers/BERT.scala:66).
+
+    Inputs: token ids (B, L), segment ids (B, L), [position ids (B, L)],
+    [attention mask (B, L), 1 = real token].
+    Output: (sequence_output (B, L, H), pooled_output (B, H)).
+    """
+
+    def __init__(self, vocab: int = 40990, hidden_size: int = 768,
+                 n_block: int = 12, nhead: int = 12,
+                 intermediate_size: int = 3072, max_position_len: int = 512,
+                 type_vocab_size: int = 2, hidden_drop: float = 0.1,
+                 attn_drop: float = 0.1, init="glorot_uniform", **kw):
+        super().__init__(**kw)
+        self.vocab = vocab
+        self.hidden_size = hidden_size
+        self.max_position_len = max_position_len
+        self.type_vocab_size = type_vocab_size
+        self.hidden_drop = hidden_drop
+        self.blocks = [
+            TransformerBlock(nhead, hidden_size, intermediate_size,
+                             hidden_drop, attn_drop, causal=False,
+                             activation="gelu", after_norm=False, init=init,
+                             name=f"{self.name}_enc{i}")
+            for i in range(n_block)]
+        self.initializer = initializers.get(init)
+
+    def build_params(self, rng, ids_shape, *rest):
+        d = self.hidden_size
+        ks = jax.random.split(rng, 4 + len(self.blocks))
+        params = {
+            "word_embed": self.initializer(ks[0], (self.vocab, d),
+                                           jnp.float32) * 0.1,
+            "pos_embed": self.initializer(ks[1], (self.max_position_len, d),
+                                          jnp.float32) * 0.1,
+            "type_embed": self.initializer(ks[2], (self.type_vocab_size, d),
+                                           jnp.float32) * 0.1,
+            "embed_ln": _layernorm_params(d),
+            "pooler": _dense_params(ks[3], d, d, self.initializer),
+        }
+        hshape = tuple(ids_shape) + (d,)
+        for i, blk in enumerate(self.blocks):
+            params[f"enc{i}"] = blk.build_params(ks[4 + i], hshape)
+        return params
+
+    def forward(self, params, ids, *rest, training=False, rng=None):
+        ids = ids.astype(jnp.int32)  # container abstract-eval passes f32
+        seg_ids = (rest[0].astype(jnp.int32) if len(rest) > 0
+                   else jnp.zeros_like(ids))
+        pos_ids = rest[1] if len(rest) > 1 else None
+        mask = rest[2] if len(rest) > 2 else None
+        l = ids.shape[1]
+        x = params["word_embed"][ids] + params["type_embed"][seg_ids]
+        if pos_ids is None:
+            x = x + params["pos_embed"][None, :l]
+        else:
+            x = x + params["pos_embed"][pos_ids.astype(jnp.int32)]
+        x = _layernorm(params["embed_ln"], x)
+        rngs = split_rng(rng, 1 + len(self.blocks))
+        x = _dropout(rngs[0], x, self.hidden_drop, training)
+        for i, blk in enumerate(self.blocks):
+            args = (x,) if mask is None else (x, mask)
+            x = blk.forward(params[f"enc{i}"], *args, training=training,
+                            rng=rngs[1 + i])
+        pooled = jnp.tanh(_dense(params["pooler"], x[:, 0]))
+        return [x, pooled]

@@ -1,0 +1,5 @@
+from analytics_zoo_tpu.ops.attention import (  # noqa: F401
+    blockwise_attention,
+    dot_product_attention,
+    reference_attention,
+)

@@ -1,0 +1,152 @@
+"""Attention ops: blockwise (flash-style) attention with online softmax.
+
+Reference capability: the O(L²) full attention inside
+api/keras/layers/TransformerLayer.scala:56 and BERT.scala:66 (SURVEY §5.7:
+the reference has NO long-context support — sequence length is bounded by
+single-node memory).  This module is the TPU-native upgrade: attention is
+computed **blockwise over KV chunks with an online softmax** (Rabe &
+Staats 2021 / FlashAttention), so peak memory is O(L·block) instead of
+O(L²), and the same code is the building block for ring attention
+(parallel/sequence.py) where the KV scan runs over devices instead of
+chunks.
+
+Two paths, same math:
+- ``blockwise_attention``: pure JAX ``lax.scan`` over KV blocks —
+  differentiable (XLA derives the backward), runs on any backend.
+- ``flash_attention`` (ops/flash_attention.py): hand-written Pallas TPU
+  kernel for the forward hot loop; falls back to blockwise elsewhere.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e30
+
+
+def reference_attention(q, k, v, mask=None, causal: bool = False,
+                        sm_scale: Optional[float] = None):
+    """Naive O(L²) attention — the numerics oracle for tests.
+
+    Shapes: q (B, H, Lq, D), k/v (B, H, Lk, D); mask broadcastable to
+    (B, H, Lq, Lk) with 1 = attend.
+    """
+    d = q.shape[-1]
+    scale = sm_scale if sm_scale is not None else 1.0 / jnp.sqrt(d)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal:
+        lq, lk = q.shape[2], k.shape[2]
+        cm = jnp.tril(jnp.ones((lq, lk), bool), k=lk - lq)
+        logits = jnp.where(cm, logits, NEG_INF)
+    if mask is not None:
+        logits = jnp.where(mask.astype(bool), logits, NEG_INF)
+    w = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", w, v)
+
+
+def blockwise_attention(q, k, v, mask=None, causal: bool = False,
+                        sm_scale: Optional[float] = None,
+                        block_size: int = 512):
+    """Flash-style attention: scan over KV blocks with a running
+    (max, sum, acc) online softmax.  O(Lq · block) memory.
+
+    Differentiable end-to-end (the scan is unrolled by XLA's autodiff);
+    wrap the call in ``jax.checkpoint`` to trade recompute for memory in
+    very long sequences.
+    """
+    b, h, lq, d = q.shape
+    lk = k.shape[2]
+    scale = sm_scale if sm_scale is not None else 1.0 / jnp.sqrt(d)
+    bs = min(block_size, lk)
+    nblocks = -(-lk // bs)  # ceil
+    pad = nblocks * bs - lk
+    if pad:
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        padmask = jnp.arange(nblocks * bs) < lk        # (Lk',)
+    else:
+        padmask = None
+    if mask is not None:
+        mask = jnp.broadcast_to(mask.astype(bool), (b, h, lq, lk))
+        if pad:
+            mask = jnp.pad(mask, ((0, 0), (0, 0), (0, 0), (0, pad)))
+        # (nblocks, B, H, Lq, bs) scan order
+        mask_blocks = jnp.moveaxis(
+            mask.reshape(b, h, lq, nblocks, bs), 3, 0)
+    k_blocks = jnp.moveaxis(k.reshape(b, h, nblocks, bs, d), 2, 0)
+    v_blocks = jnp.moveaxis(v.reshape(b, h, nblocks, bs, d), 2, 0)
+
+    q_scaled = q * scale
+    q_pos = jnp.arange(lq) + (lk - lq)  # causal offset for cross lengths
+
+    def step(carry, inputs):
+        m_prev, l_prev, acc = carry
+        if mask is not None:
+            kb, vb, mb, blk = inputs
+        else:
+            kb, vb, blk = inputs
+        logits = jnp.einsum("bhqd,bhkd->bhqk", q_scaled, kb)  # (B,H,Lq,bs)
+        if padmask is not None:
+            kpos_valid = lax.dynamic_slice_in_dim(padmask, blk * bs, bs)
+            logits = jnp.where(kpos_valid[None, None, None, :], logits,
+                               NEG_INF)
+        if causal:
+            kpos = blk * bs + jnp.arange(bs)
+            cm = q_pos[:, None] >= kpos[None, :]
+            logits = jnp.where(cm[None, None], logits, NEG_INF)
+        if mask is not None:
+            logits = jnp.where(mb, logits, NEG_INF)
+        m_cur = jnp.max(logits, axis=-1)                     # (B,H,Lq)
+        m_new = jnp.maximum(m_prev, m_cur)
+        # guard fully-masked rows: keep m finite
+        m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.exp(logits - m_safe[..., None])
+        p = jnp.where(logits <= NEG_INF / 2, 0.0, p)
+        alpha = jnp.exp(jnp.where(jnp.isfinite(m_prev), m_prev - m_safe,
+                                  NEG_INF))
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1)
+        acc = acc * alpha[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, vb)
+        return (m_safe + jnp.where(jnp.isfinite(m_new), 0.0, NEG_INF),
+                l_new, acc), None
+
+    init = (jnp.full((b, h, lq), NEG_INF, q.dtype),
+            jnp.zeros((b, h, lq), q.dtype),
+            jnp.zeros((b, h, lq, d), q.dtype))
+    blks = jnp.arange(nblocks)
+    xs = ((k_blocks, v_blocks, mask_blocks, blks) if mask is not None
+          else (k_blocks, v_blocks, blks))
+    (m, l, acc), _ = lax.scan(step, init, xs)
+    l = jnp.maximum(l, 1e-20)
+    return acc / l[..., None]
+
+
+def dot_product_attention(q, k, v, mask=None, causal: bool = False,
+                          sm_scale: Optional[float] = None,
+                          block_size: int = 512,
+                          use_flash: Optional[bool] = None):
+    """Entry point used by the attention layers.
+
+    Chooses the Pallas flash kernel on TPU when shapes allow, else the
+    blockwise scan.  ``use_flash`` forces the choice (tests).
+    """
+    if use_flash is None:
+        use_flash = (jax.default_backend() == "tpu" and mask is None
+                     and q.shape[-1] % 128 == 0 and q.shape[2] % 128 == 0
+                     and k.shape[2] % 128 == 0)
+    if use_flash:
+        if mask is not None:
+            raise ValueError("flash kernel does not take a mask; pass "
+                             "use_flash=False (or None for auto dispatch)")
+        from analytics_zoo_tpu.ops.flash_attention import flash_attention
+        return flash_attention(q, k, v, causal=causal, sm_scale=sm_scale)
+    if q.shape[2] * k.shape[2] <= 256 * 256:
+        # tiny sequences: one fused softmax beats the scan
+        return reference_attention(q, k, v, mask=mask, causal=causal,
+                                   sm_scale=sm_scale)
+    return blockwise_attention(q, k, v, mask=mask, causal=causal,
+                               sm_scale=sm_scale, block_size=block_size)

@@ -1,0 +1,161 @@
+"""Pallas TPU flash-attention forward kernel.
+
+Replaces the O(L²) attention inside the reference's TransformerLayer/BERT
+(api/keras/layers/TransformerLayer.scala:56, BERT.scala:66) with a fused
+blockwise kernel: Q/K/V tiles stream HBM→VMEM, the (block_q, block_k)
+logits tile lives only in VMEM, and the online-softmax running (m, l, acc)
+state sits in VMEM scratch across the KV grid dimension.  The MXU sees two
+matmuls per tile (Q·Kᵀ and P·V); everything else is VPU work fused in
+between.
+
+Autodiff: ``flash_attention`` carries a ``jax.custom_vjp`` whose backward
+recomputes attention gradients via the pure-JAX blockwise path
+(ops/attention.py) — i.e. the forward hot loop (serving, eval) gets the
+hand-written kernel while training gradients reuse XLA's derivation of the
+same math.  Off-TPU the kernel runs in interpreter mode only under tests;
+production dispatch falls back to blockwise (see dot_product_attention).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # TPU-specific memory spaces; absent on some CPU-only builds
+    from jax.experimental.pallas import tpu as pltpu
+    _VMEM = pltpu.VMEM
+except Exception:  # pragma: no cover
+    pltpu = None
+    _VMEM = None
+
+NEG_INF = -1e30
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+                sm_scale: float, causal: bool, block_q: int, block_k: int,
+                lq: int, lk: int):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # Causal: skip KV blocks strictly above the diagonal.
+    q_end = qi * block_q + block_q - 1 + (lk - lq)
+    live = (ki * block_k <= q_end) if causal else (ki >= 0)
+
+    @pl.when(live)
+    def _body():
+        q = q_ref[0].astype(jnp.float32) * sm_scale      # (bq, d)
+        k = k_ref[0].astype(jnp.float32)                 # (bk, d)
+        logits = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)          # (bq, bk)
+        if causal:
+            qpos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0) + (lk - lq)
+            kpos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            logits = jnp.where(qpos >= kpos, logits, NEG_INF)
+
+        m_prev = m_scr[:, :1]                            # (bq, 1)
+        l_prev = l_scr[:, :1]
+        m_cur = jnp.max(logits, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(logits - m_new)
+        p = jnp.where(logits <= NEG_INF / 2, 0.0, p)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[:] = (acc_scr[:] * alpha
+                      + jax.lax.dot_general(
+                          p, v_ref[0].astype(jnp.float32),
+                          (((1,), (0,)), ((), ())),
+                          preferred_element_type=jnp.float32))
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        l = jnp.maximum(l_scr[:, :1], 1e-20)
+        o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
+
+
+def _flash_fwd(q, k, v, sm_scale: float, causal: bool,
+               block_q: int, block_k: int, interpret: bool):
+    b, h, lq, d = q.shape
+    lk = k.shape[2]
+    bq = min(block_q, lq)
+    bk = min(block_k, lk)
+    assert lq % bq == 0 and lk % bk == 0, (
+        f"sequence lengths ({lq},{lk}) must divide blocks ({bq},{bk})")
+    qf = q.reshape(b * h, lq, d)
+    kf = k.reshape(b * h, lk, d)
+    vf = v.reshape(b * h, lk, d)
+    grid = (b * h, lq // bq, lk // bk)
+
+    if _VMEM is None:
+        raise ImportError(
+            "jax.experimental.pallas.tpu unavailable — use "
+            "ops.attention.blockwise_attention (dot_product_attention "
+            "dispatches there automatically)")
+    kernel = functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
+                               block_q=bq, block_k=bk, lq=lq, lk=lk)
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, lq, d), q.dtype),
+        scratch_shapes=[
+            _VMEM((bq, 128), jnp.float32),
+            _VMEM((bq, 128), jnp.float32),
+            _VMEM((bq, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(b, h, lq, d)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def flash_attention(q, k, v, causal: bool = False,
+                    sm_scale: Optional[float] = None, block_q: int = 128,
+                    block_k: int = 128, interpret: bool = False):
+    """Fused attention forward. Shapes q (B,H,Lq,D), k/v (B,H,Lk,D).
+
+    D and the sequence blocks should be multiples of 128 for MXU tiling
+    (dispatch in ops/attention.py enforces this).
+    """
+    scale = sm_scale if sm_scale is not None else 1.0 / (q.shape[-1] ** 0.5)
+    return _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret)
+
+
+def _fwd_rule(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    out = flash_attention(q, k, v, causal, sm_scale, block_q, block_k,
+                          interpret)
+    return out, (q, k, v)
+
+
+def _bwd_rule(causal, sm_scale, block_q, block_k, interpret, res, g):
+    from analytics_zoo_tpu.ops.attention import blockwise_attention
+
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: blockwise_attention(
+            q_, k_, v_, causal=causal, sm_scale=sm_scale,
+            block_size=block_k), q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_fwd_rule, _bwd_rule)

@@ -1,0 +1,152 @@
+"""Image pipeline tests: ImageSet, 2D preprocessors, 3D transforms."""
+
+import os
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.data.image import (
+    ImageAspectScale, ImageBrightness, ImageCenterCrop, ImageChannelNormalize,
+    ImageChannelOrder, ImageColorJitter, ImageContrast, ImageExpand,
+    ImageFeature, ImageHFlip, ImageRandomCrop, ImageRandomHFlip, ImageResize,
+    ImageSet, ImageSetToSample)
+from analytics_zoo_tpu.data.image3d import (
+    AffineTransform3D, Crop3D, RandomCrop3D, Rotate3D)
+
+RS = np.random.RandomState(0)
+
+
+def _img(h=32, w=48, c=3):
+    return RS.randint(0, 255, (h, w, c)).astype(np.uint8)
+
+
+class TestPreprocessors:
+    def test_resize(self):
+        f = ImageResize(16, 24).apply(ImageFeature(image=_img()), RS)
+        assert f.image.shape == (16, 24, 3)
+
+    def test_aspect_scale_short_edge(self):
+        f = ImageAspectScale(16).apply(
+            ImageFeature(image=_img(32, 64)), RS)
+        assert f.image.shape[0] == 16 and f.image.shape[1] == 32
+
+    def test_aspect_scale_caps_long_edge(self):
+        f = ImageAspectScale(100, max_size=50).apply(
+            ImageFeature(image=_img(40, 80)), RS)
+        assert max(f.image.shape[:2]) == 50
+
+    def test_center_and_random_crop(self):
+        img = _img(10, 10)
+        f = ImageCenterCrop(4, 6).apply(ImageFeature(image=img), RS)
+        np.testing.assert_array_equal(f.image, img[3:7, 2:8])
+        f = ImageRandomCrop(4, 4).apply(ImageFeature(image=img),
+                                        np.random.RandomState(1))
+        assert f.image.shape == (4, 4, 3)
+
+    def test_flip_and_channel_order(self):
+        img = _img(4, 4)
+        f = ImageHFlip().apply(ImageFeature(image=img), RS)
+        np.testing.assert_array_equal(f.image, img[:, ::-1])
+        f = ImageChannelOrder().apply(ImageFeature(image=img), RS)
+        np.testing.assert_array_equal(f.image, img[..., ::-1])
+
+    def test_random_hflip_deterministic_given_rng(self):
+        img = _img(4, 4)
+        f = ImageRandomHFlip(p=1.0).apply(ImageFeature(image=img), RS)
+        np.testing.assert_array_equal(f.image, img[:, ::-1])
+
+    def test_color_ops(self):
+        img = _img().astype(np.float32)
+        f = ImageBrightness(10, 10).apply(ImageFeature(image=img), RS)
+        np.testing.assert_allclose(f.image, img + 10)
+        f = ImageContrast(2, 2).apply(ImageFeature(image=img), RS)
+        np.testing.assert_allclose(f.image, img * 2)
+        f = ImageColorJitter().apply(ImageFeature(image=img.copy()), RS)
+        assert f.image.shape == img.shape
+
+    def test_expand_places_image(self):
+        img = np.ones((8, 8, 3), np.float32) * 50
+        f = ImageExpand(means=(0, 0, 0), max_expand_ratio=2.0).apply(
+            ImageFeature(image=img), np.random.RandomState(0))
+        assert f.image.shape[0] >= 8
+        assert f.image.sum() == img.sum()  # canvas zero-filled
+
+    def test_channel_normalize_is_bgr_ordered(self):
+        """Means are given R,G,B but applied B,G,R (images are OpenCV BGR),
+        matching the reference ImageChannelNormalize.scala."""
+        img = np.ones((2, 2, 3), np.float32) * [30, 20, 10]  # B,G,R planes
+        f = ImageChannelNormalize(10, 20, 30, 2, 2, 2).apply(
+            ImageFeature(image=img), RS)
+        np.testing.assert_allclose(f.image, 0.0)
+
+    def test_chain_operator(self):
+        chain = (ImageResize(16, 16) | ImageCenterCrop(8, 8)
+                 | ImageSetToSample())
+        f = chain.apply(ImageFeature(image=_img()), RS)
+        assert f["sample"].shape == (8, 8, 3)
+        assert f["sample"].dtype == np.float32
+
+
+class TestImageSet:
+    def test_read_folder_with_labels(self, tmp_path):
+        import cv2
+        for cls in ("cat", "dog"):
+            d = tmp_path / cls
+            d.mkdir()
+            for i in range(3):
+                cv2.imwrite(str(d / f"{i}.jpg"), _img())
+        ims = ImageSet.read(str(tmp_path), with_label=True)
+        assert len(ims) == 6
+        assert ims.label_map == {"cat": 1, "dog": 2}
+        labels = sorted(f["label"] for f in ims.features)
+        assert labels == [1, 1, 1, 2, 2, 2]
+
+    def test_transform_to_feature_set(self):
+        ims = ImageSet.from_arrays([_img(20, 20) for _ in range(4)],
+                                   labels=[1, 2, 1, 2])
+        ims = ims.transform(ImageResize(8, 8) | ImageSetToSample())
+        fs = ims.to_feature_set()
+        batch = next(fs.batches(2))
+        assert batch[0].shape == (2, 8, 8, 3)
+        assert batch[1].shape == (2,)
+
+    def test_sharded_read(self, tmp_path):
+        import cv2
+        for i in range(4):
+            cv2.imwrite(str(tmp_path / f"{i}.jpg"), _img())
+        s0 = ImageSet.read(str(tmp_path), num_shards=2, shard_index=0)
+        s1 = ImageSet.read(str(tmp_path), num_shards=2, shard_index=1)
+        assert len(s0) == 2 and len(s1) == 2
+        paths = {f["path"] for f in s0.features} | {f["path"] for f in s1.features}
+        assert len(paths) == 4
+
+
+class TestImage3D:
+    def test_crop3d_center(self):
+        vol = np.arange(6 ** 3, dtype=np.float32).reshape(6, 6, 6)
+        f = Crop3D(patch_size=(2, 2, 2)).apply(ImageFeature(image=vol), RS)
+        np.testing.assert_array_equal(f.image, vol[2:4, 2:4, 2:4])
+
+    def test_random_crop3d(self):
+        vol = np.zeros((8, 8, 8), np.float32)
+        f = RandomCrop3D((3, 3, 3)).apply(ImageFeature(image=vol),
+                                          np.random.RandomState(0))
+        assert f.image.shape == (3, 3, 3)
+
+    def test_rotate_identity(self):
+        vol = RS.rand(5, 5, 5).astype(np.float32)
+        f = Rotate3D(0, 0, 0).apply(ImageFeature(image=vol.copy()), RS)
+        np.testing.assert_allclose(f.image, vol, atol=1e-5)
+
+    def test_rotate_quarter_turn(self):
+        vol = np.zeros((5, 5, 5), np.float32)
+        vol[2, 2, 4] = 1.0  # offset along W
+        f = Rotate3D(yaw=np.pi / 2).apply(ImageFeature(image=vol.copy()), RS)
+        # 90° yaw rotates within the first two axes' plane
+        assert f.image.max() > 0.5
+
+    def test_affine_identity(self):
+        vol = RS.rand(4, 4, 4).astype(np.float32)
+        f = AffineTransform3D(np.eye(3)).apply(ImageFeature(image=vol.copy()),
+                                               RS)
+        np.testing.assert_allclose(f.image, vol, atol=1e-6)

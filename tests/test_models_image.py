@@ -1,0 +1,94 @@
+"""Image classification model tests (tiny shapes — CPU-friendly)."""
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.models.image import (
+    ImageClassifier, inception_v1, mobilenet, resnet50, vgg16)
+from analytics_zoo_tpu.train.optimizers import Adam
+
+
+class TestBuilders:
+    def test_resnet50_forward_shape(self):
+        m = resnet50(class_num=10, input_shape=(64, 64, 3))
+        m.compile(optimizer=Adam(1e-3),
+                  loss="sparse_categorical_crossentropy_with_logits")
+        out = m.predict(np.random.randn(2, 64, 64, 3).astype(np.float32),
+                        batch_size=2)
+        assert out.shape == (2, 10)
+
+    def test_inception_v1_forward_shape(self):
+        m = inception_v1(class_num=7, input_shape=(64, 64, 3))
+        m.compile(optimizer=Adam(1e-3),
+                  loss="sparse_categorical_crossentropy_with_logits")
+        out = m.predict(np.random.randn(2, 64, 64, 3).astype(np.float32),
+                        batch_size=2)
+        assert out.shape == (2, 7)
+
+    def test_mobilenet_forward_shape(self):
+        m = mobilenet(class_num=5, input_shape=(64, 64, 3), alpha=0.25)
+        m.compile(optimizer=Adam(1e-3),
+                  loss="sparse_categorical_crossentropy_with_logits")
+        out = m.predict(np.random.randn(2, 64, 64, 3).astype(np.float32),
+                        batch_size=2)
+        assert out.shape == (2, 5)
+
+    def test_vgg16_forward_shape(self):
+        m = vgg16(class_num=4, input_shape=(32, 32, 3))
+        m.compile(optimizer=Adam(1e-3),
+                  loss="sparse_categorical_crossentropy_with_logits")
+        out = m.predict(np.random.randn(2, 32, 32, 3).astype(np.float32),
+                        batch_size=2)
+        assert out.shape == (2, 4)
+
+
+class TestTraining:
+    def test_resnet_loss_decreases(self):
+        """ResNet-50 trains stably (loss strictly decreases) on a
+        separable 2-class task."""
+        m = resnet50(class_num=2, input_shape=(32, 32, 3))
+        m.compile(optimizer=Adam(1e-3),
+                  loss="sparse_categorical_crossentropy_with_logits",
+                  metrics=["accuracy"])
+        rs = np.random.RandomState(0)
+        n = 32
+        y = rs.randint(0, 2, n).astype(np.int32)
+        x = rs.randn(n, 32, 32, 3).astype(np.float32) * 0.1
+        x[y == 1] += 1.5  # strongly separable
+        first = m.evaluate(x, y, batch_size=32)
+        m.fit(x, y, batch_size=32, nb_epoch=5, verbose=False)
+        res = m.evaluate(x, y, batch_size=32)
+        assert np.isfinite(res["loss"])
+        assert res["loss"] < first["loss"], (first, res)
+
+
+class TestImageClassifier:
+    def test_classifier_predict_image_set(self):
+        from analytics_zoo_tpu.data.image import ImageSet
+
+        clf = ImageClassifier("mobilenet", class_num=3,
+                              input_shape=(32, 32, 3))
+        clf.compile(optimizer=Adam(1e-3),
+                    loss="sparse_categorical_crossentropy_with_logits")
+        imgs = [np.random.randint(0, 255, (48, 40, 3)).astype(np.uint8)
+                for _ in range(4)]
+        preds = clf.predict_image_set(ImageSet.from_arrays(imgs),
+                                      batch_size=2, top_k=2)
+        assert preds.shape == (4, 2)
+        assert preds.max() < 3
+
+    def test_save_load_roundtrip(self, tmp_path):
+        clf = ImageClassifier("mobilenet", class_num=3,
+                              input_shape=(32, 32, 3))
+        clf.compile(optimizer=Adam(1e-3),
+                    loss="sparse_categorical_crossentropy_with_logits")
+        x = np.random.randn(4, 32, 32, 3).astype(np.float32)
+        p1 = clf.predict(x, batch_size=4)
+        clf.save_model(str(tmp_path / "m"))
+
+        from analytics_zoo_tpu.models.common import ZooModel
+        clf2 = ZooModel.load_model(str(tmp_path / "m"))
+        clf2.compile(optimizer=Adam(1e-3),
+                     loss="sparse_categorical_crossentropy_with_logits")
+        p2 = clf2.predict(x, batch_size=4)
+        np.testing.assert_allclose(p1, p2, rtol=1e-5, atol=1e-5)
