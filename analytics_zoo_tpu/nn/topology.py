@@ -29,16 +29,18 @@ class KerasNet(Layer):
         self._estimator = None  # created by compile()
 
     # -- training facade (delegates to train.Estimator) -------------------
-    def compile(self, optimizer, loss, metrics=None):
+    def compile(self, optimizer, loss, metrics=None, sharding="dp"):
         """Configure training (reference Topology.scala:136-204).
 
         ``optimizer``/``loss``/``metrics`` accept strings (Keras-style
         lowering, reference KerasUtils.scala:165-167) or objects.
+        ``sharding``: "dp" (replicated params) | "tp" (model-axis splits)
+        | a parallel.ShardingStrategy.
         """
         from analytics_zoo_tpu.train.estimator import Estimator
 
         self._estimator = Estimator(self, optimizer=optimizer, loss=loss,
-                                    metrics=metrics)
+                                    metrics=metrics, sharding=sharding)
         # apply settings made before compile()
         if getattr(self, "_tb_dir", None):
             self._estimator.set_tensorboard(self._tb_dir)

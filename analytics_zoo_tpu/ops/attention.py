@@ -49,6 +49,28 @@ def reference_attention(q, k, v, mask=None, causal: bool = False,
     return jnp.einsum("bhqk,bhkd->bhqd", w, v)
 
 
+def online_softmax_fold(m_prev, l_prev, acc, logits, values):
+    """One fold of the online-softmax accumulation — the single source of
+    this numerics, shared by blockwise attention (KV-chunk loop) and ring
+    attention (device loop, parallel/sequence.py).
+
+    ``logits`` (B,H,Lq,Kblk) must already carry all masking as NEG_INF.
+    Returns the updated running (max, normalizer, weighted-value acc);
+    fully-masked rows are kept finite-safe and contribute zero.
+    """
+    m_cur = jnp.max(logits, axis=-1)
+    m_new = jnp.maximum(m_prev, m_cur)
+    m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+    p = jnp.exp(logits - m_safe[..., None])
+    p = jnp.where(logits <= NEG_INF / 2, 0.0, p)
+    alpha = jnp.exp(jnp.where(jnp.isfinite(m_prev), m_prev - m_safe,
+                              NEG_INF))
+    l_new = l_prev * alpha + jnp.sum(p, axis=-1)
+    acc = acc * alpha[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, values)
+    m_out = m_safe + jnp.where(jnp.isfinite(m_new), 0.0, NEG_INF)
+    return m_out, l_new, acc
+
+
 def blockwise_attention(q, k, v, mask=None, causal: bool = False,
                         sm_scale: Optional[float] = None,
                         block_size: int = 512):
@@ -101,18 +123,7 @@ def blockwise_attention(q, k, v, mask=None, causal: bool = False,
             logits = jnp.where(cm[None, None], logits, NEG_INF)
         if mask is not None:
             logits = jnp.where(mb, logits, NEG_INF)
-        m_cur = jnp.max(logits, axis=-1)                     # (B,H,Lq)
-        m_new = jnp.maximum(m_prev, m_cur)
-        # guard fully-masked rows: keep m finite
-        m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
-        p = jnp.exp(logits - m_safe[..., None])
-        p = jnp.where(logits <= NEG_INF / 2, 0.0, p)
-        alpha = jnp.exp(jnp.where(jnp.isfinite(m_prev), m_prev - m_safe,
-                                  NEG_INF))
-        l_new = l_prev * alpha + jnp.sum(p, axis=-1)
-        acc = acc * alpha[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, vb)
-        return (m_safe + jnp.where(jnp.isfinite(m_new), 0.0, NEG_INF),
-                l_new, acc), None
+        return online_softmax_fold(m_prev, l_prev, acc, logits, vb), None
 
     init = (jnp.full((b, h, lq), NEG_INF, q.dtype),
             jnp.zeros((b, h, lq), q.dtype),

@@ -1,0 +1,11 @@
+from analytics_zoo_tpu.parallel.sharding import (  # noqa: F401
+    AutoSharding,
+    DataParallel,
+    ShardingStrategy,
+    TensorParallel,
+    make_strategy,
+)
+from analytics_zoo_tpu.parallel.sequence import (  # noqa: F401
+    ring_attention,
+    ring_self_attention,
+)

@@ -1,0 +1,101 @@
+"""Sequence/context parallelism: ring attention over the mesh.
+
+Reference capability: **absent** (SURVEY.md §5.7 — the reference's
+TransformerLayer/BERT materialize full O(L²) attention on one host, and
+sequence length is bounded by single-node memory).  This module is the
+TPU-native upgrade that makes long context first-class: the sequence axis
+is sharded over devices, K/V shards rotate around the ring via
+``lax.ppermute`` (ICI neighbour exchanges), and each device folds incoming
+blocks into the same online-softmax accumulator used by blockwise
+attention (ops/attention.py) — i.e. ring attention (Liu et al.) is
+literally blockwise attention whose KV loop runs over devices.
+
+Use ``ring_attention`` inside ``shard_map`` with q/k/v sharded on the
+sequence axis; ``ring_self_attention`` wraps the shard_map for you.
+Differentiable end-to-end (ppermute has a transpose rule).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from analytics_zoo_tpu.ops.attention import online_softmax_fold
+
+try:  # jax >= 0.8
+    from jax import shard_map  # type: ignore
+except ImportError:  # pragma: no cover — older jax
+    from jax.experimental.shard_map import shard_map  # type: ignore
+
+NEG_INF = -1e30
+
+
+def ring_attention(q, k, v, axis_name: str, causal: bool = False,
+                   sm_scale: Optional[float] = None):
+    """Attention where K/V are sharded over ``axis_name`` (per-device
+    shapes: q (B, H, Lq_local, D), k/v (B, H, Lk_local, D)).
+
+    Must run inside shard_map/pjit with ``axis_name`` bound.  Each of the
+    ``n`` ring steps computes local blockwise attention against the
+    currently-held KV shard, then rotates KV to the next device.
+    """
+    n = lax.psum(1, axis_name)
+    my = lax.axis_index(axis_name)
+    b, h, lq, d = q.shape
+    lk = k.shape[2]
+    scale = sm_scale if sm_scale is not None else 1.0 / jnp.sqrt(d)
+    q_scaled = q * scale
+    # global positions of my queries (sequence sharded evenly)
+    q_pos = my * lq + jnp.arange(lq)
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(carry, i):
+        m_prev, l_prev, acc, kc, vc = carry
+        # device holding shard s sends to s+1, so after i rotations we hold
+        # the shard originally on device (my - i) mod n
+        src = (my - i) % n
+        logits = jnp.einsum("bhqd,bhkd->bhqk", q_scaled, kc)
+        if causal:
+            k_pos = src * lk + jnp.arange(lk)
+            cm = q_pos[:, None] >= k_pos[None, :]
+            logits = jnp.where(cm[None, None], logits, NEG_INF)
+        m_out, l_new, acc = online_softmax_fold(m_prev, l_prev, acc, logits,
+                                                vc)
+        kc = lax.ppermute(kc, axis_name, perm)
+        vc = lax.ppermute(vc, axis_name, perm)
+        return (m_out, l_new, acc, kc, vc), None
+
+    def _vary(x):
+        # mark freshly-created accumulators as device-varying so the scan
+        # carry type matches its (axis-dependent) outputs under shard_map
+        try:
+            return lax.pvary(x, (axis_name,))
+        except AttributeError:  # pragma: no cover — older jax
+            return x
+
+    init = (_vary(jnp.full((b, h, lq), NEG_INF, q.dtype)),
+            _vary(jnp.zeros((b, h, lq), q.dtype)),
+            _vary(jnp.zeros((b, h, lq, d), q.dtype)), k, v)
+    (m, l, acc, _, _), _ = lax.scan(step, init, jnp.arange(n))
+    l = jnp.maximum(l, 1e-20)
+    return acc / l[..., None]
+
+
+def ring_self_attention(q, k, v, mesh: Mesh, seq_axis: str,
+                        causal: bool = False,
+                        sm_scale: Optional[float] = None):
+    """Convenience wrapper: shard q/k/v (B, H, L, D) on dim 2 over
+    ``seq_axis`` of ``mesh`` and run ring attention."""
+    spec = P(None, None, seq_axis, None)
+
+    fn = shard_map(
+        functools.partial(ring_attention, axis_name=seq_axis, causal=causal,
+                          sm_scale=sm_scale),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    return fn(q, k, v)

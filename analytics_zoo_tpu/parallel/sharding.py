@@ -1,0 +1,151 @@
+"""Parallelism strategies: parameter-sharding rules over the device mesh.
+
+Reference capability (SURVEY.md §2.4): the reference has ONE strategy —
+synchronous data parallelism via Spark-block-manager allreduce
+(InternalDistriOptimizer, Topology.scala:1069-1267; wp-bigdl.md:113-160) —
+and explicitly lacks TP/PP/SP.  The TPU build gets data parallelism as the
+degenerate case of GSPMD, and tensor parallelism "for free" by annotating
+parameter shardings: XLA inserts the all-gathers/reduce-scatters over ICI.
+
+Design: a strategy is a function ``spec(path, leaf) -> PartitionSpec``
+applied over the params pytree.  The Estimator puts params on the mesh with
+those specs; batch inputs shard over the data axis; jit does the rest.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+SpecFn = Callable[[str, Any], P]
+
+
+def path_str(path) -> str:
+    """jax tree path -> 'a/b/c' string for regex matching."""
+    parts = []
+    for k in path:
+        if hasattr(k, "key"):
+            parts.append(str(k.key))
+        elif hasattr(k, "idx"):
+            parts.append(str(k.idx))
+        else:
+            parts.append(str(k))
+    return "/".join(parts)
+
+
+class ShardingStrategy:
+    """Base: fully replicated parameters (pure data parallelism)."""
+
+    def spec(self, path: str, leaf) -> P:
+        return P()
+
+    def param_shardings(self, mesh, params):
+        """Pytree of NamedShardings matching ``params``."""
+        def one(path, leaf):
+            return NamedSharding(mesh, self.spec(path_str(path), leaf))
+
+        return jax.tree_util.tree_map_with_path(one, params)
+
+
+class DataParallel(ShardingStrategy):
+    """Replicate params, shard the batch (the reference's only mode)."""
+
+
+class TensorParallel(ShardingStrategy):
+    """Shard large parameters along ``axis`` (the mesh's model axis).
+
+    Rules (applied in order):
+    - explicit ``rules``: list of (regex on param path, PartitionSpec);
+    - otherwise any leaf with ≥ ``min_size`` elements is sharded along its
+      largest dimension divisible by the axis size (embedding tables split
+      over vocab, Dense kernels over the wider of in/out) — the standard
+      Megatron-style layout expressed as GSPMD annotations.
+
+    ``mesh_axis_size`` may be omitted — ``param_shardings`` derives it from
+    the mesh (and validates that ``axis`` exists there).
+    """
+
+    def __init__(self, axis: str = "model", mesh_axis_size: Optional[int] = None,
+                 rules: Optional[Sequence] = None, min_size: int = 2 ** 16):
+        self.axis = axis
+        self.axis_size = mesh_axis_size
+        self.rules = [(re.compile(pat), spec) for pat, spec in (rules or [])]
+        self.min_size = min_size
+
+    def param_shardings(self, mesh, params):
+        sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+        if self.axis not in sizes:
+            raise ValueError(
+                f"TensorParallel axis {self.axis!r} not in mesh axes "
+                f"{tuple(mesh.axis_names)}; build the context with a model "
+                "axis, e.g. init_zoo_context(mesh_shape=(d, t), "
+                "axis_names=('data', 'model'))")
+        if self.axis_size is None:
+            self.axis_size = sizes[self.axis]
+        elif self.axis_size != sizes[self.axis]:
+            raise ValueError(
+                f"mesh_axis_size {self.axis_size} != mesh's "
+                f"{self.axis!r} size {sizes[self.axis]}")
+        return super().param_shardings(mesh, params)
+
+    def spec(self, path: str, leaf) -> P:
+        for pat, spec in self.rules:
+            if pat.search(path):
+                return spec
+        shape = getattr(leaf, "shape", ())
+        if not shape or int(np.prod(shape)) < self.min_size:
+            return P()
+        if not self.axis_size or self.axis_size <= 1:
+            return P()
+        # largest dim divisible by the axis size
+        cands = [(d, i) for i, d in enumerate(shape)
+                 if d % self.axis_size == 0]
+        if not cands:
+            return P()
+        _, dim = max(cands)
+        spec = [None] * len(shape)
+        spec[dim] = self.axis
+        return P(*spec)
+
+
+class AutoSharding(TensorParallel):
+    """Mesh-adaptive: tensor-parallel over the mesh's last axis when it has
+    a dedicated (non-data) axis, plain data parallelism otherwise."""
+
+    def __init__(self, rules: Optional[Sequence] = None,
+                 min_size: int = 2 ** 16):
+        super().__init__(axis="", mesh_axis_size=None, rules=rules,
+                         min_size=min_size)
+
+    def param_shardings(self, mesh, params):
+        if len(mesh.axis_names) < 2:
+            return DataParallel().param_shardings(mesh, params)
+        self.axis = mesh.axis_names[-1]
+        self.axis_size = None
+        return super().param_shardings(mesh, params)
+
+
+def make_strategy(name: str, mesh, **kw) -> ShardingStrategy:
+    """String lowering (config-system entry point)."""
+    name = name.lower()
+    if name in ("dp", "data", "data_parallel", "replicated"):
+        return DataParallel()
+    if name in ("auto",):
+        return AutoSharding(**kw)
+    if name in ("tp", "tensor", "tensor_parallel"):
+        axis = kw.pop("axis", None)
+        if axis is None:
+            if len(mesh.axis_names) < 2:
+                raise ValueError(
+                    "sharding='tp' needs a mesh with a model axis (got "
+                    f"axes {tuple(mesh.axis_names)}); use "
+                    "init_zoo_context(mesh_shape=(d, t), "
+                    "axis_names=('data', 'model')) or sharding='auto'")
+            axis = mesh.axis_names[-1]
+        return TensorParallel(axis=axis, **kw)
+    raise ValueError(f"unknown sharding strategy {name!r}; "
+                     "known: dp, tp, auto")

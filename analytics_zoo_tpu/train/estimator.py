@@ -58,9 +58,11 @@ class Estimator:
                  metrics: Optional[Sequence] = None,
                  ctx: Optional[ZooContext] = None,
                  grad_clip_norm: Optional[float] = None,
-                 grad_clip_value: Optional[float] = None):
+                 grad_clip_value: Optional[float] = None,
+                 sharding="dp"):
         self.model = model
         self.tx = optim_lib.get(optimizer)
+        self._sharding_strategy = sharding  # "dp" | "tp" | ShardingStrategy
         if grad_clip_norm is not None:
             self.tx = optax.chain(optax.clip_by_global_norm(grad_clip_norm), self.tx)
         elif grad_clip_value is not None:
@@ -110,10 +112,46 @@ class Estimator:
         self._initial_weights = (params, state or {})
         if self.params is not None:
             rep = self.ctx.replicated_sharding()
-            self.params = jax.device_put(params, rep)
+            self.params = jax.device_put(params, self._param_shardings(params))
             self.state = jax.device_put(state or {}, rep)
-            self.opt_state = self.tx.init(self.params)
+            self.opt_state = jax.jit(
+                self.tx.init, out_shardings=self._opt_shardings())(self.params)
         return self
+
+    def _param_shardings(self, params):
+        """Per-parameter shardings from the strategy (replicated for DP;
+        Megatron-style model-axis splits for TP — parallel/sharding.py)."""
+        from analytics_zoo_tpu.parallel.sharding import (
+            ShardingStrategy, make_strategy)
+
+        strat = self._sharding_strategy
+        if isinstance(strat, str):
+            strat = make_strategy(strat, self.ctx.mesh)
+        assert isinstance(strat, ShardingStrategy)
+        return strat.param_shardings(self.ctx.mesh, params)
+
+    def _opt_shardings(self):
+        """Sharding tree for the optimizer state: subtrees shaped like the
+        params pytree (adam mu/nu, momentum...) take the param shardings;
+        everything else (step counts) is replicated."""
+        rep = self.ctx.replicated_sharding()
+        ptree = jax.tree_util.tree_structure(self.params)
+        pshard = self._param_shardings(self.params)
+        opt_shapes = jax.eval_shape(self.tx.init, self.params)
+
+        def is_params_like(sub):
+            try:
+                return jax.tree_util.tree_structure(sub) == ptree
+            except Exception:
+                return False
+
+        def map_sub(sub):
+            if is_params_like(sub):
+                return pshard
+            return jax.tree_util.tree_map(lambda _: rep, sub)
+
+        return jax.tree_util.tree_map(map_sub, opt_shapes,
+                                      is_leaf=is_params_like)
 
     def _ensure_built(self, inputs: List[np.ndarray]):
         if self.params is not None:
@@ -124,12 +162,14 @@ class Estimator:
         pending = getattr(self, "_initial_weights", None)
         if pending is not None:
             self.params, self.state = pending
-        self.opt_state = self.tx.init(self.params)
-        # replicate across the mesh
+        # place params per strategy; state replicated (small BN buffers);
+        # optimizer state takes the matching param shardings explicitly
+        # (tx.init's zeros_like would otherwise constant-fold onto one dev).
         rep = self.ctx.replicated_sharding()
-        self.params = jax.device_put(self.params, rep)
+        self.params = jax.device_put(self.params, self._param_shardings(self.params))
         self.state = jax.device_put(self.state, rep)
-        self.opt_state = jax.device_put(self.opt_state, rep)
+        self.opt_state = jax.jit(
+            self.tx.init, out_shardings=self._opt_shardings())(self.params)
 
     def _build_train_step(self):
         model, loss_fn, tx = self.model, self.loss_fn, self.tx
@@ -151,10 +191,12 @@ class Estimator:
             new_params = optax.apply_updates(params, updates)
             return new_params, new_state, new_opt, loss
 
+        # params/state/opt shardings are inherited from their device_put
+        # placement (replicated for DP, model-axis split for TP) — pinning
+        # only the batch keeps one step implementation for every strategy.
         self._train_step = jax.jit(
             step,
-            in_shardings=(rep, rep, rep, rep, None, data_shard, data_shard),
-            out_shardings=(rep, rep, rep, None),
+            in_shardings=(None, None, None, rep, None, data_shard, data_shard),
             donate_argnums=(0, 1, 2),
         )
 
@@ -186,7 +228,7 @@ class Estimator:
             return out
 
         self._eval_step = jax.jit(
-            step, in_shardings=(rep, rep, data_shard, data_shard, data_shard),
+            step, in_shardings=(None, None, data_shard, data_shard, data_shard),
             out_shardings=rep)
 
     def _build_predict_step(self):
@@ -199,7 +241,8 @@ class Estimator:
             return preds
 
         self._predict_step = jax.jit(
-            step, in_shardings=(rep, rep, data_shard), out_shardings=data_shard)
+            step, in_shardings=(None, None, data_shard),
+            out_shardings=data_shard)
 
     # ------------------------------------------------------------------
     # data plumbing
@@ -449,9 +492,18 @@ class Estimator:
     def _restore_checkpoint(self):
         step, tree = self._ckpt_mgr.restore()
         rep = self.ctx.replicated_sharding()
-        self.params = jax.device_put(tree["params"], rep)
+        self.params = jax.device_put(tree["params"],
+                                     self._param_shardings(tree["params"]))
         self.state = jax.device_put(tree["state"], rep)
-        self.opt_state = jax.device_put(tree["opt_state"], rep)
+        try:
+            # mirror a fresh init's shardings (matches TP param splits)
+            self.opt_state = jax.device_put(tree["opt_state"],
+                                            self._opt_shardings())
+        except (ValueError, TypeError) as e:
+            logger.warning(
+                "optimizer-state shardings could not be mirrored (%s); "
+                "restoring replicated — TP runs lose opt-state sharding", e)
+            self.opt_state = jax.device_put(tree["opt_state"], rep)
         self.global_step = int(tree["meta"]["global_step"])
         self.finished_epochs = int(tree["meta"]["finished_epochs"])
         logger.info("restored checkpoint step %d", step)
