@@ -4,3 +4,8 @@ from analytics_zoo_tpu.data.image import (  # noqa: F401
     ImagePreprocessing,
     ImageSet,
 )
+from analytics_zoo_tpu.data.text import (  # noqa: F401
+    TextFeature,
+    TextSet,
+    load_glove_embeddings,
+)

@@ -6,3 +6,21 @@ from analytics_zoo_tpu.models.recommendation import (  # noqa: F401
     WideAndDeep,
     negative_sample,
 )
+from analytics_zoo_tpu.models.text import (  # noqa: F401
+    KNRM,
+    Ranker,
+    TextClassifier,
+    mean_average_precision,
+    ndcg,
+)
+from analytics_zoo_tpu.models.seq2seq import (  # noqa: F401
+    Bridge,
+    RNNDecoder,
+    RNNEncoder,
+    Seq2seq,
+)
+from analytics_zoo_tpu.models.anomalydetection import (  # noqa: F401
+    AnomalyDetector,
+    detect_anomalies,
+    unroll,
+)

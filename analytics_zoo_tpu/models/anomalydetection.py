@@ -1,0 +1,91 @@
+"""Anomaly detection: stacked-LSTM forecaster + threshold detection.
+
+Reference capability: models/anomalydetection/AnomalyDetector.scala (222
+LoC: 2-3 stacked LSTMs with dropout → Dense(1) next-value prediction;
+``detectAnomalies`` ranks |y - ŷ| and flags the top ``anomalySize``) and
+its ``Utils.unroll`` windowing (pyzoo mirror zoo/models/anomalydetection).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from analytics_zoo_tpu.models.common import ZooModel, register_model
+from analytics_zoo_tpu.nn import Sequential
+from analytics_zoo_tpu.nn.layers.core import Dense, Dropout
+from analytics_zoo_tpu.nn.layers.recurrent import LSTM
+
+
+def unroll(data: np.ndarray, unroll_length: int,
+           predict_step: int = 1) -> Tuple[np.ndarray, np.ndarray]:
+    """Sliding windows: x[i] = data[i : i+L], y[i] = data[i+L+step-1, 0]
+    (reference AnomalyDetector.unroll)."""
+    data = np.asarray(data, np.float32)
+    if data.ndim == 1:
+        data = data[:, None]
+    n = len(data) - unroll_length - predict_step + 1
+    if n <= 0:
+        raise ValueError(
+            f"series of {len(data)} too short for unroll_length "
+            f"{unroll_length} + predict_step {predict_step}")
+    x = np.stack([data[i:i + unroll_length] for i in range(n)])
+    y = data[unroll_length + predict_step - 1:
+             unroll_length + predict_step - 1 + n, 0]
+    return x, y.astype(np.float32)
+
+
+def detect_anomalies(y_true: np.ndarray, y_pred: np.ndarray,
+                     anomaly_size: Optional[int] = None,
+                     threshold: Optional[float] = None) -> np.ndarray:
+    """Indices of anomalous points — either the top-``anomaly_size`` by
+    absolute error, or all points with |error| > ``threshold``
+    (reference AnomalyDetector.detectAnomalies)."""
+    err = np.abs(np.asarray(y_true).ravel() - np.asarray(y_pred).ravel())
+    if threshold is not None:
+        return np.nonzero(err > threshold)[0]
+    if anomaly_size is None:
+        anomaly_size = max(1, int(0.01 * err.size))
+    return np.argsort(-err)[:anomaly_size]
+
+
+@register_model
+class AnomalyDetector(ZooModel):
+    """LSTM forecaster over unrolled windows
+    (reference models/anomalydetection/AnomalyDetector.scala:45-120).
+
+    ``feature_shape`` = (unroll_length, feature_num);
+    ``hidden_layers``/``dropouts`` mirror the reference's constructor.
+    """
+
+    def __init__(self, feature_shape: Sequence[int],
+                 hidden_layers: Sequence[int] = (8, 32, 15),
+                 dropouts: Sequence[float] = (0.2, 0.2, 0.2)):
+        super().__init__()
+        if len(hidden_layers) != len(dropouts):
+            raise ValueError("hidden_layers and dropouts must align")
+        self.feature_shape = tuple(feature_shape)
+        self.hidden_layers = tuple(hidden_layers)
+        self.dropouts = tuple(dropouts)
+
+        # explicit names: load_model rebuilds with fresh auto-name counters,
+        # so params must be keyed independently of global naming state
+        layers: List = []
+        for i, (h, p) in enumerate(zip(hidden_layers, dropouts)):
+            last = i == len(hidden_layers) - 1
+            kw = {"input_shape": self.feature_shape} if i == 0 else {}
+            layers.append(LSTM(h, return_sequences=not last,
+                               name=f"ad_lstm{i}", **kw))
+            layers.append(Dropout(p, name=f"ad_drop{i}"))
+        layers.append(Dense(1, name="ad_out"))
+        self.model = Sequential(layers, name="anomaly_detector")
+
+    def config(self):
+        return {"feature_shape": list(self.feature_shape),
+                "hidden_layers": list(self.hidden_layers),
+                "dropouts": list(self.dropouts)}
+
+    def detect_anomalies(self, y_true, y_pred, anomaly_size=None,
+                         threshold=None):
+        return detect_anomalies(y_true, y_pred, anomaly_size, threshold)

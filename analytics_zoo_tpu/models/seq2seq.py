@@ -1,0 +1,254 @@
+"""Seq2seq: RNNEncoder / RNNDecoder / Bridge / Seq2seq model + greedy infer.
+
+Reference capability: models/seq2seq/ — ``Seq2seq`` (Seq2seq.scala:45-302),
+``RNNEncoder``/``RNNDecoder`` (205/212 LoC: stacked LSTM/GRU with state
+handoff), ``Bridge`` (156 LoC: "pass" or dense transform of encoder states)
+and the chatbot example's greedy ``infer`` loop.
+
+TPU-first: encoder and (teacher-forced) decoder are each ONE ``lax.scan``
+— training is a single fused program; greedy inference re-uses the
+decoder's per-step cell inside another ``lax.scan`` over generated tokens
+(static ``max_seq_len``, no data-dependent Python loop).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from analytics_zoo_tpu.models.common import ZooModel, register_model
+from analytics_zoo_tpu.nn import initializers
+from analytics_zoo_tpu.nn.layers.core import Dense
+from analytics_zoo_tpu.nn.layers.embedding import Embedding
+from analytics_zoo_tpu.nn.layers.recurrent import GRU, LSTM, RNNBase
+from analytics_zoo_tpu.nn.module import Layer, StatelessLayer, split_rng
+from analytics_zoo_tpu.nn.topology import KerasNet
+
+
+def _make_cell(rnn_type: str, hidden: int, name: str) -> RNNBase:
+    rnn_type = rnn_type.lower()
+    if rnn_type == "lstm":
+        return LSTM(hidden, return_sequences=True, name=name)
+    if rnn_type == "gru":
+        return GRU(hidden, return_sequences=True, name=name)
+    raise ValueError(f"unknown rnn_type {rnn_type!r}; known: lstm, gru")
+
+
+class _StackedRNN(StatelessLayer):
+    """Shared stacked-cell construction/params for encoder and decoder."""
+
+    def __init__(self, rnn_type: str = "lstm", num_layers: int = 1,
+                 hidden_size: int = 128, **kw):
+        super().__init__(**kw)
+        self.cells = [_make_cell(rnn_type, hidden_size,
+                                 f"{self.name}_l{i}")
+                      for i in range(num_layers)]
+
+    def build_params(self, rng, input_shape):
+        params = {}
+        shape = tuple(input_shape)
+        for cell, r in zip(self.cells, split_rng(rng, len(self.cells))):
+            params[cell.name] = cell.build_params(r, shape)
+            shape = shape[:-1] + (cell.output_dim,)
+        return params
+
+
+class RNNEncoder(_StackedRNN):
+    """Stacked RNN encoder returning (sequence_output, final_states)
+    (reference models/seq2seq/RNNEncoder.scala)."""
+
+    def forward(self, params, x, training=False, rng=None):
+        states = []
+        for cell in self.cells:
+            x, st = cell.run(params[cell.name], x, return_state=True)
+            states.append(st)
+        return [x, states]
+
+
+class Bridge(StatelessLayer):
+    """Transform encoder final states into decoder initial states
+    (reference models/seq2seq/Bridge.scala: "pass" | "dense")."""
+
+    def __init__(self, bridge_type: str = "pass",
+                 decoder_hidden_size: Optional[int] = None, **kw):
+        super().__init__(**kw)
+        if bridge_type not in ("pass", "dense"):
+            raise ValueError(
+                f"unknown bridge_type {bridge_type!r}; known: pass, dense")
+        self.bridge_type = bridge_type
+        self.decoder_hidden_size = decoder_hidden_size
+        self.initializer = initializers.get("glorot_uniform")
+
+    def build_state_params(self, rng, states):
+        """Allocate dense kernels sized from a concrete states pytree."""
+        if self.bridge_type == "pass":
+            return {}
+        leaves = jax.tree_util.tree_leaves(states)
+        ks = jax.random.split(rng, len(leaves))
+        out = {}
+        for i, (leaf, k) in enumerate(zip(leaves, ks)):
+            d_in = leaf.shape[-1]
+            d_out = self.decoder_hidden_size or d_in
+            out[f"w{i}"] = self.initializer(k, (d_in, d_out), jnp.float32)
+            out[f"b{i}"] = jnp.zeros((d_out,), jnp.float32)
+        return out
+
+    def apply_states(self, params, states):
+        if self.bridge_type == "pass":
+            return states
+        leaves, treedef = jax.tree_util.tree_flatten(states)
+        new = [jnp.tanh(leaf @ params[f"w{i}"] + params[f"b{i}"])
+               for i, leaf in enumerate(leaves)]
+        return jax.tree_util.tree_unflatten(treedef, new)
+
+
+class RNNDecoder(_StackedRNN):
+    """Stacked RNN decoder consuming initial states per layer
+    (reference models/seq2seq/RNNDecoder.scala)."""
+
+    def run_with_states(self, params, x, init_states,
+                        return_state: bool = False):
+        states = []
+        for cell, st in zip(self.cells, init_states):
+            x, new_st = cell.run(params[cell.name], x, initial_carry=st,
+                                 return_state=True)
+            states.append(new_st)
+        if return_state:
+            return x, states
+        return x
+
+    def forward(self, params, x, training=False, rng=None):
+        return self.run_with_states(
+            params, x, [None] * len(self.cells))
+
+
+class Seq2seqNet(KerasNet):
+    """The jittable seq2seq program: ids → embed → encode → bridge →
+    teacher-forced decode → vocab logits."""
+
+    @property
+    def layers(self):
+        return [self.embedding, self.encoder, self.bridge, self.decoder,
+                self.generator]
+
+    def __init__(self, vocab_size: int, embed_dim: int, rnn_type: str,
+                 num_layers: int, hidden_size: int, bridge_type: str,
+                 **kw):
+        super().__init__(**kw)
+        self.vocab_size = vocab_size
+        self.embedding = Embedding(vocab_size, embed_dim,
+                                   name=f"{self.name}_embed")
+        self.encoder = RNNEncoder(rnn_type, num_layers, hidden_size,
+                                  name=f"{self.name}_enc")
+        self.decoder = RNNDecoder(rnn_type, num_layers, hidden_size,
+                                  name=f"{self.name}_dec")
+        self.bridge = Bridge(bridge_type, hidden_size,
+                             name=f"{self.name}_bridge")
+        self.generator = Dense(vocab_size, name=f"{self.name}_gen")
+
+    def build(self, rng, enc_shape, dec_shape):
+        k_e, k_enc, k_dec, k_b, k_g = jax.random.split(rng, 5)
+        params = {
+            "embed": self.embedding.build_params(k_e, enc_shape),
+            "enc": self.encoder.build_params(
+                k_enc, tuple(enc_shape) + (self.embedding.output_dim,)),
+            "dec": self.decoder.build_params(
+                k_dec, tuple(dec_shape) + (self.embedding.output_dim,)),
+        }
+        # size bridge kernels from real encoder state shapes
+        dummy = jnp.zeros((2,) + tuple(enc_shape)[1:], jnp.int32)
+        emb = self.embedding.forward(params["embed"], dummy)
+        _, states = self.encoder.forward(params["enc"], emb)
+        params["bridge"] = self.bridge.build_state_params(k_b, states)
+        params["gen"] = self.generator.build_params(
+            k_g, (2, self.decoder.cells[-1].output_dim))
+        return params, {}
+
+    def call(self, params, state, enc_ids, dec_ids, training=False,
+             rng=None):
+        enc_emb = self.embedding.forward(params["embed"], enc_ids)
+        dec_emb = self.embedding.forward(params["embed"], dec_ids)
+        _, enc_states = self.encoder.forward(params["enc"], enc_emb)
+        init_states = self.bridge.apply_states(params["bridge"], enc_states)
+        dec_out = self.decoder.run_with_states(params["dec"], dec_emb,
+                                               init_states)
+        logits = self.generator.forward(params["gen"], dec_out)
+        return logits, state
+
+    # -- greedy inference --------------------------------------------------
+    def infer(self, params, enc_ids, start_sign: int, max_seq_len: int,
+              stop_sign: Optional[int] = None) -> jnp.ndarray:
+        """Greedy decode (reference Seq2seq.infer / chatbot example):
+        feed <start>, repeatedly take argmax, for ``max_seq_len`` steps —
+        one lax.scan, fixed shapes.  With ``stop_sign``, positions after a
+        sequence emits the stop token are padded with it (the scan still
+        runs max_seq_len steps — static shape — but post-stop logits no
+        longer leak into the output)."""
+        enc_emb = self.embedding.forward(params["embed"], enc_ids)
+        _, enc_states = self.encoder.forward(params["enc"], enc_emb)
+        states = self.bridge.apply_states(params["bridge"], enc_states)
+        b = enc_ids.shape[0]
+        tok0 = jnp.full((b, 1), start_sign, jnp.int32)
+        done0 = jnp.zeros((b,), bool)
+
+        def step(carry, _):
+            tok, states, done = carry
+            emb = self.embedding.forward(params["embed"], tok)  # (B,1,E)
+            out, new_states = self.decoder.run_with_states(
+                params["dec"], emb, states, return_state=True)
+            logits = self.generator.forward(params["gen"], out[:, -1])
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            if stop_sign is not None:
+                nxt = jnp.where(done, jnp.int32(stop_sign), nxt)
+                done = done | (nxt == stop_sign)
+            return (nxt[:, None], new_states, done), nxt
+
+        (_, _, _), toks = jax.lax.scan(step, (tok0, states, done0), None,
+                                       length=max_seq_len)
+        return toks.swapaxes(0, 1)  # (B, max_seq_len)
+
+
+@register_model
+class Seq2seq(ZooModel):
+    """Sequence-to-sequence ZooModel (reference models/seq2seq/Seq2seq.scala).
+
+    fit() takes ``[encoder_ids, decoder_ids]`` (teacher forcing) with
+    targets = decoder ids shifted left; ``infer`` greedy-decodes.
+    """
+
+    def __init__(self, vocab_size: int, embed_dim: int = 64,
+                 rnn_type: str = "lstm", num_layers: int = 1,
+                 hidden_size: int = 128, bridge_type: str = "pass"):
+        super().__init__()
+        self.vocab_size = vocab_size
+        self.embed_dim = embed_dim
+        self.rnn_type = rnn_type
+        self.num_layers = num_layers
+        self.hidden_size = hidden_size
+        self.bridge_type = bridge_type
+        self.model = Seq2seqNet(vocab_size, embed_dim, rnn_type, num_layers,
+                                hidden_size, bridge_type, name="seq2seq")
+
+    def config(self):
+        return {"vocab_size": self.vocab_size, "embed_dim": self.embed_dim,
+                "rnn_type": self.rnn_type, "num_layers": self.num_layers,
+                "hidden_size": self.hidden_size,
+                "bridge_type": self.bridge_type}
+
+    def infer(self, enc_ids: np.ndarray, start_sign: int,
+              max_seq_len: int = 30,
+              stop_sign: Optional[int] = None) -> np.ndarray:
+        est = self.model.estimator
+        est._ensure_built([np.asarray(enc_ids),
+                           np.asarray(enc_ids)])  # dec shape == enc shape ok
+        if not hasattr(self, "_infer_jit"):
+            # one persistent jit cache — re-wrapping the bound method per
+            # call would recompile the whole decode program every time
+            self._infer_jit = jax.jit(self.model.infer,
+                                      static_argnums=(2, 3, 4))
+        out = self._infer_jit(est.params, jnp.asarray(enc_ids), start_sign,
+                              max_seq_len, stop_sign)
+        return np.asarray(out)

@@ -59,22 +59,30 @@ class RNNBase(StatelessLayer):
     def _init_carry(self, batch):
         return jnp.zeros((batch, self.output_dim), jnp.float32)
 
-    def forward(self, params, x, training=False, rng=None):
+    def run(self, params, x, initial_carry=None, return_state: bool = False):
+        """Scan over time with an optional initial carry — the seq2seq
+        decoder hook (models/seq2seq.py feeds bridge states here)."""
         b, t, f = x.shape
         if self.go_backwards:
             x = jnp.flip(x, axis=1)
         # hoist the input projection out of the scan: one MXU matmul
         zx = (x.reshape(b * t, f) @ params["kernel"] + params["bias"]) \
             .reshape(b, t, -1).swapaxes(0, 1)  # (T, B, G*H)
-        carry = self._init_carry(b)
+        carry = (initial_carry if initial_carry is not None
+                 else self._init_carry(b))
 
         def step(carry, z):
             return self._step(params, carry, z)
 
         last, ys = jax.lax.scan(step, carry, zx)
-        if self.return_sequences:
-            return ys.swapaxes(0, 1)  # (B, T, H)
-        return self._carry_output(last)
+        out = ys.swapaxes(0, 1) if self.return_sequences \
+            else self._carry_output(last)
+        if return_state:
+            return out, last
+        return out
+
+    def forward(self, params, x, training=False, rng=None):
+        return self.run(params, x)
 
     def _carry_output(self, carry):
         return carry

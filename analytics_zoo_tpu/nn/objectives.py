@@ -19,20 +19,37 @@ LossFn = Callable[[jnp.ndarray, jnp.ndarray], jnp.ndarray]
 _EPS = 1e-7
 
 
+def _align(y_true, y_pred):
+    """Match a (B,) target against a (B, 1) prediction (and vice versa) so
+    elementwise losses never silently broadcast to (B, B)."""
+    y_true = jnp.asarray(y_true)
+    if (y_pred.ndim == y_true.ndim + 1 and y_pred.shape[-1] == 1
+            and y_pred.shape[:-1] == y_true.shape):
+        y_pred = y_pred[..., 0]
+    elif (y_true.ndim == y_pred.ndim + 1 and y_true.shape[-1] == 1
+            and y_true.shape[:-1] == y_pred.shape):
+        y_true = y_true[..., 0]
+    return y_true, y_pred
+
+
 def mean_squared_error(y_true, y_pred):
+    y_true, y_pred = _align(y_true, y_pred)
     return jnp.mean(jnp.square(y_pred - y_true))
 
 
 def mean_absolute_error(y_true, y_pred):
+    y_true, y_pred = _align(y_true, y_pred)
     return jnp.mean(jnp.abs(y_pred - y_true))
 
 
 def mean_absolute_percentage_error(y_true, y_pred):
+    y_true, y_pred = _align(y_true, y_pred)
     diff = jnp.abs((y_true - y_pred) / jnp.clip(jnp.abs(y_true), _EPS, None))
     return 100.0 * jnp.mean(diff)
 
 
 def mean_squared_logarithmic_error(y_true, y_pred):
+    y_true, y_pred = _align(y_true, y_pred)
     a = jnp.log(jnp.clip(y_pred, _EPS, None) + 1.0)
     b = jnp.log(jnp.clip(y_true, _EPS, None) + 1.0)
     return jnp.mean(jnp.square(a - b))
@@ -40,12 +57,14 @@ def mean_squared_logarithmic_error(y_true, y_pred):
 
 def binary_crossentropy(y_true, y_pred):
     """y_pred are probabilities in (0, 1) (post-sigmoid), Keras semantics."""
+    y_true, y_pred = _align(y_true, y_pred)
     p = jnp.clip(y_pred, _EPS, 1.0 - _EPS)
     return -jnp.mean(y_true * jnp.log(p) + (1.0 - y_true) * jnp.log1p(-p))
 
 
 def binary_crossentropy_with_logits(y_true, logits):
     """Numerically stable BCE on logits (preferred on TPU)."""
+    y_true, logits = _align(y_true, logits)
     return jnp.mean(
         jnp.maximum(logits, 0) - logits * y_true + jnp.log1p(jnp.exp(-jnp.abs(logits))))
 
@@ -56,29 +75,43 @@ def categorical_crossentropy(y_true, y_pred):
     return -jnp.mean(jnp.sum(y_true * jnp.log(p), axis=-1))
 
 
+def _sparse_labels(y_true, preds):
+    """Integer labels matching preds' leading dims: supports (B,) vs
+    (B, C), (B, 1) vs (B, C), and sequence targets (B, T) vs (B, T, C)."""
+    labels = y_true.astype(jnp.int32)
+    if labels.ndim == preds.ndim:          # trailing singleton
+        labels = labels[..., 0]
+    if labels.shape != preds.shape[:-1]:
+        raise ValueError(
+            f"label shape {labels.shape} incompatible with predictions "
+            f"{preds.shape}")
+    return labels
+
+
 def sparse_categorical_crossentropy(y_true, y_pred, zero_based_label=True):
     """Integer targets vs probability outputs
     (reference SparseCategoricalCrossEntropy, 0/1-based switch)."""
-    labels = y_true.astype(jnp.int32).reshape(y_true.shape[0], -1)[:, 0]
+    labels = _sparse_labels(y_true, y_pred)
     if not zero_based_label:
         labels = labels - 1
     p = jnp.clip(y_pred, _EPS, 1.0)
-    ll = jnp.take_along_axis(jnp.log(p), labels[:, None], axis=-1)
+    ll = jnp.take_along_axis(jnp.log(p), labels[..., None], axis=-1)
     return -jnp.mean(ll)
 
 
 def sparse_categorical_crossentropy_with_logits(y_true, logits):
-    """Integer targets vs raw logits (fused log-softmax; stable + fast)."""
-    labels = y_true.astype(jnp.int32).reshape(y_true.shape[0], -1)[:, 0]
+    """Integer targets vs raw logits (fused log-softmax; stable + fast).
+    Sequence targets (B, T) vs (B, T, V) are averaged over all positions."""
+    labels = _sparse_labels(y_true, logits)
     logp = jax.nn.log_softmax(logits, axis=-1)
-    ll = jnp.take_along_axis(logp, labels[:, None], axis=-1)
+    ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)
     return -jnp.mean(ll)
 
 
 def class_nll(y_true, log_probs):
     """NLL on log-probabilities (reference ClassNLLCriterion, 197 LoC)."""
-    labels = y_true.astype(jnp.int32).reshape(y_true.shape[0], -1)[:, 0]
-    ll = jnp.take_along_axis(log_probs, labels[:, None], axis=-1)
+    labels = _sparse_labels(y_true, log_probs)
+    ll = jnp.take_along_axis(log_probs, labels[..., None], axis=-1)
     return -jnp.mean(ll)
 
 
@@ -89,6 +122,7 @@ def kullback_leibler_divergence(y_true, y_pred):
 
 
 def poisson(y_true, y_pred):
+    y_true, y_pred = _align(y_true, y_pred)
     return jnp.mean(y_pred - y_true * jnp.log(y_pred + _EPS))
 
 
@@ -99,10 +133,12 @@ def cosine_proximity(y_true, y_pred):
 
 
 def hinge(y_true, y_pred):
+    y_true, y_pred = _align(y_true, y_pred)
     return jnp.mean(jnp.maximum(1.0 - y_true * y_pred, 0.0))
 
 
 def squared_hinge(y_true, y_pred):
+    y_true, y_pred = _align(y_true, y_pred)
     return jnp.mean(jnp.square(jnp.maximum(1.0 - y_true * y_pred, 0.0)))
 
 

@@ -1,0 +1,152 @@
+"""Seq2seq + AnomalyDetector model tests."""
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.models.anomalydetection import (
+    AnomalyDetector, detect_anomalies, unroll)
+from analytics_zoo_tpu.models.seq2seq import Bridge, Seq2seq
+from analytics_zoo_tpu.train.optimizers import Adam
+
+
+class TestSeq2seq:
+    def _data(self, n=64, t=6, vocab=12, seed=0):
+        """Copy task: decoder must reproduce the encoder sequence."""
+        rs = np.random.RandomState(seed)
+        src = rs.randint(2, vocab, (n, t)).astype(np.int32)
+        # decoder input: <start>=1 + target shifted right
+        dec_in = np.concatenate(
+            [np.ones((n, 1), np.int32), src[:, :-1]], axis=1)
+        return src, dec_in, src  # (enc_in, dec_in, target)
+
+    @pytest.mark.parametrize("rnn_type,bridge", [("lstm", "pass"),
+                                                 ("gru", "dense")])
+    def test_forward_shape(self, rnn_type, bridge):
+        m = Seq2seq(vocab_size=12, embed_dim=8, rnn_type=rnn_type,
+                    num_layers=2, hidden_size=16, bridge_type=bridge)
+        m.compile(optimizer=Adam(1e-3),
+                  loss="sparse_categorical_crossentropy_with_logits")
+        enc, dec, _ = self._data(n=4)
+        out = m.predict([enc, dec], batch_size=4)
+        assert out.shape == (4, 6, 12)
+
+    def test_learns_copy_task(self):
+        m = Seq2seq(vocab_size=12, embed_dim=16, rnn_type="lstm",
+                    num_layers=1, hidden_size=32)
+        m.compile(optimizer=Adam(1e-2),
+                  loss="sparse_categorical_crossentropy_with_logits")
+        enc, dec, tgt = self._data(n=128, t=4)
+        hist = m.fit([enc, dec], tgt, batch_size=32, nb_epoch=10,
+                     verbose=False)
+        assert hist[-1]["loss"] < hist[0]["loss"] * 0.7, (
+            hist[0]["loss"], hist[-1]["loss"])
+
+    def test_greedy_infer_shapes_and_determinism(self):
+        m = Seq2seq(vocab_size=10, embed_dim=8, hidden_size=16)
+        m.compile(optimizer=Adam(1e-3),
+                  loss="sparse_categorical_crossentropy_with_logits")
+        enc = np.random.randint(2, 10, (3, 5)).astype(np.int32)
+        out1 = m.infer(enc, start_sign=1, max_seq_len=7)
+        out2 = m.infer(enc, start_sign=1, max_seq_len=7)
+        assert out1.shape == (3, 7)
+        np.testing.assert_array_equal(out1, out2)
+        assert out1.dtype == np.int32
+
+    def test_infer_stop_sign_pads_after_stop(self):
+        m = Seq2seq(vocab_size=10, embed_dim=8, hidden_size=16)
+        m.compile(optimizer=Adam(1e-3),
+                  loss="sparse_categorical_crossentropy_with_logits")
+        enc = np.random.randint(2, 10, (4, 5)).astype(np.int32)
+        out = m.infer(enc, start_sign=1, max_seq_len=12, stop_sign=3)
+        for row in out:
+            hits = np.nonzero(row == 3)[0]
+            if hits.size:  # every position after the first stop is stop
+                assert (row[hits[0]:] == 3).all()
+
+    def test_bad_bridge_raises(self):
+        with pytest.raises(ValueError):
+            Bridge(bridge_type="quantum")
+
+    def test_save_load(self, tmp_path):
+        from analytics_zoo_tpu.models.common import ZooModel
+        m = Seq2seq(vocab_size=10, embed_dim=8, hidden_size=16)
+        m.compile(optimizer=Adam(1e-3),
+                  loss="sparse_categorical_crossentropy_with_logits")
+        enc, dec, _ = self._data(n=4, t=4, vocab=10)
+        p1 = m.predict([enc, dec], batch_size=4)
+        m.save_model(str(tmp_path / "s2s"))
+        m2 = ZooModel.load_model(str(tmp_path / "s2s"))
+        m2.compile(optimizer=Adam(1e-3),
+                   loss="sparse_categorical_crossentropy_with_logits")
+        p2 = m2.predict([enc, dec], batch_size=4)
+        np.testing.assert_allclose(p1, p2, rtol=1e-5, atol=1e-6)
+
+
+class TestUnroll:
+    def test_windows_and_targets(self):
+        data = np.arange(10, dtype=np.float32)
+        x, y = unroll(data, unroll_length=3)
+        assert x.shape == (7, 3, 1)
+        np.testing.assert_allclose(x[0, :, 0], [0, 1, 2])
+        np.testing.assert_allclose(y, [3, 4, 5, 6, 7, 8, 9])
+
+    def test_multivariate(self):
+        data = np.random.randn(20, 4).astype(np.float32)
+        x, y = unroll(data, 5)
+        assert x.shape == (15, 5, 4)
+        np.testing.assert_allclose(y, data[5:, 0])
+
+    def test_too_short_raises(self):
+        with pytest.raises(ValueError):
+            unroll(np.arange(3), 5)
+
+
+class TestDetect:
+    def test_top_k(self):
+        y = np.zeros(10)
+        pred = np.zeros(10)
+        pred[[3, 7]] = 5.0
+        idx = detect_anomalies(y, pred, anomaly_size=2)
+        assert set(idx) == {3, 7}
+
+    def test_threshold(self):
+        y = np.zeros(5)
+        pred = np.array([0.1, 2.0, 0.2, 3.0, 0.0])
+        idx = detect_anomalies(y, pred, threshold=1.0)
+        assert set(idx) == {1, 3}
+
+
+class TestAnomalyDetector:
+    def test_trains_on_sine_and_flags_spike(self):
+        t = np.arange(400, dtype=np.float32)
+        series = np.sin(t * 0.1)
+        x, y = unroll(series, unroll_length=10)
+        m = AnomalyDetector(feature_shape=(10, 1), hidden_layers=(16, 8),
+                            dropouts=(0.1, 0.1))
+        m.compile(optimizer=Adam(1e-2), loss="mse")
+        m.fit(x, y, batch_size=64, nb_epoch=5, verbose=False)
+        # inject a spike into held-out continuation
+        series2 = np.sin((np.arange(80) + 400) * 0.1)
+        series2[40] = 5.0
+        x2, y2 = unroll(series2, unroll_length=10)
+        pred = m.predict(x2, batch_size=64)[:, 0]
+        idx = m.detect_anomalies(y2, pred, anomaly_size=1)
+        # the spike lands at window index 40 - 10 = 30
+        assert idx[0] == 30, (idx, np.abs(y2 - pred).argmax())
+
+    def test_config_validation(self):
+        with pytest.raises(ValueError):
+            AnomalyDetector((10, 1), hidden_layers=(8, 8), dropouts=(0.1,))
+
+    def test_save_load(self, tmp_path):
+        from analytics_zoo_tpu.models.common import ZooModel
+        m = AnomalyDetector(feature_shape=(5, 1), hidden_layers=(8,),
+                            dropouts=(0.1,))
+        m.compile(optimizer=Adam(1e-3), loss="mse")
+        x = np.random.randn(8, 5, 1).astype(np.float32)
+        p1 = m.predict(x, batch_size=8)
+        m.save_model(str(tmp_path / "ad"))
+        m2 = ZooModel.load_model(str(tmp_path / "ad"))
+        m2.compile(optimizer=Adam(1e-3), loss="mse")
+        np.testing.assert_allclose(p1, m2.predict(x, batch_size=8),
+                                   rtol=1e-5, atol=1e-6)

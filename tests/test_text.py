@@ -1,0 +1,198 @@
+"""Text pipeline + text model tests."""
+
+import os
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.data.text import TextSet, load_glove_embeddings
+from analytics_zoo_tpu.models.text import (
+    KNRM, Ranker, TextClassifier, mean_average_precision, ndcg)
+from analytics_zoo_tpu.train.optimizers import Adam
+
+
+class TestTextSet:
+    def test_pipeline_stages(self):
+        ts = TextSet.from_texts(
+            ["Hello World hello", "the quick brown Fox", "hello fox"],
+            labels=[0, 1, 0])
+        ts = ts.tokenize().normalize().word2idx().shape_sequence(len=5)
+        x, y = ts.generate_sample().to_arrays()
+        assert x.shape == (3, 5) and x.dtype == np.int32
+        np.testing.assert_array_equal(y, [0, 1, 0])
+        # "hello" appears 3x → id 1 (most frequent first)
+        assert ts.word_index["hello"] == 1
+
+    def test_word2idx_options(self):
+        ts = TextSet.from_texts(["a a a b b c"]).tokenize()
+        t1 = ts.word2idx(remove_topN=1)
+        assert "a" not in t1.word_index
+        t2 = ts.word2idx(max_words_num=2)
+        assert len(t2.word_index) == 2
+        t3 = ts.word2idx(min_freq=2)
+        assert "c" not in t3.word_index
+
+    def test_existing_vocab_and_unk(self):
+        vocab = {"known": 1}
+        ts = TextSet.from_texts(["known unknown"]).tokenize().word2idx(
+            existing_map=vocab)
+        assert ts.features[0]["indexed"] == [1, 0]  # unk -> 0
+
+    def test_shape_sequence_modes(self):
+        ts = TextSet.from_texts(["a b c d"]).tokenize().word2idx()
+        pre = ts.shape_sequence(len=2).features[0]["indexed"]
+        post = ts.shape_sequence(len=2, trunc_mode="post").features[0]["indexed"]
+        assert len(pre) == 2 and len(post) == 2
+        padded = ts.shape_sequence(len=6).features[0]["indexed"]
+        assert padded[:2] == [0, 0]
+
+    def test_read_folder(self, tmp_path):
+        for cls, texts in [("neg", ["bad movie", "awful"]),
+                           ("pos", ["great film"])]:
+            d = tmp_path / cls
+            d.mkdir()
+            for i, t in enumerate(texts):
+                (d / f"{i}.txt").write_text(t)
+        ts = TextSet.read(str(tmp_path))
+        assert len(ts) == 3
+        assert ts.label_map == {"neg": 0, "pos": 1}
+
+    def test_read_csv(self, tmp_path):
+        p = tmp_path / "data.csv"
+        p.write_text("uid,text,label\n1,hello world,0\n2,foo bar,1\n")
+        ts = TextSet.read_csv(str(p))
+        assert len(ts) == 2
+        assert ts.features[0].text == "hello world"
+        assert ts.features[1]["label"] == 1
+
+    def test_word_index_roundtrip(self, tmp_path):
+        ts = TextSet.from_texts(["x y z"]).tokenize().word2idx()
+        path = str(tmp_path / "vocab.json")
+        ts.save_word_index(path)
+        assert TextSet.load_word_index(path) == ts.word_index
+
+    def test_glove_loading(self, tmp_path):
+        p = tmp_path / "glove.txt"
+        p.write_text("hello 1.0 2.0 3.0\nworld 4.0 5.0 6.0\n")
+        table = load_glove_embeddings(str(p), {"hello": 1, "absent": 2})
+        np.testing.assert_allclose(table[1], [1, 2, 3])
+        np.testing.assert_allclose(table[2], 0.0)  # absent stays zero
+        np.testing.assert_allclose(table[0], 0.0)  # pad row
+
+    def test_glove_dim_mismatch_raises(self, tmp_path):
+        p = tmp_path / "glove.txt"
+        p.write_text("hello 1.0 2.0 3.0\n")
+        with pytest.raises(ValueError):
+            load_glove_embeddings(str(p), {"hello": 1}, dim=100)
+        with pytest.raises(ValueError):  # no vocab overlap at all
+            load_glove_embeddings(str(p), {"zebra": 1})
+
+
+class TestTextClassifier:
+    @pytest.mark.parametrize("encoder", ["cnn", "lstm", "gru"])
+    def test_forward_shapes(self, encoder):
+        clf = TextClassifier(class_num=3, token_length=16,
+                             sequence_length=20, encoder=encoder,
+                             encoder_output_dim=8, max_words_num=50)
+        clf.compile(optimizer=Adam(1e-3),
+                    loss="sparse_categorical_crossentropy_with_logits")
+        x = np.random.randint(0, 51, (4, 20)).astype(np.int32)
+        out = clf.predict(x, batch_size=4)
+        assert out.shape == (4, 3)
+
+    def test_unknown_encoder_raises(self):
+        with pytest.raises(ValueError):
+            TextClassifier(class_num=2, encoder="transformermagic")
+
+    def test_cnn_learns(self):
+        clf = TextClassifier(class_num=2, token_length=16,
+                             sequence_length=12, encoder="cnn",
+                             encoder_output_dim=16, max_words_num=20)
+        clf.compile(optimizer=Adam(1e-2),
+                    loss="sparse_categorical_crossentropy_with_logits",
+                    metrics=["accuracy"])
+        rs = np.random.RandomState(0)
+        x = rs.randint(1, 21, (64, 12)).astype(np.int32)
+        y = (x[:, 0] > 10).astype(np.int32)
+        x[:, 5] = np.where(y == 1, 3, 7)  # planted signal token
+        clf.fit(x, y, batch_size=16, nb_epoch=6, verbose=False)
+        res = clf.evaluate(x, y, batch_size=16)
+        assert res["accuracy"] > 0.85, res
+
+
+class TestKNRM:
+    def test_kernel_num_guard(self):
+        with pytest.raises(ValueError):
+            KNRM(text1_length=5, text2_length=10, kernel_num=1)
+
+    def test_forward_shape_and_score_range(self):
+        m = KNRM(text1_length=5, text2_length=10, max_words_num=30,
+                 embed_size=8, kernel_num=11,
+                 target_mode="classification")
+        m.compile(optimizer=Adam(1e-3), loss="binary_crossentropy")
+        q = np.random.randint(0, 31, (6, 5)).astype(np.int32)
+        d = np.random.randint(0, 31, (6, 10)).astype(np.int32)
+        out = m.predict([q, d], batch_size=6)
+        assert out.shape == (6, 1)
+        assert (out >= 0).all() and (out <= 1).all()
+
+    def test_exact_match_scores_higher(self):
+        """A doc repeating the query tokens must outscore a random doc
+        after brief training on that objective."""
+        m = KNRM(text1_length=4, text2_length=8, max_words_num=20,
+                 embed_size=8, kernel_num=11, target_mode="classification")
+        m.compile(optimizer=Adam(5e-2), loss="binary_crossentropy")
+        rs = np.random.RandomState(0)
+        n = 64
+        q = rs.randint(1, 21, (n, 4)).astype(np.int32)
+        d_pos = np.concatenate([q, q], axis=1)
+        d_neg = rs.randint(1, 21, (n, 8)).astype(np.int32)
+        qq = np.concatenate([q, q])
+        dd = np.concatenate([d_pos, d_neg])
+        yy = np.concatenate([np.ones(n), np.zeros(n)]).astype(np.float32)
+        m.fit([qq, dd], yy, batch_size=32, nb_epoch=5, verbose=False)
+        s_pos = m.predict([q, d_pos], batch_size=32).mean()
+        s_neg = m.predict([q, d_neg], batch_size=32).mean()
+        assert s_pos > s_neg, (s_pos, s_neg)
+
+    def test_save_load(self, tmp_path):
+        from analytics_zoo_tpu.models.common import ZooModel
+        m = KNRM(text1_length=3, text2_length=4, max_words_num=10,
+                 embed_size=4, kernel_num=5)
+        m.compile(optimizer=Adam(1e-3), loss="mse")
+        q = np.random.randint(0, 11, (2, 3)).astype(np.int32)
+        d = np.random.randint(0, 11, (2, 4)).astype(np.int32)
+        p1 = m.predict([q, d], batch_size=2)
+        m.save_model(str(tmp_path / "knrm"))
+        m2 = ZooModel.load_model(str(tmp_path / "knrm"))
+        m2.compile(optimizer=Adam(1e-3), loss="mse")
+        p2 = m2.predict([q, d], batch_size=2)
+        np.testing.assert_allclose(p1, p2, rtol=1e-5, atol=1e-6)
+
+
+class TestRanking:
+    def test_ndcg_perfect_and_inverted(self):
+        y = np.array([3, 2, 1, 0])
+        assert ndcg(y, np.array([4, 3, 2, 1])) == pytest.approx(1.0)
+        assert ndcg(y, np.array([1, 2, 3, 4])) < 1.0
+
+    def test_ndcg_cutoff(self):
+        y = np.array([0, 0, 1])
+        # relevant doc ranked beyond k → 0
+        assert ndcg(y, np.array([3, 2, 1]), k=2) == 0.0
+
+    def test_map(self):
+        y = np.array([1, 0, 1, 0])
+        s = np.array([4, 3, 2, 1])  # relevant at ranks 1 and 3
+        expected = (1.0 + 2.0 / 3.0) / 2.0
+        assert mean_average_precision(y, s) == pytest.approx(expected)
+        assert mean_average_precision(np.zeros(3), np.arange(3)) == 0.0
+
+    def test_ranker_groups_by_query(self):
+        qids = [0, 0, 1, 1]
+        labels = [1, 0, 0, 1]
+        scores = [2.0, 1.0, 2.0, 1.0]  # q0 perfect, q1 inverted
+        m = Ranker.evaluate_map(qids, labels, scores)
+        assert m == pytest.approx((1.0 + 0.5) / 2)
+        n = Ranker.evaluate_ndcg(qids, labels, scores, k=5)
+        assert 0 < n < 1
