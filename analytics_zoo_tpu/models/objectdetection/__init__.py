@@ -1,0 +1,27 @@
+from analytics_zoo_tpu.models.objectdetection.bbox import (  # noqa: F401
+    decode_boxes,
+    encode_boxes,
+    generate_priors,
+    iou_matrix,
+    match_priors,
+)
+from analytics_zoo_tpu.models.objectdetection.nms import (  # noqa: F401
+    batched_class_nms,
+    nms,
+)
+from analytics_zoo_tpu.models.objectdetection.loss import (  # noqa: F401
+    MultiBoxLoss,
+    multibox_loss,
+    smooth_l1,
+)
+from analytics_zoo_tpu.models.objectdetection.ssd import (  # noqa: F401
+    SSD300_CONFIG,
+    ObjectDetector,
+    SSDTargetAssigner,
+    build_ssd,
+)
+from analytics_zoo_tpu.models.objectdetection.evaluation import (  # noqa: F401
+    MeanAveragePrecision,
+    PascalVocEvaluator,
+    average_precision,
+)

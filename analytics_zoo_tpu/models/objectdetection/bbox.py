@@ -1,0 +1,131 @@
+"""Bounding-box utilities: IoU, prior (anchor) generation, encode/decode.
+
+Reference capability: models/image/objectdetection/common/BboxUtil.scala
+(1,033 LoC: bboxTransform/decode with variances, jaccard overlap, prior
+matching) and ssd/PriorBox generation.
+
+TPU-first: everything is vectorized jnp over fixed-size arrays — the IoU
+matrix is one broadcasted min/max block, encode/decode are elementwise —
+so the whole detection head stays inside one XLA program.  Boxes are
+(x1, y1, x2, y2) normalized to [0, 1] throughout.
+"""
+
+from __future__ import annotations
+
+import itertools
+import math
+from typing import List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def iou_matrix(a, b):
+    """Pairwise IoU. a (N, 4), b (M, 4) → (N, M)."""
+    a = jnp.asarray(a)
+    b = jnp.asarray(b)
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0.0, None)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = jnp.clip(a[:, 2] - a[:, 0], 0, None) * \
+        jnp.clip(a[:, 3] - a[:, 1], 0, None)
+    area_b = jnp.clip(b[:, 2] - b[:, 0], 0, None) * \
+        jnp.clip(b[:, 3] - b[:, 1], 0, None)
+    union = area_a[:, None] + area_b[None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+def _corner_to_center(boxes):
+    cx = (boxes[..., 0] + boxes[..., 2]) / 2.0
+    cy = (boxes[..., 1] + boxes[..., 3]) / 2.0
+    w = boxes[..., 2] - boxes[..., 0]
+    h = boxes[..., 3] - boxes[..., 1]
+    return cx, cy, w, h
+
+
+def encode_boxes(matched, priors, variances=(0.1, 0.2)):
+    """gt corner boxes → loc regression targets relative to priors
+    (reference BboxUtil encodeBoxes with SSD variances)."""
+    gcx, gcy, gw, gh = _corner_to_center(matched)
+    pcx, pcy, pw, ph = _corner_to_center(priors)
+    eps = 1e-8
+    dx = (gcx - pcx) / jnp.maximum(pw, eps) / variances[0]
+    dy = (gcy - pcy) / jnp.maximum(ph, eps) / variances[0]
+    dw = jnp.log(jnp.maximum(gw, eps) / jnp.maximum(pw, eps)) / variances[1]
+    dh = jnp.log(jnp.maximum(gh, eps) / jnp.maximum(ph, eps)) / variances[1]
+    return jnp.stack([dx, dy, dw, dh], axis=-1)
+
+
+def decode_boxes(loc, priors, variances=(0.1, 0.2)):
+    """loc predictions → corner boxes (inverse of encode_boxes)."""
+    pcx, pcy, pw, ph = _corner_to_center(priors)
+    cx = loc[..., 0] * variances[0] * pw + pcx
+    cy = loc[..., 1] * variances[0] * ph + pcy
+    w = pw * jnp.exp(loc[..., 2] * variances[1])
+    h = ph * jnp.exp(loc[..., 3] * variances[1])
+    return jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2],
+                     axis=-1)
+
+
+def match_priors(gt_boxes, gt_labels, priors, iou_threshold: float = 0.5,
+                 variances=(0.1, 0.2)):
+    """Assign each prior a gt box/label (0 = background) — the SSD matching
+    step (reference BboxUtil.matchBbox): best-prior-per-gt is forced
+    matched, then any prior with IoU ≥ threshold.
+
+    gt rows with label 0 are padding and never matched.
+    Returns (loc_targets (P, 4), cls_targets (P,) int32).
+    """
+    gt_boxes = jnp.asarray(gt_boxes, jnp.float32)
+    gt_labels = jnp.asarray(gt_labels, jnp.int32)
+    valid = gt_labels > 0
+    iou = iou_matrix(priors, gt_boxes) * valid[None, :]  # (P, G)
+
+    best_gt_per_prior = jnp.argmax(iou, axis=1)          # (P,)
+    best_iou_per_prior = jnp.max(iou, axis=1)
+    # force each gt's best prior to match it
+    best_prior_per_gt = jnp.argmax(iou, axis=0)          # (G,)
+    g_idx = jnp.arange(gt_boxes.shape[0])
+    best_gt_per_prior = best_gt_per_prior.at[best_prior_per_gt].set(
+        jnp.where(valid, g_idx, best_gt_per_prior[best_prior_per_gt]))
+    best_iou_per_prior = best_iou_per_prior.at[best_prior_per_gt].set(
+        jnp.where(valid, 2.0, best_iou_per_prior[best_prior_per_gt]))
+
+    matched_boxes = gt_boxes[best_gt_per_prior]
+    matched_labels = gt_labels[best_gt_per_prior]
+    cls_targets = jnp.where(best_iou_per_prior >= iou_threshold,
+                            matched_labels, 0)
+    loc_targets = encode_boxes(matched_boxes, priors, variances)
+    return loc_targets, cls_targets.astype(jnp.int32)
+
+
+def generate_priors(feature_sizes: Sequence[int], image_size: int,
+                    min_sizes: Sequence[float], max_sizes: Sequence[float],
+                    aspect_ratios: Sequence[Sequence[float]],
+                    clip: bool = True) -> np.ndarray:
+    """SSD prior boxes for a pyramid of feature maps
+    (reference ssd/SSDVgg PriorBox params; Liu et al. 2016 §2.2).
+
+    Per cell: square min_size anchor, sqrt(min*max) anchor, plus two per
+    aspect ratio.  Returns (P, 4) corner boxes, normalized.
+    """
+    priors: List[Tuple[float, float, float, float]] = []
+    for k, fsize in enumerate(feature_sizes):
+        step = image_size / fsize
+        s_min = min_sizes[k] / image_size
+        s_max = max_sizes[k] / image_size
+        for i, j in itertools.product(range(fsize), repeat=2):
+            cx = (j + 0.5) * step / image_size
+            cy = (i + 0.5) * step / image_size
+            sizes = [(s_min, s_min), (math.sqrt(s_min * s_max),) * 2]
+            for ar in aspect_ratios[k]:
+                r = math.sqrt(ar)
+                sizes.append((s_min * r, s_min / r))
+                sizes.append((s_min / r, s_min * r))
+            for w, h in sizes:
+                priors.append((cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2))
+    out = np.asarray(priors, np.float32)
+    if clip:
+        out = np.clip(out, 0.0, 1.0)
+    return out

@@ -1,0 +1,94 @@
+"""Non-maximum suppression — fixed-shape, jit/vmap-able.
+
+Reference capability: models/image/objectdetection/common/Nms.scala
+(greedy IoU suppression inside BboxUtil post-processing).
+
+TPU-first: NMS is notoriously serial; here it is a ``lax.fori_loop`` over
+a *fixed* ``max_output`` count with an O(N) suppression mask update per
+step — no dynamic shapes, no host round-trip, vmap-able over batch and
+class.  (SURVEY §2.3 lists NMS as a Pallas candidate; the fori_loop form
+already keeps the whole detection post-process on-device, and XLA fuses
+the mask updates — revisit with a kernel only if profiling demands.)
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from analytics_zoo_tpu.models.objectdetection.bbox import iou_matrix
+
+
+def nms(boxes, scores, iou_threshold: float = 0.45,
+        score_threshold: float = 0.01, max_output: int = 100
+        ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Greedy NMS. boxes (N, 4), scores (N,) →
+    (indices (max_output,) int32 with -1 padding, count ()).
+
+    Deterministic, fixed output size — callers mask on index >= 0.
+    """
+    boxes = jnp.asarray(boxes, jnp.float32)
+    scores = jnp.asarray(scores, jnp.float32)
+    n = boxes.shape[0]
+    iou = iou_matrix(boxes, boxes)                     # (N, N)
+    alive = scores >= score_threshold
+    m = min(max_output, n)
+
+    def body(i, carry):
+        alive, out, count = carry
+        masked = jnp.where(alive, scores, -jnp.inf)
+        best = jnp.argmax(masked)
+        ok = masked[best] > -jnp.inf
+        out = out.at[i].set(jnp.where(ok, best.astype(jnp.int32), -1))
+        count = count + ok.astype(jnp.int32)
+        # suppress the chosen box and all overlapping ones
+        suppress = (iou[best] >= iou_threshold) | \
+            (jnp.arange(n) == best)
+        alive = alive & jnp.where(ok, ~suppress, alive)
+        return alive, out, count
+
+    out0 = jnp.full((m,), -1, jnp.int32)
+    _, out, count = lax.fori_loop(0, m, body, (alive, out0,
+                                               jnp.int32(0)))
+    if m < max_output:
+        out = jnp.concatenate(
+            [out, jnp.full((max_output - m,), -1, jnp.int32)])
+    return out, count
+
+
+def batched_class_nms(boxes, class_scores, iou_threshold: float = 0.45,
+                      score_threshold: float = 0.01,
+                      max_per_class: int = 50, max_total: int = 100):
+    """Per-class NMS over one image's decoded boxes.
+
+    boxes (P, 4), class_scores (P, C) with class 0 = background.
+    Returns (boxes (max_total, 4), scores (max_total,),
+    labels (max_total,) int32 — 0 where padded).
+    """
+    P, C = class_scores.shape
+
+    def per_class(c_scores):
+        idx, _ = nms(boxes, c_scores, iou_threshold, score_threshold,
+                     max_per_class)
+        sel = jnp.clip(idx, 0, P - 1)
+        valid = idx >= 0
+        return boxes[sel], jnp.where(valid, c_scores[sel], -jnp.inf)
+
+    # vmap over foreground classes (skip background column 0)
+    cls_boxes, cls_scores = jax.vmap(per_class, in_axes=1)(
+        class_scores[:, 1:])
+    n_fg = C - 1
+    labels = jnp.broadcast_to(jnp.arange(1, C)[:, None],
+                              (n_fg, max_per_class))
+    flat_boxes = cls_boxes.reshape(-1, 4)
+    flat_scores = cls_scores.reshape(-1)
+    flat_labels = labels.reshape(-1)
+    top = jnp.argsort(-flat_scores)[:max_total]
+    out_scores = flat_scores[top]
+    keep = jnp.isfinite(out_scores)
+    return (jnp.where(keep[:, None], flat_boxes[top], 0.0),
+            jnp.where(keep, out_scores, 0.0),
+            jnp.where(keep, flat_labels[top], 0).astype(jnp.int32))

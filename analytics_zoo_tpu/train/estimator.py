@@ -456,6 +456,12 @@ class Estimator:
         return out
 
     def predict(self, x, batch_size: int = 32) -> np.ndarray:
+        out = self.predict_raw(x, batch_size=batch_size)
+        return out[0]
+
+    def predict_raw(self, x, batch_size: int = 32) -> List[np.ndarray]:
+        """Like predict but preserves multi-output models: returns one
+        array per model output (single-output models → a 1-list)."""
         xs = _as_list(x)
         self._ensure_built(xs)
         if self._predict_step is None:
@@ -463,7 +469,7 @@ class Estimator:
         n = xs[0].shape[0]
         d = self.ctx.num_devices
         eff_batch = int(math.ceil(max(batch_size, d) / d)) * d
-        outs = []
+        outs: Optional[List[List[np.ndarray]]] = None
         for s in range(int(math.ceil(n / eff_batch))):
             sl = slice(s * eff_batch, min((s + 1) * eff_batch, n))
             bx = [a[sl] for a in xs]
@@ -471,10 +477,13 @@ class Estimator:
             preds = self._predict_step(self.params, self.state,
                                        self._shard_batch(bx_p))
             preds = jax.device_get(preds)
-            if isinstance(preds, (list, tuple)):
-                preds = preds[0]
-            outs.append(np.asarray(preds)[:real])
-        return np.concatenate(outs, axis=0)
+            if not isinstance(preds, (list, tuple)):
+                preds = [preds]
+            if outs is None:
+                outs = [[] for _ in preds]
+            for o, p in zip(outs, preds):
+                o.append(np.asarray(p)[:real])
+        return [np.concatenate(o, axis=0) for o in outs]
 
     # ------------------------------------------------------------------
     # checkpoint plumbing

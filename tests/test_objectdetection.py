@@ -1,0 +1,232 @@
+"""Object detection tests: bbox math, NMS, MultiBoxLoss, SSD, mAP."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.models.objectdetection import (
+    MeanAveragePrecision, MultiBoxLoss, ObjectDetector, SSDTargetAssigner,
+    average_precision, batched_class_nms, build_ssd, decode_boxes,
+    encode_boxes, generate_priors, iou_matrix, match_priors, multibox_loss,
+    nms, smooth_l1)
+
+# a small SSD config for tests (fast CPU build)
+TINY_CONFIG = {
+    "image_size": 64,
+    "feature_sizes": (8, 4, 2, 1, 1, 1),
+    "min_sizes": (6, 13, 26, 38, 51, 58),
+    "max_sizes": (13, 26, 38, 51, 58, 70),
+    "aspect_ratios": ((2,), (2, 3), (2, 3), (2, 3), (2,), (2,)),
+}
+
+
+class TestBbox:
+    def test_iou_known_values(self):
+        a = np.array([[0, 0, 2, 2]], np.float32)
+        b = np.array([[1, 1, 3, 3], [0, 0, 2, 2], [5, 5, 6, 6]], np.float32)
+        iou = np.asarray(iou_matrix(a, b))
+        np.testing.assert_allclose(iou[0], [1 / 7, 1.0, 0.0], rtol=1e-6)
+
+    def test_encode_decode_roundtrip(self):
+        rs = np.random.RandomState(0)
+        priors = np.stack([
+            rs.uniform(0, 0.5, 16), rs.uniform(0, 0.5, 16),
+            rs.uniform(0.5, 1, 16), rs.uniform(0.5, 1, 16)], axis=1)
+        boxes = np.stack([
+            rs.uniform(0, 0.4, 16), rs.uniform(0, 0.4, 16),
+            rs.uniform(0.6, 1, 16), rs.uniform(0.6, 1, 16)], axis=1)
+        enc = encode_boxes(jnp.asarray(boxes), jnp.asarray(priors))
+        dec = decode_boxes(enc, jnp.asarray(priors))
+        np.testing.assert_allclose(np.asarray(dec), boxes, rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_match_priors_assigns_best(self):
+        priors = np.array([[0, 0, 0.5, 0.5], [0.5, 0.5, 1, 1],
+                           [0, 0.5, 0.5, 1]], np.float32)
+        gt = np.array([[0.05, 0.05, 0.45, 0.45], [0, 0, 0, 0]], np.float32)
+        labels = np.array([3, 0], np.int32)  # second row is padding
+        loc_t, cls_t = match_priors(gt, labels, jnp.asarray(priors))
+        cls_t = np.asarray(cls_t)
+        assert cls_t[0] == 3          # overlapping prior matched
+        assert cls_t[1] == 0 and cls_t[2] == 0  # others background
+
+    def test_match_priors_forces_best_prior_per_gt(self):
+        """Even below the IoU threshold, each gt's best prior matches."""
+        priors = np.array([[0, 0, 1, 1], [0.9, 0.9, 1, 1]], np.float32)
+        gt = np.array([[0.0, 0.0, 0.1, 0.1]], np.float32)  # tiny box
+        labels = np.array([5], np.int32)
+        _, cls_t = match_priors(gt, labels, jnp.asarray(priors),
+                                iou_threshold=0.5)
+        assert np.asarray(cls_t)[0] == 5
+
+    def test_generate_priors_count_and_range(self):
+        cfg = TINY_CONFIG
+        priors = generate_priors(cfg["feature_sizes"], cfg["image_size"],
+                                 cfg["min_sizes"], cfg["max_sizes"],
+                                 cfg["aspect_ratios"])
+        expected = sum(f * f * (2 + 2 * len(ar)) for f, ar in
+                       zip(cfg["feature_sizes"], cfg["aspect_ratios"]))
+        assert priors.shape == (expected, 4)
+        assert priors.min() >= 0.0 and priors.max() <= 1.0
+
+
+class TestNMS:
+    def test_suppresses_overlaps(self):
+        boxes = np.array([[0, 0, 1, 1], [0.05, 0, 1, 1], [2, 2, 3, 3]],
+                         np.float32)
+        scores = np.array([0.9, 0.8, 0.7], np.float32)
+        idx, count = nms(boxes, scores, iou_threshold=0.5, max_output=3)
+        idx = np.asarray(idx)
+        assert int(count) == 2
+        assert list(idx[:2]) == [0, 2]
+        assert idx[2] == -1
+
+    def test_score_threshold(self):
+        boxes = np.array([[0, 0, 1, 1], [2, 2, 3, 3]], np.float32)
+        scores = np.array([0.9, 0.001], np.float32)
+        _, count = nms(boxes, scores, score_threshold=0.01)
+        assert int(count) == 1
+
+    def test_jit_and_fixed_shape(self):
+        f = jax.jit(lambda b, s: nms(b, s, max_output=5))
+        boxes = jnp.asarray(np.random.rand(10, 4).astype(np.float32))
+        idx, _ = f(boxes, jnp.linspace(1, 0, 10))
+        assert idx.shape == (5,)
+
+    def test_batched_class_nms_labels(self):
+        boxes = np.array([[0, 0, 0.3, 0.3], [0.6, 0.6, 1, 1]], np.float32)
+        scores = np.array([[0.05, 0.9, 0.05], [0.05, 0.05, 0.9]], np.float32)
+        b, s, l = batched_class_nms(jnp.asarray(boxes), jnp.asarray(scores),
+                                    score_threshold=0.5, max_total=4)
+        l = np.asarray(l)
+        kept = l[np.asarray(s) > 0]
+        assert set(kept) == {1, 2}
+
+
+class TestMultiBoxLoss:
+    def test_perfect_predictions_low_loss(self):
+        rs = np.random.RandomState(0)
+        B, P, C = 2, 16, 4
+        cls_t = rs.randint(0, C, (B, P)).astype(np.int32)
+        loc_t = rs.randn(B, P, 4).astype(np.float32)
+        logits = np.full((B, P, C), -20.0, np.float32)
+        for b in range(B):
+            logits[b, np.arange(P), cls_t[b]] = 20.0
+        loss = multibox_loss(jnp.asarray(loc_t), jnp.asarray(logits),
+                             jnp.asarray(loc_t), jnp.asarray(cls_t))
+        assert float(loss) < 1e-3
+
+    def test_hard_negative_mining_limits_negatives(self):
+        """With zero positives the loss is just 0 (normalized by 1)."""
+        B, P, C = 1, 8, 3
+        cls_t = np.zeros((B, P), np.int32)
+        loc_t = np.zeros((B, P, 4), np.float32)
+        logits = np.zeros((B, P, C), np.float32)
+        loss = multibox_loss(jnp.asarray(loc_t), jnp.asarray(logits),
+                             jnp.asarray(loc_t), jnp.asarray(cls_t))
+        assert float(loss) == pytest.approx(0.0, abs=1e-6)
+
+    def test_wrong_loc_increases_loss(self):
+        B, P, C = 1, 8, 3
+        cls_t = np.zeros((B, P), np.int32)
+        cls_t[0, 0] = 1
+        loc_t = np.zeros((B, P, 4), np.float32)
+        logits = np.zeros((B, P, C), np.float32)
+        good = multibox_loss(jnp.zeros((B, P, 4)), jnp.asarray(logits),
+                             jnp.asarray(loc_t), jnp.asarray(cls_t))
+        bad = multibox_loss(jnp.ones((B, P, 4)) * 3, jnp.asarray(logits),
+                            jnp.asarray(loc_t), jnp.asarray(cls_t))
+        assert float(bad) > float(good)
+
+    def test_smooth_l1(self):
+        x = jnp.asarray([-2.0, -0.5, 0.0, 0.5, 2.0])
+        np.testing.assert_allclose(
+            np.asarray(smooth_l1(x)), [1.5, 0.125, 0.0, 0.125, 1.5],
+            rtol=1e-6)
+
+
+class TestSSD:
+    def test_build_and_forward(self):
+        from analytics_zoo_tpu.train.optimizers import Adam
+        det = ObjectDetector(class_num=3, config=TINY_CONFIG,
+                             width_mult=0.125)
+        det.model.compile(optimizer=Adam(1e-3), loss=det.loss())
+        x = np.random.randn(2, 64, 64, 3).astype(np.float32)
+        loc, conf = det.estimator.predict_raw(x, batch_size=2)
+        P = det.priors.shape[0]
+        assert loc.shape == (2, P, 4)
+        assert conf.shape == (2, P, 3)
+
+    def test_train_step_and_detect(self):
+        from analytics_zoo_tpu.train.optimizers import Adam
+        det = ObjectDetector(class_num=3, config=TINY_CONFIG,
+                             width_mult=0.125)
+        det.model.compile(optimizer=Adam(1e-3), loss=det.loss())
+        rs = np.random.RandomState(0)
+        n = 8
+        imgs = rs.randn(n, 64, 64, 3).astype(np.float32)
+        gt_boxes = np.tile(np.array([[0.2, 0.2, 0.7, 0.7]], np.float32),
+                           (n, 1, 1))
+        gt_labels = np.full((n, 1), 1, np.int32)
+        hist = det.fit_detection(imgs, gt_boxes, gt_labels, batch_size=8,
+                                 nb_epoch=2, verbose=False)
+        assert np.isfinite(hist[-1]["loss"])
+        dets = det.detect(imgs[:2], score_threshold=0.0)
+        assert len(dets) == 2
+        boxes, scores, labels = dets[0]
+        assert boxes.shape[1] == 4 if boxes.size else True
+
+    def test_target_assigner_shape(self):
+        priors = generate_priors(
+            TINY_CONFIG["feature_sizes"], TINY_CONFIG["image_size"],
+            TINY_CONFIG["min_sizes"], TINY_CONFIG["max_sizes"],
+            TINY_CONFIG["aspect_ratios"])
+        assigner = SSDTargetAssigner(priors)
+        t = assigner(np.zeros((2, 3, 4), np.float32),
+                     np.zeros((2, 3), np.int32))
+        assert t.shape == (2, priors.shape[0], 5)
+
+
+class TestMAP:
+    def test_perfect_detections(self):
+        m = MeanAveragePrecision(num_classes=2)
+        gt = np.array([[0, 0, 1, 1], [2, 2, 3, 3]], np.float32)
+        gl = np.array([1, 2])
+        m.add(gt, np.array([0.9, 0.8]), gl, gt, gl)
+        assert m.result() == pytest.approx(1.0)
+
+    def test_misses_halve_recall(self):
+        m = MeanAveragePrecision(num_classes=1)
+        gt = np.array([[0, 0, 1, 1], [2, 2, 3, 3]], np.float32)
+        gl = np.array([1, 1])
+        # only one of two gts detected
+        m.add(gt[:1], np.array([0.9]), gl[:1], gt, gl)
+        assert m.result() == pytest.approx(0.5)
+
+    def test_false_positive_hurts_precision(self):
+        m = MeanAveragePrecision(num_classes=1)
+        gt = np.array([[0, 0, 1, 1]], np.float32)
+        gl = np.array([1])
+        dets = np.array([[0, 0, 1, 1], [5, 5, 6, 6]], np.float32)
+        m.add(dets, np.array([0.9, 0.95]), np.array([1, 1]), gt, gl)
+        assert m.result() < 1.0
+
+    def test_duplicate_detection_is_fp(self):
+        """A second detection of an already-matched gt counts as FP.
+        (The higher-scored duplicate matches first; the TP then ranks
+        after an FP, dragging AP below 1.)"""
+        m = MeanAveragePrecision(num_classes=1)
+        gt = np.array([[0, 0, 1, 1]], np.float32)
+        dets = np.array([[0, 0, 1, 1], [0.01, 0, 1, 1]], np.float32)
+        m.add(dets, np.array([0.9, 0.95]), np.array([1, 1]), gt,
+              np.array([1]))
+        flags = [tp for _, tp in m._dets[1]]
+        assert sum(flags) == 1 and len(flags) == 2  # one TP, one FP
+
+    def test_ap_11pt_vs_area(self):
+        rec = np.array([0.5, 1.0])
+        prec = np.array([1.0, 0.5])
+        area = average_precision(rec, prec, use_07_metric=False)
+        p11 = average_precision(rec, prec, use_07_metric=True)
+        assert 0 < p11 <= 1 and 0 < area <= 1
