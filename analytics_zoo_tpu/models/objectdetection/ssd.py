@@ -72,28 +72,48 @@ def build_ssd(class_num: int, config=SSD300_CONFIG,
     inp = Input(shape=(S, S, 3), name="image")
     x = inp
     feats = []
+    sizes = []  # analytically tracked spatial size of each feature map
+    s = S
     # VGG-ish trunk down to 38x38 (3 stride-2 stages for S=300)
     for i, f in enumerate((64, 128, 256)):
         x = _conv_block(x, c(f), 3, f"stage{i}a")
         x = _conv_block(x, c(f), 3, f"stage{i}b")
         x = MaxPooling2D((2, 2), border_mode="same")(x)
+        s = -(-s // 2)
     x = _conv_block(x, c(512), 3, "conv4")
-    feats.append(x)                                   # ~38x38
+    feats.append(x); sizes.append(s)                  # ~38x38
     x = MaxPooling2D((2, 2), border_mode="same")(x)
+    s = -(-s // 2)
     x = _conv_block(x, c(512), 3, "conv5")
-    feats.append(x)                                   # ~19x19
+    feats.append(x); sizes.append(s)                  # ~19x19
     x = _conv_block(x, c(256), 1, "conv6r")
     x = _conv_block(x, c(512), 3, "conv6", strides=2)
-    feats.append(x)                                   # ~10x10
+    s = -(-s // 2)
+    feats.append(x); sizes.append(s)                  # ~10x10
     x = _conv_block(x, c(128), 1, "conv7r")
     x = _conv_block(x, c(256), 3, "conv7", strides=2)
-    feats.append(x)                                   # ~5x5
+    s = -(-s // 2)
+    feats.append(x); sizes.append(s)                  # ~5x5
     x = _conv_block(x, c(128), 1, "conv8r")
     x = _conv_block(x, c(256), 3, "conv8", strides=2)
-    feats.append(x)                                   # ~3x3
+    s = -(-s // 2)
+    feats.append(x); sizes.append(s)                  # ~3x3
     x = _conv_block(x, c(128), 1, "conv9r")
-    x = _conv_block(x, c(256), 3, "conv9", strides=2)
-    feats.append(x)                                   # ~1x1 (ceil)
+    if s == 3:
+        # canonical SSD300 tail: 3x3 VALID stride-1 maps 3x3 -> 1x1;
+        # other sizes keep the stride-2 SAME tail (ceil(s/2))
+        x = _conv_block(x, c(256), 3, "conv9", strides=1, padding="valid")
+        s = s - 2
+    else:
+        x = _conv_block(x, c(256), 3, "conv9", strides=2)
+        s = -(-s // 2)
+    feats.append(x); sizes.append(s)                  # 1x1
+
+    if tuple(sizes) != tuple(fsizes):
+        raise ValueError(
+            f"SSD trunk produces feature maps {tuple(sizes)} but config "
+            f"declares feature_sizes={tuple(fsizes)}; priors would not "
+            "match the head outputs")
 
     locs, confs = [], []
     for i, (feat, ar) in enumerate(zip(feats, ars)):
@@ -113,6 +133,11 @@ def build_ssd(class_num: int, config=SSD300_CONFIG,
 
     priors = generate_priors(fsizes, S, config["min_sizes"],
                              config["max_sizes"], ars)
+    head_priors = sum(sz * sz * _anchors_per_cell(ar)
+                      for sz, ar in zip(sizes, ars))
+    assert head_priors == priors.shape[0], (
+        f"head prior count {head_priors} != generated priors "
+        f"{priors.shape[0]}")
     return model, priors
 
 

@@ -117,9 +117,12 @@ class MultiHeadAttention(StatelessLayer):
             elif mask.ndim == 3:    # (B, Lq, Lk) full mask
                 mask = mask[:, None, :, :]
         r1, r2 = split_rng(rng, 2)
-        out = dot_product_attention(q, k, v, mask=mask, causal=self.causal)
-        if self.attn_drop > 0:
-            out = _dropout(r1, out, self.attn_drop, training)
+        # attn_drop acts on the softmax probabilities (reference
+        # TransformerLayer/BERT semantics) via the blockwise path, which
+        # keeps the flash memory bound; inference uses the fused kernels
+        drop = self.attn_drop if (training and r1 is not None) else 0.0
+        out = dot_product_attention(q, k, v, mask=mask, causal=self.causal,
+                                    dropout_rate=drop, dropout_rng=r1)
         b, h, l, hd = out.shape
         out = out.transpose(0, 2, 1, 3).reshape(b, l, h * hd)
         out = _dense(params["o"], out)

@@ -46,10 +46,15 @@ def reference_attention(q, k, v, mask=None, causal: bool = False,
     if mask is not None:
         logits = jnp.where(mask.astype(bool), logits, NEG_INF)
     w = jax.nn.softmax(logits, axis=-1)
+    # zero fully-masked query rows so every dispatch path (blockwise, ring,
+    # flash) agrees: they return 0 there, not the softmax of a constant row
+    row_valid = jnp.any(logits > NEG_INF / 2, axis=-1, keepdims=True)
+    w = jnp.where(row_valid, w, 0.0)
     return jnp.einsum("bhqk,bhkd->bhqd", w, v)
 
 
-def online_softmax_fold(m_prev, l_prev, acc, logits, values):
+def online_softmax_fold(m_prev, l_prev, acc, logits, values,
+                        drop_mask=None, keep_prob: float = 1.0):
     """One fold of the online-softmax accumulation — the single source of
     this numerics, shared by blockwise attention (KV-chunk loop) and ring
     attention (device loop, parallel/sequence.py).
@@ -57,6 +62,11 @@ def online_softmax_fold(m_prev, l_prev, acc, logits, values):
     ``logits`` (B,H,Lq,Kblk) must already carry all masking as NEG_INF.
     Returns the updated running (max, normalizer, weighted-value acc);
     fully-masked rows are kept finite-safe and contribute zero.
+
+    ``drop_mask`` (same shape as logits) implements dropout on the softmax
+    *probabilities*: the normalizer keeps the undropped sum, only the
+    value accumulation is masked/rescaled — since w = p/l this is exactly
+    dropout on the normalized weights, without materializing them.
     """
     m_cur = jnp.max(logits, axis=-1)
     m_new = jnp.maximum(m_prev, m_cur)
@@ -66,20 +76,28 @@ def online_softmax_fold(m_prev, l_prev, acc, logits, values):
     alpha = jnp.exp(jnp.where(jnp.isfinite(m_prev), m_prev - m_safe,
                               NEG_INF))
     l_new = l_prev * alpha + jnp.sum(p, axis=-1)
-    acc = acc * alpha[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, values)
+    p_acc = p if drop_mask is None else (
+        jnp.where(drop_mask, p, 0.0) / keep_prob)
+    acc = acc * alpha[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p_acc,
+                                              values)
     m_out = m_safe + jnp.where(jnp.isfinite(m_new), 0.0, NEG_INF)
     return m_out, l_new, acc
 
 
 def blockwise_attention(q, k, v, mask=None, causal: bool = False,
                         sm_scale: Optional[float] = None,
-                        block_size: int = 512):
+                        block_size: int = 512,
+                        dropout_rate: float = 0.0, dropout_rng=None):
     """Flash-style attention: scan over KV blocks with a running
     (max, sum, acc) online softmax.  O(Lq · block) memory.
 
     Differentiable end-to-end (the scan is unrolled by XLA's autodiff);
     wrap the call in ``jax.checkpoint`` to trade recompute for memory in
     very long sequences.
+
+    ``dropout_rate`` > 0 (with ``dropout_rng``) applies dropout to the
+    softmax probabilities — reference TransformerLayer/BERT attn_drop
+    semantics — per KV block via ``fold_in``, keeping the memory bound.
     """
     b, h, lq, d = q.shape
     lk = k.shape[2]
@@ -123,41 +141,61 @@ def blockwise_attention(q, k, v, mask=None, causal: bool = False,
             logits = jnp.where(cm[None, None], logits, NEG_INF)
         if mask is not None:
             logits = jnp.where(mb, logits, NEG_INF)
+        if dropout_rate > 0.0 and dropout_rng is not None:
+            keep = jax.random.bernoulli(
+                jax.random.fold_in(dropout_rng, blk),
+                1.0 - dropout_rate, logits.shape)
+            return online_softmax_fold(m_prev, l_prev, acc, logits, vb,
+                                       drop_mask=keep,
+                                       keep_prob=1.0 - dropout_rate), None
         return online_softmax_fold(m_prev, l_prev, acc, logits, vb), None
 
-    init = (jnp.full((b, h, lq), NEG_INF, q.dtype),
-            jnp.zeros((b, h, lq), q.dtype),
-            jnp.zeros((b, h, lq, d), q.dtype))
+    # f32 carry: with bf16 inputs the running normalizer/accumulator must
+    # not round across KV blocks (matches the Pallas kernel's f32 scratch)
+    init = (jnp.full((b, h, lq), NEG_INF, jnp.float32),
+            jnp.zeros((b, h, lq), jnp.float32),
+            jnp.zeros((b, h, lq, d), jnp.float32))
     blks = jnp.arange(nblocks)
     xs = ((k_blocks, v_blocks, mask_blocks, blks) if mask is not None
           else (k_blocks, v_blocks, blks))
     (m, l, acc), _ = lax.scan(step, init, xs)
     l = jnp.maximum(l, 1e-20)
-    return acc / l[..., None]
+    return (acc / l[..., None]).astype(q.dtype)
 
 
 def dot_product_attention(q, k, v, mask=None, causal: bool = False,
                           sm_scale: Optional[float] = None,
                           block_size: int = 512,
-                          use_flash: Optional[bool] = None):
+                          use_flash: Optional[bool] = None,
+                          dropout_rate: float = 0.0, dropout_rng=None):
     """Entry point used by the attention layers.
 
     Chooses the Pallas flash kernel on TPU when shapes allow, else the
     blockwise scan.  ``use_flash`` forces the choice (tests).
+    ``dropout_rate`` > 0 with a ``dropout_rng`` applies probability
+    dropout (reference attn_drop semantics) via the blockwise path, which
+    keeps the O(Lq · block) memory bound during training.
     """
+    dropping = dropout_rate > 0.0 and dropout_rng is not None
     if use_flash is None:
         use_flash = (jax.default_backend() == "tpu" and mask is None
+                     and not dropping
                      and q.shape[-1] % 128 == 0 and q.shape[2] % 128 == 0
                      and k.shape[2] % 128 == 0)
     if use_flash:
         if mask is not None:
             raise ValueError("flash kernel does not take a mask; pass "
                              "use_flash=False (or None for auto dispatch)")
+        if dropping:
+            raise ValueError("flash kernel does not support attention "
+                             "dropout; pass use_flash=False/None")
         from analytics_zoo_tpu.ops.flash_attention import flash_attention
         return flash_attention(q, k, v, causal=causal, sm_scale=sm_scale)
-    if q.shape[2] * k.shape[2] <= 256 * 256:
+    if not dropping and q.shape[2] * k.shape[2] <= 256 * 256:
         # tiny sequences: one fused softmax beats the scan
         return reference_attention(q, k, v, mask=mask, causal=causal,
                                    sm_scale=sm_scale)
     return blockwise_attention(q, k, v, mask=mask, causal=causal,
-                               sm_scale=sm_scale, block_size=block_size)
+                               sm_scale=sm_scale, block_size=block_size,
+                               dropout_rate=dropout_rate if dropping else 0.0,
+                               dropout_rng=dropout_rng if dropping else None)

@@ -79,12 +79,14 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
         except AttributeError:  # pragma: no cover — older jax
             return x
 
-    init = (_vary(jnp.full((b, h, lq), NEG_INF, q.dtype)),
-            _vary(jnp.zeros((b, h, lq), q.dtype)),
-            _vary(jnp.zeros((b, h, lq, d), q.dtype)), k, v)
+    # f32 carry across ring steps, matching blockwise_attention/the Pallas
+    # kernel's f32 scratch, so bf16 inputs don't round the accumulator
+    init = (_vary(jnp.full((b, h, lq), NEG_INF, jnp.float32)),
+            _vary(jnp.zeros((b, h, lq), jnp.float32)),
+            _vary(jnp.zeros((b, h, lq, d), jnp.float32)), k, v)
     (m, l, acc, _, _), _ = lax.scan(step, init, jnp.arange(n))
     l = jnp.maximum(l, 1e-20)
-    return acc / l[..., None]
+    return (acc / l[..., None]).astype(q.dtype)
 
 
 def ring_self_attention(q, k, v, mesh: Mesh, seq_axis: str,

@@ -76,7 +76,9 @@ class TensorParallel(ShardingStrategy):
         self.rules = [(re.compile(pat), spec) for pat, spec in (rules or [])]
         self.min_size = min_size
 
-    def param_shardings(self, mesh, params):
+    def _resolve(self, mesh):
+        """Per-call (axis, axis_size) for ``mesh`` — never cached on self,
+        so one strategy object works across different meshes."""
         sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
         if self.axis not in sizes:
             raise ValueError(
@@ -84,31 +86,46 @@ class TensorParallel(ShardingStrategy):
                 f"{tuple(mesh.axis_names)}; build the context with a model "
                 "axis, e.g. init_zoo_context(mesh_shape=(d, t), "
                 "axis_names=('data', 'model'))")
-        if self.axis_size is None:
-            self.axis_size = sizes[self.axis]
-        elif self.axis_size != sizes[self.axis]:
+        if self.axis_size is not None and self.axis_size != sizes[self.axis]:
             raise ValueError(
                 f"mesh_axis_size {self.axis_size} != mesh's "
                 f"{self.axis!r} size {sizes[self.axis]}")
-        return super().param_shardings(mesh, params)
+        return self.axis, sizes[self.axis]
+
+    def param_shardings(self, mesh, params):
+        axis, axis_size = self._resolve(mesh)
+
+        def one(path, leaf):
+            return NamedSharding(
+                mesh, self._spec(path_str(path), leaf, axis, axis_size))
+
+        return jax.tree_util.tree_map_with_path(one, params)
 
     def spec(self, path: str, leaf) -> P:
+        if self.axis_size is None:
+            raise ValueError(
+                "TensorParallel.spec() without mesh_axis_size — use "
+                "param_shardings(mesh, params), which resolves the axis "
+                "size from the mesh")
+        return self._spec(path, leaf, self.axis, self.axis_size)
+
+    def _spec(self, path: str, leaf, axis: str, axis_size: int) -> P:
         for pat, spec in self.rules:
             if pat.search(path):
                 return spec
         shape = getattr(leaf, "shape", ())
         if not shape or int(np.prod(shape)) < self.min_size:
             return P()
-        if not self.axis_size or self.axis_size <= 1:
+        if not axis_size or axis_size <= 1:
             return P()
         # largest dim divisible by the axis size
         cands = [(d, i) for i, d in enumerate(shape)
-                 if d % self.axis_size == 0]
+                 if d % axis_size == 0]
         if not cands:
             return P()
         _, dim = max(cands)
         spec = [None] * len(shape)
-        spec[dim] = self.axis
+        spec[dim] = axis
         return P(*spec)
 
 
@@ -121,11 +138,14 @@ class AutoSharding(TensorParallel):
         super().__init__(axis="", mesh_axis_size=None, rules=rules,
                          min_size=min_size)
 
+    def _resolve(self, mesh):
+        axis = mesh.axis_names[-1]
+        return axis, dict(zip(mesh.axis_names,
+                              mesh.devices.shape))[axis]
+
     def param_shardings(self, mesh, params):
         if len(mesh.axis_names) < 2:
             return DataParallel().param_shardings(mesh, params)
-        self.axis = mesh.axis_names[-1]
-        self.axis_size = None
         return super().param_shardings(mesh, params)
 
 

@@ -54,6 +54,44 @@ class TestBlockwise:
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=2e-5, atol=2e-5)
 
+    def test_bf16_inputs_accumulate_in_f32(self):
+        """bf16 q/k/v: the scan carry is f32 so blockwise stays close to
+        the f32 oracle, and the output dtype matches the inputs."""
+        q, k, v = _qkv(lq=32, lk=64)
+        ref = reference_attention(q, k, v)
+        out = blockwise_attention(q.astype(jnp.bfloat16),
+                                  k.astype(jnp.bfloat16),
+                                  v.astype(jnp.bfloat16), block_size=16)
+        assert out.dtype == jnp.bfloat16
+        np.testing.assert_allclose(np.asarray(out, np.float32),
+                                   np.asarray(ref), rtol=2e-2, atol=2e-2)
+
+    def test_fully_masked_rows_agree_across_paths(self):
+        """A query row with no visible key returns zeros on every path."""
+        q, k, v = _qkv(lq=4, lk=16)
+        mask = jnp.ones((2, 1, 4, 16), bool).at[:, :, 2, :].set(False)
+        ref = reference_attention(q, k, v, mask=mask)
+        out = blockwise_attention(q, k, v, mask=mask, block_size=8)
+        assert np.all(np.asarray(ref)[:, :, 2] == 0)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_prob_dropout_unbiased(self):
+        """Blockwise probability dropout: E[out] ~= undropped output, and
+        rate=0 is exactly the undropped path."""
+        q, k, v = _qkv(lq=8, lk=64)
+        base = blockwise_attention(q, k, v, block_size=16)
+        same = blockwise_attention(q, k, v, block_size=16,
+                                   dropout_rate=0.0,
+                                   dropout_rng=jax.random.PRNGKey(0))
+        np.testing.assert_array_equal(np.asarray(base), np.asarray(same))
+        outs = [blockwise_attention(q, k, v, block_size=16,
+                                    dropout_rate=0.3,
+                                    dropout_rng=jax.random.PRNGKey(s))
+                for s in range(64)]
+        mean = np.mean([np.asarray(o) for o in outs], axis=0)
+        np.testing.assert_allclose(mean, np.asarray(base), atol=0.15)
+
     def test_ragged_kv_length(self):
         """Lk not divisible by block size (padding path)."""
         q, k, v = _qkv(lq=8, lk=21)

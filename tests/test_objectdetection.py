@@ -147,6 +147,22 @@ class TestMultiBoxLoss:
 
 
 class TestSSD:
+    def test_ssd300_priors_match_heads(self):
+        """Default SSD300 config: heads emit exactly priors.shape[0]
+        boxes (regression: the old trunk produced a 2x2 final map ->
+        8744 vs 8732)."""
+        from analytics_zoo_tpu.models.objectdetection.ssd import (
+            SSD300_CONFIG, build_ssd)
+        model, priors = build_ssd(class_num=3, config=SSD300_CONFIG,
+                                  width_mult=0.03125)
+        assert priors.shape == (8732, 4)
+
+    def test_inconsistent_config_raises(self):
+        bad = dict(TINY_CONFIG)
+        bad["feature_sizes"] = (8, 4, 2, 1, 1, 2)  # trunk can't make this
+        with pytest.raises(ValueError):
+            ObjectDetector(class_num=3, config=bad, width_mult=0.125)
+
     def test_build_and_forward(self):
         from analytics_zoo_tpu.train.optimizers import Adam
         det = ObjectDetector(class_num=3, config=TINY_CONFIG,

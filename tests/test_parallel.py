@@ -56,9 +56,11 @@ class TestShardingRules:
         strat = make_strategy("tp", mesh_2d.mesh)
         assert isinstance(strat, TensorParallel)
         assert strat.axis == "model"
-        # axis size resolved (and validated) against the mesh at use time
-        strat.param_shardings(mesh_2d.mesh, {"k": np.zeros((256, 1024))})
-        assert strat.axis_size == 4
+        # axis size resolved (and validated) against the mesh at use time,
+        # without mutating the strategy (reusable across meshes)
+        sh = strat.param_shardings(mesh_2d.mesh, {"k": np.zeros((256, 1024))})
+        assert sh["k"].spec == P(None, "model")
+        assert strat.axis_size is None
         with pytest.raises(ValueError):
             make_strategy("pipeline", mesh_2d.mesh)
 
