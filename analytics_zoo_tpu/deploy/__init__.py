@@ -1,0 +1,14 @@
+"""Deployment layer: InferenceModel + Cluster-Serving equivalent.
+
+Reference capability: L7 — pipeline/inference/ (InferenceModel.scala:30,
+multi-backend thread-safe serving) and serving/ (ClusterServing.scala:46,
+Redis-stream streaming inference), plus the Python client
+(pyzoo/zoo/serving/client.py:58-150).
+"""
+
+from analytics_zoo_tpu.deploy.inference import (  # noqa: F401
+    DynamicBatcher, InferenceModel, dequantize_pytree, quantize_pytree)
+from analytics_zoo_tpu.deploy.serving import (  # noqa: F401
+    ClusterServing, FileQueue, InputQueue, MemoryQueue, OutputQueue,
+    RedisQueue, ServingConfig, decode_image, decode_tensor, encode_image,
+    encode_tensor, make_queue)

@@ -1,0 +1,514 @@
+"""Cluster Serving: streaming inference worker + client queues.
+
+Reference capability: serving/ClusterServing.scala:46 (Spark Structured
+Streaming over a Redis stream ``image_stream``: read → base64-decode →
+batch → broadcast InferenceModel predict → write results to Redis hashes,
+with XTRIM backpressure at :123-138) and the Python client
+pyzoo/zoo/serving/client.py:58-150 (InputQueue.enqueue_image / xadd,
+OutputQueue.dequeue / query).
+
+TPU-first redesign: the streaming engine is a plain worker loop around one
+compiled forward (no Spark, no model broadcast — the XLA executable IS the
+broadcast).  The transport is pluggable:
+
+- ``MemoryQueue``   — in-process (tests, single-process apps);
+- ``FileQueue``     — spool directory with atomic renames (cross-process
+                      on one host / shared FS, zero extra deps);
+- ``RedisQueue``    — wire-compatible with the reference client
+                      (xadd/hset), used when ``redis`` is importable.
+
+Client API parity: ``InputQueue.enqueue`` / ``enqueue_image`` (base64) and
+``OutputQueue.dequeue`` / ``query`` keep the reference semantics.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+import tempfile
+import threading
+import time
+import uuid
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["MemoryQueue", "FileQueue", "RedisQueue", "make_queue",
+           "InputQueue", "OutputQueue", "ServingConfig", "ClusterServing",
+           "encode_image", "decode_image"]
+
+
+# ---------------------------------------------------------------------------
+# image payload codec (reference serving/utils/ImageProcessing base64→BGR,
+# client.py:83-110 enqueue_image)
+# ---------------------------------------------------------------------------
+
+def encode_tensor(a) -> Dict[str, Any]:
+    """ndarray → JSON-safe payload (the single raw-array wire codec)."""
+    a = np.asarray(a)
+    return {"b64": base64.b64encode(a.tobytes()).decode("ascii"),
+            "shape": list(a.shape), "dtype": str(a.dtype)}
+
+
+def decode_tensor(payload: Dict[str, Any]) -> np.ndarray:
+    return np.frombuffer(
+        base64.b64decode(payload["b64"]),
+        dtype=np.dtype(payload["dtype"])).reshape(payload["shape"]).copy()
+
+
+def encode_image(image) -> Dict[str, Any]:
+    """ndarray (H, W, C) float/uint8 or a path → JSON-safe payload."""
+    if isinstance(image, str):
+        with open(image, "rb") as f:
+            return {"image": base64.b64encode(f.read()).decode("ascii"),
+                    "codec": "file"}
+    return {"codec": "raw", "image": encode_tensor(image)}
+
+
+def decode_image(payload: Dict[str, Any]) -> np.ndarray:
+    if payload.get("codec") == "raw":
+        return decode_tensor(payload["image"])
+    raw = base64.b64decode(payload["image"])
+    import cv2  # compressed file bytes (jpg/png)
+    img = cv2.imdecode(np.frombuffer(raw, np.uint8), cv2.IMREAD_COLOR)
+    if img is None:
+        raise ValueError("undecodable image payload")
+    return img
+
+
+# ---------------------------------------------------------------------------
+# queue backends
+# ---------------------------------------------------------------------------
+
+class MemoryQueue:
+    """In-process stream + result store (single-process serving/tests)."""
+
+    def __init__(self, name: str = "serving_stream"):
+        self.name = name
+        self._items: List[Tuple[str, Dict]] = []
+        self._results: Dict[str, Any] = {}
+        self._cv = threading.Condition()
+
+    def push(self, record: Dict) -> str:
+        rid = record.get("uri") or uuid.uuid4().hex
+        with self._cv:
+            self._items.append((rid, record))
+            self._cv.notify_all()
+        return rid
+
+    def pop_batch(self, n: int, timeout: float = 0.1
+                  ) -> List[Tuple[str, Dict]]:
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while not self._items and time.monotonic() < deadline:
+                self._cv.wait(timeout=deadline - time.monotonic())
+            out, self._items = self._items[:n], self._items[n:]
+            return out
+
+    def __len__(self) -> int:
+        with self._cv:
+            return len(self._items)
+
+    def trim(self, maxlen: int) -> int:
+        """Drop oldest items beyond maxlen (reference XTRIM backpressure,
+        ClusterServing.scala:132-138).  Returns number dropped."""
+        with self._cv:
+            drop = max(0, len(self._items) - maxlen)
+            if drop:
+                self._items = self._items[drop:]
+            return drop
+
+    def set_result(self, rid: str, value: Any) -> None:
+        with self._cv:
+            self._results[rid] = value
+            self._cv.notify_all()
+
+    def get_result(self, rid: str, timeout: float = 10.0) -> Any:
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while rid not in self._results:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    raise TimeoutError(f"no result for {rid}")
+                self._cv.wait(timeout=left)
+            return self._results.pop(rid)
+
+    def pending_results(self) -> List[str]:
+        with self._cv:
+            return list(self._results)
+
+
+class FileQueue:
+    """Spool-directory stream: cross-process on one host or a shared FS.
+
+    Records are JSON files; atomic rename makes push/claim race-free
+    without locks (rename(2) is atomic on POSIX).  Plays the role the
+    Redis server plays for the reference when no Redis is available.
+    """
+
+    def __init__(self, root: str, name: str = "serving_stream"):
+        self.name = name
+        self.root = os.path.join(root, name)
+        self.in_dir = os.path.join(self.root, "in")
+        self.out_dir = os.path.join(self.root, "out")
+        for d in (self.in_dir, self.out_dir):
+            os.makedirs(d, exist_ok=True)
+        self._seq = 0
+
+    def push(self, record: Dict) -> str:
+        rid = record.get("uri") or uuid.uuid4().hex
+        self._seq += 1
+        fn = f"{time.time_ns():020d}_{self._seq:06d}_{rid}.json"
+        fd, tmp = tempfile.mkstemp(dir=self.root, suffix=".tmp")
+        with os.fdopen(fd, "w") as f:
+            json.dump({"rid": rid, "record": record}, f)
+        os.replace(tmp, os.path.join(self.in_dir, fn))
+        return rid
+
+    # claims older than this are from a crashed worker and get requeued
+    STALE_CLAIM_S = 60.0
+
+    def pop_batch(self, n: int, timeout: float = 0.1
+                  ) -> List[Tuple[str, Dict]]:
+        deadline = time.monotonic() + timeout
+        while True:
+            out = []
+            for fn in sorted(os.listdir(self.in_dir)):
+                if len(out) >= n:
+                    break
+                path = os.path.join(self.in_dir, fn)
+                if fn.endswith(".claimed"):
+                    # recover claims orphaned by a crashed worker
+                    try:
+                        if (time.time() - os.path.getmtime(path)
+                                > self.STALE_CLAIM_S):
+                            os.rename(path, path[: -len(".claimed")])
+                    except OSError:
+                        pass
+                    continue
+                if not fn.endswith(".json"):
+                    continue
+                claimed = path + ".claimed"
+                try:
+                    os.rename(path, claimed)  # atomic claim
+                except OSError:
+                    continue  # another worker won
+                with open(claimed) as f:
+                    blob = json.load(f)
+                os.unlink(claimed)
+                out.append((blob["rid"], blob["record"]))
+            if out or time.monotonic() >= deadline:
+                return out
+            time.sleep(0.005)
+
+    def __len__(self) -> int:
+        return sum(1 for fn in os.listdir(self.in_dir)
+                   if fn.endswith(".json"))
+
+    def trim(self, maxlen: int) -> int:
+        files = sorted(fn for fn in os.listdir(self.in_dir)
+                       if fn.endswith(".json"))
+        drop = max(0, len(files) - maxlen)
+        for fn in files[:drop]:
+            try:
+                os.unlink(os.path.join(self.in_dir, fn))
+            except OSError:
+                pass
+        return drop
+
+    def set_result(self, rid: str, value: Any) -> None:
+        fd, tmp = tempfile.mkstemp(dir=self.root, suffix=".tmp")
+        with os.fdopen(fd, "w") as f:
+            json.dump(value, f)
+        os.replace(tmp, os.path.join(self.out_dir, rid + ".json"))
+
+    def get_result(self, rid: str, timeout: float = 10.0) -> Any:
+        path = os.path.join(self.out_dir, rid + ".json")
+        deadline = time.monotonic() + timeout
+        while True:
+            if os.path.exists(path):
+                with open(path) as f:
+                    val = json.load(f)
+                os.unlink(path)
+                return val
+            if time.monotonic() >= deadline:
+                raise TimeoutError(f"no result for {rid}")
+            time.sleep(0.005)
+
+    def pending_results(self) -> List[str]:
+        return [fn[:-5] for fn in os.listdir(self.out_dir)
+                if fn.endswith(".json")]
+
+
+class RedisQueue:
+    """Redis-stream backend, wire-shaped like the reference
+    (xadd to the stream, results to hashes ``result:{uri}``) —
+    client.py:83-150 / ClusterServing.scala:107-138.  Requires the
+    ``redis`` package and a live server.
+
+    Reads go through a consumer group (XREADGROUP + XACK), so N workers
+    on one queue each claim disjoint records — the same exactly-one-
+    claimer contract as FileQueue."""
+
+    GROUP = "serving_workers"
+
+    def __init__(self, host: str = "localhost", port: int = 6379,
+                 name: str = "serving_stream"):
+        import redis  # gated import
+
+        self.name = name
+        self._r = redis.Redis(host=host, port=port, decode_responses=True)
+        self._consumer = uuid.uuid4().hex
+        try:
+            self._r.xgroup_create(self.name, self.GROUP, id="0",
+                                  mkstream=True)
+        except redis.ResponseError as e:  # BUSYGROUP = already exists
+            if "BUSYGROUP" not in str(e):
+                raise
+
+    def push(self, record: Dict) -> str:
+        rid = record.get("uri") or uuid.uuid4().hex
+        self._r.xadd(self.name, {"blob": json.dumps({"rid": rid,
+                                                     "record": record})})
+        return rid
+
+    def pop_batch(self, n: int, timeout: float = 0.1
+                  ) -> List[Tuple[str, Dict]]:
+        resp = self._r.xreadgroup(self.GROUP, self._consumer,
+                                  {self.name: ">"}, count=n,
+                                  block=int(timeout * 1000))
+        out = []
+        for _, entries in resp or []:
+            for eid, fields in entries:
+                blob = json.loads(fields["blob"])
+                out.append((blob["rid"], blob["record"]))
+                self._r.xack(self.name, self.GROUP, eid)
+        return out
+
+    def __len__(self) -> int:
+        return self._r.xlen(self.name)
+
+    def trim(self, maxlen: int) -> int:
+        before = self._r.xlen(self.name)
+        self._r.xtrim(self.name, maxlen=maxlen)
+        return max(0, before - self._r.xlen(self.name))
+
+    def set_result(self, rid: str, value: Any) -> None:
+        self._r.hset(f"result:{rid}", "value", json.dumps(value))
+
+    def get_result(self, rid: str, timeout: float = 10.0) -> Any:
+        deadline = time.monotonic() + timeout
+        while True:
+            v = self._r.hget(f"result:{rid}", "value")
+            if v is not None:
+                self._r.delete(f"result:{rid}")
+                return json.loads(v)
+            if time.monotonic() >= deadline:
+                raise TimeoutError(f"no result for {rid}")
+            time.sleep(0.01)
+
+    def pending_results(self) -> List[str]:
+        return [k.split(":", 1)[1] for k in self._r.keys("result:*")]
+
+
+def make_queue(backend: str = "memory", **kw):
+    """String lowering for queue backends."""
+    b = backend.lower()
+    if b in ("memory", "mem"):
+        return MemoryQueue(**kw)
+    if b in ("file", "spool"):
+        return FileQueue(**kw)
+    if b in ("redis",):
+        return RedisQueue(**kw)
+    raise ValueError(f"unknown queue backend {backend!r}; "
+                     "known: memory, file, redis")
+
+
+# ---------------------------------------------------------------------------
+# client (reference pyzoo/zoo/serving/client.py:58-150)
+# ---------------------------------------------------------------------------
+
+class InputQueue:
+    """Producer side: enqueue records for the serving worker."""
+
+    def __init__(self, queue):
+        self.queue = queue
+
+    def enqueue(self, uri: Optional[str] = None, **data) -> str:
+        """Enqueue arbitrary named arrays (reference enqueue:58)."""
+        rec: Dict[str, Any] = {"uri": uri or uuid.uuid4().hex}
+        for k, v in data.items():
+            rec[k] = encode_tensor(v)
+        return self.queue.push(rec)
+
+    def enqueue_image(self, uri: Optional[str] = None, image=None) -> str:
+        """Enqueue one image (path or ndarray) — reference
+        enqueue_image:83 (base64 xadd)."""
+        rec = {"uri": uri or uuid.uuid4().hex, **encode_image(image)}
+        return self.queue.push(rec)
+
+
+class OutputQueue:
+    """Consumer side: fetch prediction results."""
+
+    def __init__(self, queue):
+        self.queue = queue
+
+    def query(self, uri: str, timeout: float = 10.0) -> Any:
+        """Result for one uri (reference query:140)."""
+        return self.queue.get_result(uri, timeout=timeout)
+
+    def dequeue(self, timeout: float = 10.0) -> Dict[str, Any]:
+        """Drain all currently-available results (reference dequeue:127)."""
+        deadline = time.monotonic() + timeout
+        while True:
+            pend = self.queue.pending_results()
+            if pend:
+                return {rid: self.queue.get_result(rid, timeout=1.0)
+                        for rid in pend}
+            if time.monotonic() >= deadline:
+                return {}
+            time.sleep(0.01)
+
+
+# ---------------------------------------------------------------------------
+# the serving worker (reference ClusterServing.scala main loop)
+# ---------------------------------------------------------------------------
+
+class ServingConfig:
+    """YAML/dict config (reference ClusterServingHelper.scala:104-170)."""
+
+    def __init__(self, model_path: Optional[str] = None, batch_size: int = 32,
+                 backpressure_maxlen: int = 10_000, poll_timeout_s: float = 0.1,
+                 postprocess_top_n: Optional[int] = None, int8: bool = False,
+                 tensorboard_dir: Optional[str] = None):
+        self.model_path = model_path
+        self.batch_size = batch_size
+        self.backpressure_maxlen = backpressure_maxlen
+        self.poll_timeout_s = poll_timeout_s
+        self.postprocess_top_n = postprocess_top_n
+        self.int8 = int8
+        self.tensorboard_dir = tensorboard_dir
+
+    @classmethod
+    def from_yaml(cls, path: str) -> "ServingConfig":
+        import yaml
+
+        with open(path) as f:
+            blob = yaml.safe_load(f) or {}
+        return cls(**blob)
+
+
+def _decode_record(rec: Dict) -> Dict[str, np.ndarray]:
+    out = {}
+    if "image" in rec:
+        out["image"] = decode_image(rec)
+    for k, v in rec.items():
+        if k != "image" and isinstance(v, dict) and "b64" in v:
+            out[k] = decode_tensor(v)
+    return out
+
+
+class ClusterServing:
+    """The worker loop: pop batch → decode → predict → write results.
+
+    One process per TPU chip/slice; scale out by running more workers on
+    the same queue (FileQueue/RedisQueue hand each record to exactly one
+    claimer).  Backpressure trims the input stream like the reference's
+    XTRIM-at-memory-threshold (ClusterServing.scala:123-138).
+    """
+
+    def __init__(self, model, queue, config: Optional[ServingConfig] = None,
+                 preprocess: Optional[Callable] = None):
+        self.model = model  # InferenceModel
+        self.queue = queue
+        self.cfg = config or ServingConfig()
+        self.preprocess = preprocess
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.records_served = 0
+        self._tb = None
+        if self.cfg.tensorboard_dir:
+            from analytics_zoo_tpu.core.summary import SummaryWriter
+            self._tb = SummaryWriter(self.cfg.tensorboard_dir)
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> "ClusterServing":
+        self._thread = threading.Thread(target=self.run_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    def run_forever(self) -> None:
+        import logging
+
+        log = logging.getLogger("analytics_zoo_tpu.deploy")
+        while not self._stop.is_set():
+            try:
+                self.serve_once()
+            except Exception:  # keep serving: one bad batch must not
+                log.exception("serving batch failed; worker continues")
+                time.sleep(0.05)  # kill the worker (reference keeps its
+                #                   streaming query alive the same way)
+
+    # -- one scheduling quantum -------------------------------------------
+    def serve_once(self) -> int:
+        """Serve up to one batch; returns number of records served."""
+        dropped = self.queue.trim(self.cfg.backpressure_maxlen)
+        if dropped:
+            import logging
+            logging.getLogger("analytics_zoo_tpu.deploy").warning(
+                "backpressure: dropped %d queued records", dropped)
+        batch = self.queue.pop_batch(self.cfg.batch_size,
+                                     timeout=self.cfg.poll_timeout_s)
+        if not batch:
+            return 0
+        t0 = time.perf_counter()
+        rids, arrays = [], []
+        for rid, rec in batch:
+            try:
+                decoded = _decode_record(rec)
+                x = decoded.get("image")
+                if x is None:  # first non-image tensor
+                    x = next(iter(decoded.values()))
+                if self.preprocess is not None:
+                    x = self.preprocess(x)
+                x = np.asarray(x)
+                if arrays and x.shape != arrays[0].shape:
+                    raise ValueError(
+                        f"record shape {x.shape} != batch {arrays[0].shape}")
+            except Exception as e:
+                # a bad record answers with an error instead of poisoning
+                # the batch (clients see it in query() rather than a hang)
+                self.queue.set_result(rid, {"error": str(e)})
+                continue
+            rids.append(rid)
+            arrays.append(x)
+        if not arrays:
+            return 0
+        x = np.stack(arrays, axis=0)
+        out = self.model.predict(x)
+        outs = out[0] if isinstance(out, list) else out
+        for i, rid in enumerate(rids):
+            row = np.asarray(outs[i])
+            if self.cfg.postprocess_top_n and row.ndim == 1:
+                # top-N (class, prob) pairs — reference PostProcessing topN
+                idx = np.argsort(row)[::-1][: self.cfg.postprocess_top_n]
+                val = [[int(j), float(row[j])] for j in idx]
+            else:
+                val = row.tolist()
+            self.queue.set_result(rid, val)
+        dt = time.perf_counter() - t0
+        self.records_served += len(rids)
+        if self._tb is not None:
+            # reference "Serving Throughput"/"Total Records Number" scalars
+            self._tb.add_scalar("serving_throughput", len(rids) / dt,
+                                self.records_served)
+            self._tb.add_scalar("total_records", self.records_served,
+                                self.records_served)
+        return len(rids)

@@ -1,0 +1,322 @@
+"""Deployment layer tests: InferenceModel, int8, batching, serving.
+
+Mirrors the reference test surface for pipeline/inference (InferenceModel
+load/predict concurrency) and serving (client enqueue → worker → dequeue,
+backpressure) — SURVEY.md §3.4.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.deploy import (
+    ClusterServing, DynamicBatcher, FileQueue, InferenceModel, InputQueue,
+    MemoryQueue, OutputQueue, ServingConfig, decode_image, encode_image,
+    make_queue, quantize_pytree, dequantize_pytree)
+from analytics_zoo_tpu.nn import Dense, Sequential
+from analytics_zoo_tpu.nn.layers.core import Activation
+from analytics_zoo_tpu.train.optimizers import Adam
+
+
+def _trained_net(in_dim=8, out_dim=3, n=64):
+    from analytics_zoo_tpu.nn import reset_name_scope
+
+    reset_name_scope()
+    net = Sequential([Dense(16, input_shape=(in_dim,)), Activation("relu"),
+                      Dense(out_dim)])
+    net.compile(optimizer=Adam(1e-2), loss="mse")
+    rs = np.random.RandomState(0)
+    x = rs.randn(n, in_dim).astype(np.float32)
+    y = rs.randn(n, out_dim).astype(np.float32)
+    net.fit(x, y, batch_size=32, nb_epoch=1, verbose=False)
+    return net, x
+
+
+class TestInferenceModel:
+    def test_from_keras_net_matches_predict(self):
+        net, x = _trained_net()
+        m = InferenceModel.from_keras_net(net, net.estimator.params,
+                                          net.estimator.state)
+        out = m.predict(x[:10])
+        ref = net.predict(x[:10], batch_size=10)
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+    def test_native_load_roundtrip(self, tmp_path):
+        from analytics_zoo_tpu.models import NeuralCF
+        from analytics_zoo_tpu.nn import reset_name_scope
+
+        reset_name_scope()
+        ncf = NeuralCF(user_count=20, item_count=10, class_num=3)
+        ncf.compile(optimizer=Adam(1e-3),
+                    loss="sparse_categorical_crossentropy")
+        rs = np.random.RandomState(0)
+        u = rs.randint(1, 21, (32, 1)).astype(np.int32)
+        it = rs.randint(1, 11, (32, 1)).astype(np.int32)
+        y = rs.randint(0, 3, 32).astype(np.int32)
+        ncf.fit([u, it], y, batch_size=32, nb_epoch=1, verbose=False)
+        ref = ncf.predict([u, it], batch_size=32)
+        ncf.save_model(str(tmp_path / "m"))
+
+        reset_name_scope()
+        m = InferenceModel.load(str(tmp_path / "m"))
+        out = m.predict([u, it])
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+    def test_bucket_padding_and_chunking(self):
+        net, x = _trained_net(n=600)
+        m = InferenceModel.from_keras_net(net, net.estimator.params,
+                                          net.estimator.state,
+                                          batch_buckets=(8, 64))
+        for n in (3, 8, 17, 300):  # pad, exact, pad, chunk
+            out = m.predict(x[:n] if n <= 600 else x)
+            assert out.shape[0] == n
+            ref = net.predict(x[:n], batch_size=64)
+            np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+    def test_predict_classes(self):
+        net, x = _trained_net()
+        m = InferenceModel.from_keras_net(net, net.estimator.params,
+                                          net.estimator.state)
+        cls = m.predict_classes(x[:7])
+        assert cls.shape == (7,) and cls.dtype.kind == "i"
+
+    def test_thread_safety(self):
+        net, x = _trained_net()
+        m = InferenceModel.from_keras_net(net, net.estimator.params,
+                                          net.estimator.state)
+        ref = m.predict(x[:16])
+        errs = []
+
+        def worker():
+            try:
+                for _ in range(5):
+                    np.testing.assert_allclose(m.predict(x[:16]), ref,
+                                               rtol=1e-5, atol=1e-5)
+            except Exception as e:  # pragma: no cover
+                errs.append(e)
+
+        ts = [threading.Thread(target=worker) for _ in range(4)]
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+        assert not errs
+
+
+class TestInt8:
+    def test_quantize_dequantize_close(self):
+        rs = np.random.RandomState(0)
+        w = rs.randn(64, 32).astype(np.float32)
+        q = quantize_pytree({"k": w}, min_size=16)
+        assert q["k"]["q"].dtype == np.int8
+        back = np.asarray(dequantize_pytree(q)["k"])
+        assert np.max(np.abs(back - w)) < np.max(np.abs(w)) / 100
+
+    def test_int8_predict_close_to_fp32(self):
+        net, x = _trained_net()
+        p, s = net.estimator.params, net.estimator.state
+        m32 = InferenceModel.from_keras_net(net, p, s)
+        m8 = InferenceModel.from_keras_net(net, p, s, int8=True)
+        a, b = m32.predict(x[:16]), m8.predict(x[:16])
+        # int8 weight error is small relative to activation scale
+        assert np.max(np.abs(a - b)) < 0.1 * (np.max(np.abs(a)) + 1e-6)
+
+    def test_small_leaves_not_quantized(self):
+        q = quantize_pytree({"bias": np.zeros(4, np.float32)})
+        assert isinstance(q["bias"], np.ndarray)
+
+
+class TestDynamicBatcher:
+    def test_concurrent_requests_fused(self):
+        net, x = _trained_net()
+        m = InferenceModel.from_keras_net(net, net.estimator.params,
+                                          net.estimator.state)
+        batcher = DynamicBatcher(m, max_batch=32, max_latency_ms=20)
+        try:
+            ref = m.predict(x[:12])
+            results = {}
+
+            def one(i):
+                results[i] = batcher.predict(x[i:i + 1])
+
+            ts = [threading.Thread(target=one, args=(i,)) for i in range(12)]
+            [t.start() for t in ts]
+            [t.join() for t in ts]
+            got = np.concatenate([results[i] for i in range(12)], axis=0)
+            np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+        finally:
+            batcher.close()
+
+
+class TestQueues:
+    @pytest.mark.parametrize("backend", ["memory", "file"])
+    def test_push_pop_result_roundtrip(self, backend, tmp_path):
+        q = (MemoryQueue() if backend == "memory"
+             else FileQueue(str(tmp_path)))
+        rid = q.push({"uri": "a", "x": 1})
+        assert rid == "a" and len(q) == 1
+        got = q.pop_batch(8)
+        assert got == [("a", {"uri": "a", "x": 1})] and len(q) == 0
+        q.set_result("a", [1.0, 2.0])
+        assert q.get_result("a") == [1.0, 2.0]
+
+    @pytest.mark.parametrize("backend", ["memory", "file"])
+    def test_trim_backpressure(self, backend, tmp_path):
+        q = (MemoryQueue() if backend == "memory"
+             else FileQueue(str(tmp_path)))
+        for i in range(10):
+            q.push({"uri": f"r{i}"})
+        dropped = q.trim(4)
+        assert dropped == 6 and len(q) == 4
+        # oldest were dropped: first remaining is r6
+        assert q.pop_batch(1)[0][0] == "r6"
+
+    def test_make_queue_lowering(self, tmp_path):
+        assert isinstance(make_queue("memory"), MemoryQueue)
+        assert isinstance(make_queue("file", root=str(tmp_path)), FileQueue)
+        with pytest.raises(ValueError):
+            make_queue("kafka")
+
+    def test_image_codec_roundtrip(self):
+        img = (np.random.RandomState(0).rand(6, 5, 3) * 255).astype(np.uint8)
+        back = decode_image(encode_image(img))
+        np.testing.assert_array_equal(img, back)
+
+
+class TestClusterServing:
+    def _model(self):
+        net, x = _trained_net(in_dim=12, out_dim=4)
+        return InferenceModel.from_keras_net(
+            net, net.estimator.params, net.estimator.state), x
+
+    def test_end_to_end_memory(self):
+        m, x = self._model()
+        q = MemoryQueue()
+        serving = ClusterServing(m, q, ServingConfig(batch_size=8))
+        inp, outp = InputQueue(q), OutputQueue(q)
+        for i in range(5):
+            inp.enqueue(uri=f"req{i}", x=x[i])
+        served = 0
+        while served < 5:
+            served += serving.serve_once()
+        res = outp.query("req3")
+        ref = m.predict(x[3:4])[0]
+        np.testing.assert_allclose(np.asarray(res), ref, rtol=1e-4,
+                                   atol=1e-4)
+
+    def test_end_to_end_file_backend_with_images(self, tmp_path):
+        net, _ = _trained_net(in_dim=27, out_dim=2)  # 3*3*3 image flattened
+        m = InferenceModel.from_keras_net(
+            net, net.estimator.params, net.estimator.state)
+        q = FileQueue(str(tmp_path))
+        serving = ClusterServing(
+            m, q, ServingConfig(batch_size=4, postprocess_top_n=2),
+            preprocess=lambda im: im.astype(np.float32).reshape(-1) / 255.0)
+        inp, outp = InputQueue(q), OutputQueue(q)
+        rs = np.random.RandomState(0)
+        img = (rs.rand(3, 3, 3) * 255).astype(np.uint8)
+        inp.enqueue_image(uri="img0", image=img)
+        assert serving.serve_once() == 1
+        res = outp.query("img0")
+        assert len(res) == 2 and len(res[0]) == 2  # top-2 (class, prob)
+
+    def test_worker_thread_and_dequeue(self):
+        m, x = self._model()
+        q = MemoryQueue()
+        serving = ClusterServing(m, q, ServingConfig(
+            batch_size=8, poll_timeout_s=0.02)).start()
+        try:
+            inp, outp = InputQueue(q), OutputQueue(q)
+            for i in range(4):
+                inp.enqueue(uri=f"t{i}", x=x[i])
+            got = {}
+            deadline = 40
+            while len(got) < 4 and deadline:
+                got.update(outp.dequeue(timeout=0.5))
+                deadline -= 1
+            assert set(got) == {"t0", "t1", "t2", "t3"}
+        finally:
+            serving.stop()
+
+    def test_bad_record_gets_error_result_not_poison(self):
+        """An undecodable/mis-shaped record answers with an error; the
+        rest of the batch still serves (worker resilience)."""
+        m, x = self._model()
+        q = MemoryQueue()
+        serving = ClusterServing(m, q, ServingConfig(batch_size=8))
+        inp, outp = InputQueue(q), OutputQueue(q)
+        inp.enqueue(uri="good0", x=x[0])
+        q.push({"uri": "bad", "image": "!!!not-base64-payload",
+                "codec": "file"})
+        inp.enqueue(uri="good1", x=x[1])
+        served = 0
+        for _ in range(10):
+            served += serving.serve_once()
+            if served >= 2:
+                break
+        assert served == 2
+        err = outp.query("bad")
+        assert isinstance(err, dict) and "error" in err
+        assert np.asarray(outp.query("good0")).shape == (4,)
+
+    def test_file_queue_recovers_stale_claims(self, tmp_path):
+        q = FileQueue(str(tmp_path))
+        q.push({"uri": "a"})
+        # simulate a worker that claimed and crashed
+        fn = [f for f in os.listdir(q.in_dir) if f.endswith(".json")][0]
+        claimed = os.path.join(q.in_dir, fn + ".claimed")
+        os.rename(os.path.join(q.in_dir, fn), claimed)
+        old = time.time() - 120
+        os.utime(claimed, (old, old))
+        q.push({"uri": "b"})
+        got = q.pop_batch(8, timeout=0.2)
+        got += q.pop_batch(8, timeout=0.2)  # recovered claim next poll
+        assert sorted(rid for rid, _ in got) == ["a", "b"]
+
+    def test_batcher_close_fails_pending(self):
+        net, x = _trained_net()
+        m = InferenceModel.from_keras_net(net, net.estimator.params,
+                                          net.estimator.state)
+        b = DynamicBatcher(m, max_batch=4, max_latency_ms=1)
+        b._stop.set()  # wedge the loop before draining
+        b._thread.join(timeout=2)
+        res = {}
+
+        def call():
+            try:
+                b.predict(x[:1])
+            except RuntimeError as e:
+                res["err"] = e
+
+        t = threading.Thread(target=call)
+        t.start()
+        time.sleep(0.05)
+        b.close()
+        t.join(timeout=2)
+        assert not t.is_alive() and "err" in res
+
+    def test_predict_honors_batch_size(self):
+        calls = []
+
+        def fwd(xs):
+            calls.append(xs[0].shape[0])
+            return xs[0] * 2.0
+
+        m = InferenceModel(fwd, batch_buckets=(1, 8, 64))
+        x = np.ones((20, 3), np.float32)
+        out = m.predict(x, batch_size=4)
+        assert out.shape == (20, 3)
+        assert all(c <= 4 for c in calls)
+
+    def test_backpressure_drops_oldest(self):
+        m, x = self._model()
+        q = MemoryQueue()
+        serving = ClusterServing(m, q, ServingConfig(
+            batch_size=4, backpressure_maxlen=3))
+        inp = InputQueue(q)
+        for i in range(8):
+            inp.enqueue(uri=f"b{i}", x=x[i])
+        serving.serve_once()
+        # 8 queued, trimmed to 3 (b5..b7), then up to batch_size served
+        assert serving.records_served == 3

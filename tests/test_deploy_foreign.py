@@ -1,0 +1,67 @@
+"""Foreign-model ingestion tests: TF SavedModel / tf.keras / TorchScript
+into InferenceModel (reference doLoadTF/doLoadPyTorch,
+InferenceModel.scala:86-443; TFNet.scala:654).
+
+TF/torch are optional at runtime — tests skip when absent.
+"""
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.deploy import InferenceModel
+
+tf = pytest.importorskip("tensorflow")
+torch = pytest.importorskip("torch")
+
+
+class TestTFIngestion:
+    def _keras_model(self):
+        inp = tf.keras.Input(shape=(6,))
+        out = tf.keras.layers.Dense(4, activation="relu")(inp)
+        out = tf.keras.layers.Dense(2)(out)
+        return tf.keras.Model(inp, out)
+
+    def test_saved_model_roundtrip(self, tmp_path):
+        model = self._keras_model()
+        x = np.random.RandomState(0).randn(5, 6).astype(np.float32)
+        ref = model(x).numpy()
+        path = str(tmp_path / "sm")
+        tf.saved_model.save(
+            model, path,
+            signatures=tf.function(
+                lambda t: model(t)).get_concrete_function(
+                    tf.TensorSpec([None, 6], tf.float32)))
+        m = InferenceModel.load_tf_saved_model(path)
+        out = m.predict(x)
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5,
+                                   atol=1e-5)
+
+    def test_tf_keras_object(self):
+        model = self._keras_model()
+        x = np.random.RandomState(1).randn(3, 6).astype(np.float32)
+        ref = model(x).numpy()
+        m = InferenceModel.load_tf_keras(model)
+        np.testing.assert_allclose(np.asarray(m.predict(x)), ref,
+                                   rtol=1e-5, atol=1e-5)
+
+
+class TestTorchIngestion:
+    def test_torch_module(self):
+        net = torch.nn.Sequential(torch.nn.Linear(5, 8), torch.nn.ReLU(),
+                                  torch.nn.Linear(8, 3))
+        x = np.random.RandomState(0).randn(4, 5).astype(np.float32)
+        with torch.no_grad():
+            ref = net(torch.from_numpy(x)).numpy()
+        m = InferenceModel.load_torch(net)
+        np.testing.assert_allclose(m.predict(x), ref, rtol=1e-5, atol=1e-5)
+
+    def test_torchscript_file(self, tmp_path):
+        net = torch.nn.Linear(3, 2)
+        scripted = torch.jit.script(net)
+        path = str(tmp_path / "m.pt")
+        scripted.save(path)
+        x = np.random.RandomState(0).randn(2, 3).astype(np.float32)
+        with torch.no_grad():
+            ref = net(torch.from_numpy(x)).numpy()
+        m = InferenceModel.load_torch(path)
+        np.testing.assert_allclose(m.predict(x), ref, rtol=1e-5, atol=1e-5)
