@@ -14,9 +14,9 @@ TPU-first redesign:
   of compiled programs stays bounded (replaces per-shape model clones).
 - Foreign models: TF SavedModel / tf.keras ingested via
   ``jax2tf.call_tf`` (host TF executes the graph, JAX orchestrates) or —
-  preferred — weight-mapped into native layers by tfpark; torch modules
-  run in-process through torch (the reference ran libtorch via JNI
-  in-process too).
+  preferred — converted to a pure JAX program with imported weights by
+  ``tfpark.convert_keras_model``; torch modules run in-process through
+  torch (the reference ran libtorch via JNI in-process too).
 - INT8: native weight quantization (per-channel symmetric) replacing the
   reference's OpenVINO calibration — int8 tables live in HBM, dequant is
   fused into the consuming matmul by XLA, halving weight bandwidth.
@@ -60,7 +60,10 @@ def quantize_pytree(params, min_size: int = 1024):
         a = np.asarray(leaf)
         if a.dtype.kind != "f" or a.size < min_size or a.ndim == 0:
             return leaf
-        amax = np.max(np.abs(a), axis=tuple(range(a.ndim - 1)), keepdims=True)
+        # per-channel (last axis) for >=2-D; per-tensor for 1-D (a
+        # per-element scale would be larger than the original weights)
+        axes = tuple(range(a.ndim - 1)) if a.ndim >= 2 else (0,)
+        amax = np.max(np.abs(a), axis=axes, keepdims=True)
         scale = np.where(amax > 0, amax / 127.0, 1.0).astype(np.float32)
         q = np.clip(np.round(a / scale), -127, 127).astype(np.int8)
         return {"q": q, "scale": scale.astype(np.float32)}
@@ -296,12 +299,18 @@ class DynamicBatcher:
     def predict(self, inputs) -> Any:
         """Enqueue one request (single example or small batch); blocks
         until its slice of the fused batch returns."""
+        if self._stop.is_set():
+            raise RuntimeError("DynamicBatcher is closed")
         xs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
         xs = [np.asarray(x) for x in xs]
         done = threading.Event()
         slot: Dict[str, Any] = {}
         self._q.put((xs, done, slot))
-        done.wait()
+        while not done.wait(timeout=1.0):
+            if self._stop.is_set() and not done.is_set():
+                # raced with close(): the worker may have exited before
+                # popping this request — close() drains, but don't hang
+                raise RuntimeError("DynamicBatcher closed while waiting")
         if "error" in slot:
             raise slot["error"]
         return slot["out"]

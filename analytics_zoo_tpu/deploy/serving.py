@@ -492,7 +492,14 @@ class ClusterServing:
         if not arrays:
             return 0
         x = np.stack(arrays, axis=0)
-        out = self.model.predict(x)
+        try:
+            out = self.model.predict(x)
+        except Exception as e:
+            # records are already destructively popped from the queue —
+            # answer every one with the error rather than losing them
+            for rid in rids:
+                self.queue.set_result(rid, {"error": str(e)})
+            return 0
         outs = out[0] if isinstance(out, list) else out
         for i, rid in enumerate(rids):
             row = np.asarray(outs[i])

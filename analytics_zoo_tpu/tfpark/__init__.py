@@ -1,0 +1,14 @@
+"""TFPark equivalent: foreign-model ingestion (L5).
+
+Reference capability: pyzoo/zoo/tfpark/ — TFDataset (tf_dataset.py:115),
+TFOptimizer (tf_optimizer.py:336), KerasModel (model.py:34), TFNet
+(tfnet.py:51) — training and serving other frameworks' models under the
+zoo engine.  Here ingestion means *conversion to native JAX* (see
+converter.py) so the training hot loop is one XLA program.
+"""
+
+from analytics_zoo_tpu.tfpark.converter import (  # noqa: F401
+    GraphProgram, UnsupportedLayerError, convert_keras_model)
+from analytics_zoo_tpu.tfpark.model import (  # noqa: F401
+    FunctionModel, KerasModel, TFNet, TFOptimizer, TorchModel)
+from analytics_zoo_tpu.tfpark.tf_dataset import TFDataset  # noqa: F401

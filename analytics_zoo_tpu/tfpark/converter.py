@@ -1,0 +1,478 @@
+"""tf.keras → native JAX conversion (the heart of the TFPark equivalent).
+
+Reference capability: TFPark trains *foreign* TF models under the zoo
+engine by exporting the TF graph and running it via JNI per partition
+(tf_optimizer.py:225-334, TFTrainingHelper.scala:32).  On TPU that
+two-runtime trick would put host TF in the hot loop, so the redesign
+*ingests* the model instead: the Keras layer graph is converted to a pure
+JAX function + imported weight pytree, and then trains natively under the
+SPMD Estimator — one fused XLA program, no TF at step time.
+
+Supported: Sequential + single-node functional graphs over the common
+layer vocabulary (Dense/Conv/BN/pool/merge/activations/...).  Anything
+else raises ``UnsupportedLayerError`` — callers can fall back to
+``deploy.InferenceModel.load_tf_keras`` (call_tf) for inference.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["convert_keras_model", "UnsupportedLayerError", "GraphProgram"]
+
+
+class UnsupportedLayerError(ValueError):
+    pass
+
+
+_ACTS = {
+    "linear": lambda x: x,
+    "relu": jax.nn.relu,
+    "relu6": lambda x: jnp.clip(x, 0, 6),
+    "sigmoid": jax.nn.sigmoid,
+    "tanh": jnp.tanh,
+    "softmax": lambda x: jax.nn.softmax(x, axis=-1),
+    "gelu": jax.nn.gelu,
+    "elu": jax.nn.elu,
+    "selu": jax.nn.selu,
+    "softplus": jax.nn.softplus,
+    "swish": jax.nn.silu,
+    "silu": jax.nn.silu,
+    "hard_sigmoid": jax.nn.hard_sigmoid,
+    "exponential": jnp.exp,
+}
+
+
+def _act(name: Optional[str]) -> Callable:
+    if name is None:
+        return _ACTS["linear"]
+    if callable(name):
+        raise UnsupportedLayerError("custom activation callables are not "
+                                    "convertible; use a string activation")
+    if name not in _ACTS:
+        raise UnsupportedLayerError(f"activation {name!r}")
+    return _ACTS[name]
+
+
+def _pair(v) -> Tuple[int, int]:
+    if isinstance(v, (list, tuple)):
+        return (int(v[0]), int(v[1]))
+    return (int(v), int(v))
+
+
+# ---------------------------------------------------------------------------
+# per-layer converters: (config, weights) -> (params, op)
+# op signature: op(p, xs: List[arr], training, rng, state_in) -> (out, state)
+# ---------------------------------------------------------------------------
+
+def _stateless(fn):
+    def op(p, xs, training, rng, st):
+        return fn(p, xs, training, rng), st
+    return op
+
+
+def _conv_dn(x_ndim):
+    return jax.lax.conv_dimension_numbers(
+        (1,) * x_ndim, (1,) * x_ndim,
+        ("NHWC", "HWIO", "NHWC") if x_ndim == 4 else ("NWC", "WIO", "NWC"))
+
+
+def _convert_dense(cfg, w):
+    act = _act(cfg.get("activation"))
+    p = {"kernel": w[0]}
+    if cfg.get("use_bias", True):
+        p["bias"] = w[1]
+
+    def fn(p, xs, training, rng):
+        y = jnp.dot(xs[0], p["kernel"])
+        if "bias" in p:
+            y = y + p["bias"]
+        return act(y)
+
+    return p, _stateless(fn)
+
+
+def _convert_embedding(cfg, w):
+    p = {"table": w[0]}
+
+    def fn(p, xs, training, rng):
+        return jnp.take(p["table"], xs[0].astype(jnp.int32), axis=0)
+
+    return p, _stateless(fn)
+
+
+def _make_conv(cfg, w, ndim, depthwise=False):
+    strides = _pair(cfg.get("strides", 1)) if ndim == 4 else (
+        (int(cfg.get("strides", [1])[0]
+             if isinstance(cfg.get("strides", 1), (list, tuple))
+             else cfg.get("strides", 1)),))
+    dilation = cfg.get("dilation_rate", 1)
+    dilation = (_pair(dilation) if ndim == 4 else
+                ((int(dilation[0]) if isinstance(dilation, (list, tuple))
+                  else int(dilation)),))
+    padding = cfg.get("padding", "valid").upper()
+    act = _act(cfg.get("activation"))
+    use_bias = cfg.get("use_bias", True)
+    p = {"kernel": w[0]}
+    if use_bias:
+        p["bias"] = w[1]
+
+    def fn(p, xs, training, rng):
+        x = xs[0]
+        k = p["kernel"]
+        if depthwise:
+            # keras depthwise kernel (kh, kw, cin, mult) → HWIO with
+            # feature_group_count=cin
+            kh, kw, cin, mult = k.shape
+            k = k.reshape(kh, kw, 1, cin * mult)
+            y = jax.lax.conv_general_dilated(
+                x, k, window_strides=strides, padding=padding,
+                rhs_dilation=dilation, dimension_numbers=_conv_dn(4),
+                feature_group_count=cin)
+        else:
+            y = jax.lax.conv_general_dilated(
+                x, k, window_strides=strides, padding=padding,
+                rhs_dilation=dilation, dimension_numbers=_conv_dn(x.ndim))
+        if "bias" in p:
+            y = y + p["bias"]
+        return act(y)
+
+    return p, _stateless(fn)
+
+
+def _make_pool(cfg, reducer, init, ndim, average=False):
+    pool = cfg.get("pool_size", 2)
+    pool = _pair(pool) if ndim == 4 else (
+        (int(pool[0]) if isinstance(pool, (list, tuple)) else int(pool)),)
+    strides = cfg.get("strides") or pool
+    strides = _pair(strides) if ndim == 4 else (
+        (int(strides[0]) if isinstance(strides, (list, tuple))
+         else int(strides)),)
+    padding = cfg.get("padding", "valid").upper()
+
+    def fn(p, xs, training, rng):
+        x = xs[0]
+        dims = (1,) + pool + (1,)
+        strd = (1,) + strides + (1,)
+        y = jax.lax.reduce_window(x, init, reducer, dims, strd, padding)
+        if average:
+            ones = jnp.ones(x.shape[1:-1], x.dtype)[None, ..., None]
+            cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, dims, strd,
+                                        padding)
+            y = y / cnt
+        return y
+
+    return {}, _stateless(fn)
+
+
+def _convert_batchnorm(cfg, w):
+    eps = cfg.get("epsilon", 1e-3)
+    momentum = cfg.get("momentum", 0.99)
+    scale, center = cfg.get("scale", True), cfg.get("center", True)
+    i = 0
+    p = {}
+    if scale:
+        p["gamma"] = w[i]; i += 1
+    if center:
+        p["beta"] = w[i]; i += 1
+    moving_mean, moving_var = w[i], w[i + 1]
+
+    def op(p, xs, training, rng, st):
+        x = xs[0]
+        axes = tuple(range(x.ndim - 1))
+        if training:
+            mean = jnp.mean(x, axis=axes)
+            var = jnp.var(x, axis=axes)
+            new_st = {
+                "mean": st["mean"] * momentum + mean * (1 - momentum),
+                "var": st["var"] * momentum + var * (1 - momentum)}
+        else:
+            mean, var = st["mean"], st["var"]
+            new_st = st
+        y = (x - mean) / jnp.sqrt(var + eps)
+        if "gamma" in p:
+            y = y * p["gamma"]
+        if "beta" in p:
+            y = y + p["beta"]
+        return y, new_st
+
+    return p, op, {"mean": moving_mean, "var": moving_var}
+
+
+def _convert_zeropad(cfg, w):
+    pad = cfg.get("padding", 1)
+    if isinstance(pad, int):
+        pad = ((pad, pad), (pad, pad))
+    else:
+        pad = tuple((p, p) if isinstance(p, int) else tuple(p) for p in pad)
+
+    def fn(p, xs, training, rng):
+        return jnp.pad(xs[0], ((0, 0),) + pad + ((0, 0),))
+
+    return {}, _stateless(fn)
+
+
+def _convert_dropout(cfg, w):
+    rate = cfg.get("rate", 0.5)
+
+    def fn(p, xs, training, rng):
+        x = xs[0]
+        if not training or rng is None or rate <= 0:
+            return x
+        keep = jax.random.bernoulli(rng, 1.0 - rate, x.shape)
+        return jnp.where(keep, x / (1.0 - rate), 0.0)
+
+    return {}, _stateless(fn)
+
+
+def _convert_layernorm(cfg, w):
+    eps = cfg.get("epsilon", 1e-3)
+    i = 0
+    p = {}
+    if cfg.get("scale", True):
+        p["gamma"] = w[i]; i += 1
+    if cfg.get("center", True):
+        p["beta"] = w[i]; i += 1
+
+    def fn(p, xs, training, rng):
+        x = xs[0]
+        mean = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.var(x, axis=-1, keepdims=True)
+        y = (x - mean) / jnp.sqrt(var + eps)
+        if "gamma" in p:
+            y = y * p["gamma"]
+        if "beta" in p:
+            y = y + p["beta"]
+        return y
+
+    return p, _stateless(fn)
+
+
+def _merge(fn2):
+    def fn(p, xs, training, rng):
+        out = xs[0]
+        for x in xs[1:]:
+            out = fn2(out, x)
+        return out
+    return {}, _stateless(fn)
+
+
+def _convert_layer(class_name: str, cfg: Dict, weights: List[np.ndarray]):
+    """Returns (params, op, state) for one keras layer."""
+    cn = class_name
+    if cn == "Dense":
+        return (*_convert_dense(cfg, weights), {})
+    if cn == "Embedding":
+        return (*_convert_embedding(cfg, weights), {})
+    if cn in ("Conv2D", "Convolution2D"):
+        return (*_make_conv(cfg, weights, 4), {})
+    if cn in ("Conv1D", "Convolution1D"):
+        return (*_make_conv(cfg, weights, 3), {})
+    if cn == "DepthwiseConv2D":
+        return (*_make_conv(cfg, weights, 4, depthwise=True), {})
+    if cn == "MaxPooling2D":
+        return (*_make_pool(cfg, jax.lax.max, -jnp.inf, 4), {})
+    if cn == "AveragePooling2D":
+        return (*_make_pool(cfg, jax.lax.add, 0.0, 4, average=True), {})
+    if cn == "MaxPooling1D":
+        return (*_make_pool(cfg, jax.lax.max, -jnp.inf, 3), {})
+    if cn == "AveragePooling1D":
+        return (*_make_pool(cfg, jax.lax.add, 0.0, 3, average=True), {})
+    if cn == "GlobalAveragePooling2D":
+        return {}, _stateless(
+            lambda p, xs, t, r: jnp.mean(xs[0], axis=(1, 2))), {}
+    if cn == "GlobalMaxPooling2D":
+        return {}, _stateless(
+            lambda p, xs, t, r: jnp.max(xs[0], axis=(1, 2))), {}
+    if cn == "GlobalAveragePooling1D":
+        return {}, _stateless(
+            lambda p, xs, t, r: jnp.mean(xs[0], axis=1)), {}
+    if cn == "GlobalMaxPooling1D":
+        return {}, _stateless(
+            lambda p, xs, t, r: jnp.max(xs[0], axis=1)), {}
+    if cn == "Flatten":
+        return {}, _stateless(
+            lambda p, xs, t, r: xs[0].reshape(xs[0].shape[0], -1)), {}
+    if cn == "Reshape":
+        shape = tuple(cfg["target_shape"])
+        return {}, _stateless(
+            lambda p, xs, t, r: xs[0].reshape((xs[0].shape[0],) + shape)), {}
+    if cn == "Permute":
+        dims = tuple(cfg["dims"])
+        return {}, _stateless(
+            lambda p, xs, t, r: jnp.transpose(xs[0], (0,) + dims)), {}
+    if cn == "Activation":
+        a = _act(cfg.get("activation"))
+        return {}, _stateless(lambda p, xs, t, r: a(xs[0])), {}
+    if cn == "ReLU":
+        mx = cfg.get("max_value")
+        neg = cfg.get("negative_slope", 0.0) or 0.0
+        thr = cfg.get("threshold", 0.0) or 0.0
+
+        def relu_fn(p, xs, t, r):
+            x = xs[0]
+            y = jnp.where(x >= thr, x, neg * (x - thr))
+            if mx is not None:
+                y = jnp.minimum(y, mx)
+            return y
+
+        return {}, _stateless(relu_fn), {}
+    if cn == "LeakyReLU":
+        alpha = cfg.get("negative_slope", cfg.get("alpha", 0.3))
+        return {}, _stateless(
+            lambda p, xs, t, r: jax.nn.leaky_relu(xs[0], alpha)), {}
+    if cn == "Softmax":
+        axis = cfg.get("axis", -1)
+        return {}, _stateless(
+            lambda p, xs, t, r: jax.nn.softmax(xs[0], axis=axis)), {}
+    if cn == "BatchNormalization":
+        return _convert_batchnorm(cfg, weights)
+    if cn == "LayerNormalization":
+        return (*_convert_layernorm(cfg, weights), {})
+    if cn == "Dropout" or cn == "SpatialDropout2D":
+        return (*_convert_dropout(cfg, weights), {})
+    if cn == "ZeroPadding2D":
+        return (*_convert_zeropad(cfg, weights), {})
+    if cn == "Add":
+        return (*_merge(jnp.add), {})
+    if cn == "Subtract":
+        return (*_merge(jnp.subtract), {})
+    if cn == "Multiply":
+        return (*_merge(jnp.multiply), {})
+    if cn == "Maximum":
+        return (*_merge(jnp.maximum), {})
+    if cn == "Minimum":
+        return (*_merge(jnp.minimum), {})
+    if cn == "Average":
+        p, op = _merge(jnp.add)
+
+        def avg(p2, xs, training, rng, st):
+            (y, st2) = op(p2, xs, training, rng, st)
+            return y / len(xs), st2
+
+        return p, avg, {}
+    if cn == "Concatenate":
+        axis = cfg.get("axis", -1)
+        return {}, _stateless(
+            lambda p, xs, t, r: jnp.concatenate(xs, axis=axis)), {}
+    if cn in ("InputLayer",):
+        return {}, _stateless(lambda p, xs, t, r: xs[0]), {}
+    raise UnsupportedLayerError(f"keras layer {class_name!r}")
+
+
+# ---------------------------------------------------------------------------
+# graph walking
+# ---------------------------------------------------------------------------
+
+def _tensor_refs(obj, out: List[Tuple[str, int]]):
+    """Recursively collect keras_history refs from serialized call args."""
+    if isinstance(obj, dict):
+        if obj.get("class_name") == "__keras_tensor__":
+            name, node_idx, tensor_idx = obj["config"]["keras_history"]
+            if int(tensor_idx) != 0 or int(node_idx) != 0:
+                raise UnsupportedLayerError(
+                    "multi-output / shared-layer graphs")
+            out.append(name)
+        else:
+            for v in obj.values():
+                _tensor_refs(v, out)
+    elif isinstance(obj, (list, tuple)):
+        for v in obj:
+            _tensor_refs(v, out)
+
+
+class GraphProgram:
+    """A converted keras model: ordered ops over a name-keyed env.
+
+    ``call(params, state, *inputs, training=, rng=)`` mirrors the native
+    layer protocol so KerasModel can drop this into the Estimator.
+    """
+
+    def __init__(self, nodes, input_names, output_names, params, state):
+        self.nodes = nodes              # [(name, op, parent_names)]
+        self.input_names = input_names
+        self.output_names = output_names
+        self.params = params            # {layer_name: pytree}
+        self.state = state              # {layer_name: pytree}
+
+    def call(self, params, state, *inputs, training=False, rng=None):
+        if len(inputs) != len(self.input_names):
+            raise ValueError(f"expected {len(self.input_names)} inputs, "
+                             f"got {len(inputs)}")
+        env = dict(zip(self.input_names, inputs))
+        new_state = dict(state)
+        rngs = (jax.random.split(rng, len(self.nodes))
+                if rng is not None else [None] * len(self.nodes))
+        for (name, op, parents), r in zip(self.nodes, rngs):
+            xs = [env[p] for p in parents]
+            env[name], ns = op(params.get(name, {}), xs, training, r,
+                               state.get(name, {}))
+            if ns:  # only stateful nodes (BN) carry state — keeping the
+                new_state[name] = ns  # pytree structure step-stable
+        outs = [env[n] for n in self.output_names]
+        return (outs[0] if len(outs) == 1 else outs), new_state
+
+
+def convert_keras_model(model) -> GraphProgram:
+    """Convert a tf.keras Sequential/functional model (Keras 3 config
+    format) into a GraphProgram with imported weights."""
+    cfg = model.get_config()
+    layers_cfg = cfg["layers"]
+    is_sequential = type(model).__name__ == "Sequential"
+
+    params: Dict[str, Any] = {}
+    state: Dict[str, Any] = {}
+    nodes = []
+    input_names: List[str] = []
+    prev_name: Optional[str] = None
+
+    for lc in layers_cfg:
+        class_name = lc["class_name"]
+        lcfg = lc.get("config", {})
+        name = lcfg.get("name") or lc.get("name")
+        if class_name == "InputLayer":
+            input_names.append(name)
+            prev_name = name
+            continue
+        try:
+            klayer = model.get_layer(name)
+            weights = [np.asarray(w) for w in klayer.get_weights()]
+        except ValueError:
+            weights = []
+        p, op, st = _convert_layer(class_name, lcfg, weights)
+        if is_sequential:
+            if prev_name is None:  # no explicit InputLayer
+                input_names.append("__seq_input__")
+                prev_name = "__seq_input__"
+            parents = [prev_name]
+        else:
+            refs: List[str] = []
+            for node in lc.get("inbound_nodes", []):
+                _tensor_refs(node, refs)
+            if not refs:
+                raise UnsupportedLayerError(
+                    f"layer {name!r} has no inbound nodes")
+            parents = refs
+        nodes.append((name, op, parents))
+        if p:
+            params[name] = p
+        if st:
+            state[name] = st
+        prev_name = name
+
+    if is_sequential:
+        output_names = [prev_name]
+    else:
+        def _names(spec):
+            # ['name', 0, 0] or [['name',0,0], ...]
+            if spec and isinstance(spec[0], (list, tuple)):
+                return [s[0] for s in spec]
+            return [spec[0]]
+
+        input_names = _names(cfg["input_layers"])
+        output_names = _names(cfg["output_layers"])
+    return GraphProgram(nodes, input_names, output_names, params, state)

@@ -1,0 +1,241 @@
+"""TFPark-equivalent tests: keras→JAX conversion parity + native training
+of foreign models (reference pyzoo/test/zoo/tfpark/test_tfpark_model.py).
+"""
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.tfpark import (KerasModel, TFDataset, TFOptimizer,
+                                      TorchModel, UnsupportedLayerError,
+                                      convert_keras_model)
+
+tf = pytest.importorskip("tensorflow")
+
+
+def _forward(program, x_list, training=False):
+    out, _ = program.call(program.params, program.state, *x_list,
+                          training=training)
+    return np.asarray(out)
+
+
+class TestConverterParity:
+    """Converted program must match tf.keras numerics (the golden-parity
+    discipline of KerasBaseSpec.scala:45-72 applied to ingestion)."""
+
+    def _check(self, model, *xs, rtol=1e-4, atol=1e-5):
+        prog = convert_keras_model(model)
+        ref = model(*[tf.constant(x) for x in xs], training=False)
+        got = _forward(prog, list(xs))
+        np.testing.assert_allclose(got, np.asarray(ref), rtol=rtol,
+                                   atol=atol)
+
+    def test_mlp_sequential(self):
+        m = tf.keras.Sequential([
+            tf.keras.layers.Input(shape=(8,)),
+            tf.keras.layers.Dense(16, activation="relu"),
+            tf.keras.layers.Dropout(0.5),
+            tf.keras.layers.Dense(4, activation="softmax")])
+        x = np.random.RandomState(0).randn(6, 8).astype(np.float32)
+        self._check(m, x)
+
+    def test_conv_pool_bn(self):
+        m = tf.keras.Sequential([
+            tf.keras.layers.Input(shape=(12, 12, 3)),
+            tf.keras.layers.ZeroPadding2D(1),
+            tf.keras.layers.Conv2D(8, 3, strides=2, padding="valid",
+                                   activation="relu"),
+            tf.keras.layers.BatchNormalization(),
+            tf.keras.layers.MaxPooling2D(2),
+            tf.keras.layers.Conv2D(4, 1, padding="same"),
+            tf.keras.layers.GlobalAveragePooling2D(),
+            tf.keras.layers.Dense(3)])
+        # make BN stats non-trivial
+        m.layers[2].set_weights([
+            np.random.RandomState(1).rand(8).astype(np.float32) + 0.5,
+            np.random.RandomState(2).randn(8).astype(np.float32),
+            np.random.RandomState(3).randn(8).astype(np.float32),
+            np.random.RandomState(4).rand(8).astype(np.float32) + 0.5])
+        x = np.random.RandomState(0).randn(2, 12, 12, 3).astype(np.float32)
+        self._check(m, x)
+
+    def test_functional_residual(self):
+        inp = tf.keras.Input(shape=(10,))
+        h = tf.keras.layers.Dense(10, activation="relu", name="f1")(inp)
+        h2 = tf.keras.layers.Dense(10, name="f2")(h)
+        s = tf.keras.layers.Add()([h, h2])
+        out = tf.keras.layers.Dense(2, name="f3")(s)
+        m = tf.keras.Model(inp, out)
+        x = np.random.RandomState(0).randn(4, 10).astype(np.float32)
+        self._check(m, x)
+
+    def test_multi_input_concat(self):
+        a = tf.keras.Input(shape=(4,))
+        b = tf.keras.Input(shape=(6,))
+        c = tf.keras.layers.Concatenate()([a, b])
+        out = tf.keras.layers.Dense(3)(c)
+        m = tf.keras.Model([a, b], out)
+        rs = np.random.RandomState(0)
+        xa = rs.randn(5, 4).astype(np.float32)
+        xb = rs.randn(5, 6).astype(np.float32)
+        prog = convert_keras_model(m)
+        ref = m([tf.constant(xa), tf.constant(xb)], training=False)
+        got = _forward(prog, [xa, xb])
+        np.testing.assert_allclose(got, np.asarray(ref), rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_embedding_flatten(self):
+        m = tf.keras.Sequential([
+            tf.keras.layers.Input(shape=(5,), dtype="int32"),
+            tf.keras.layers.Embedding(20, 6),
+            tf.keras.layers.Flatten(),
+            tf.keras.layers.Dense(2)])
+        x = np.random.RandomState(0).randint(0, 20, (3, 5)).astype(np.int32)
+        self._check(m, x)
+
+    def test_depthwise_and_relu6(self):
+        m = tf.keras.Sequential([
+            tf.keras.layers.Input(shape=(8, 8, 4)),
+            tf.keras.layers.DepthwiseConv2D(3, padding="same"),
+            tf.keras.layers.ReLU(max_value=6.0),
+            tf.keras.layers.AveragePooling2D(2)])
+        x = np.random.RandomState(0).randn(2, 8, 8, 4).astype(np.float32)
+        self._check(m, x)
+
+    def test_unsupported_layer_raises(self):
+        m = tf.keras.Sequential([
+            tf.keras.layers.Input(shape=(4, 3)),
+            tf.keras.layers.LSTM(5)])
+        with pytest.raises(UnsupportedLayerError):
+            convert_keras_model(m)
+
+    def test_resnet50_block_style(self):
+        """A residual bottleneck with BN — the ResNet-50 building block."""
+        inp = tf.keras.Input(shape=(8, 8, 16))
+        h = tf.keras.layers.Conv2D(8, 1, name="r1")(inp)
+        h = tf.keras.layers.BatchNormalization(name="rb1")(h)
+        h = tf.keras.layers.Activation("relu")(h)
+        h = tf.keras.layers.Conv2D(8, 3, padding="same", name="r2")(h)
+        h = tf.keras.layers.BatchNormalization(name="rb2")(h)
+        h = tf.keras.layers.Activation("relu")(h)
+        h = tf.keras.layers.Conv2D(16, 1, name="r3")(h)
+        h = tf.keras.layers.BatchNormalization(name="rb3")(h)
+        out = tf.keras.layers.Add()([inp, h])
+        out = tf.keras.layers.Activation("relu")(out)
+        m = tf.keras.Model(inp, out)
+        x = np.random.RandomState(0).randn(2, 8, 8, 16).astype(np.float32)
+        self._check(m, x, rtol=1e-3, atol=1e-4)
+
+
+class TestResNet50Ingestion:
+    def test_full_resnet50_parity(self):
+        """The whole tf.keras.applications ResNet-50 graph converts and
+        matches TF numerics (BASELINE config #2 ingestion path)."""
+        m = tf.keras.applications.ResNet50(weights=None, include_top=True,
+                                           classes=10,
+                                           input_shape=(64, 64, 3))
+        prog = convert_keras_model(m)
+        x = np.random.RandomState(0).randn(2, 64, 64, 3).astype(np.float32)
+        ref = m(tf.constant(x), training=False).numpy()
+        got = _forward(prog, [x])
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+class TestKerasModelTraining:
+    def test_fit_improves_loss_and_roundtrip(self):
+        tf.keras.utils.set_random_seed(0)
+        km = tf.keras.Sequential([
+            tf.keras.layers.Input(shape=(6,)),
+            tf.keras.layers.Dense(16, activation="relu", name="t1"),
+            tf.keras.layers.Dense(3, name="t2")])
+        km.compile(loss="sparse_categorical_crossentropy")
+        model = KerasModel(km)
+        rs = np.random.RandomState(0)
+        x = rs.randn(256, 6).astype(np.float32)
+        y = (x.sum(axis=1) > 0).astype(np.int32) + (x[:, 0] > 1)
+        ds = TFDataset.from_ndarrays((x, y), batch_size=64)
+        before = model.evaluate(ds)["loss"]
+        model.fit(ds, epochs=8, verbose=False)
+        after = model.evaluate(ds)["loss"]
+        assert after < before
+        # round trip: trained weights written back into tf.keras
+        back = model.to_keras()
+        ref = back(tf.constant(x[:8]), training=False)
+        got = model.predict(x[:8], batch_size=8)
+        np.testing.assert_allclose(got, np.asarray(ref), rtol=1e-3,
+                                   atol=1e-4)
+
+    def test_tf_optimizer_facade(self):
+        km = tf.keras.Sequential([
+            tf.keras.layers.Input(shape=(4,)),
+            tf.keras.layers.Dense(2)])
+        km.compile(loss="mse")
+        rs = np.random.RandomState(0)
+        x = rs.randn(64, 4).astype(np.float32)
+        y = rs.randn(64, 2).astype(np.float32)
+        opt = TFOptimizer.from_keras(km, (x, y))
+        opt.optimize(epochs=1)
+        assert opt.kmodel.params is not None
+
+
+class TestTFDataset:
+    def test_from_ndarrays_and_validation(self):
+        rs = np.random.RandomState(0)
+        x, y = rs.randn(10, 3), rs.randn(10)
+        vx, vy = rs.randn(4, 3), rs.randn(4)
+        ds = TFDataset.from_ndarrays((x, y), batch_size=5,
+                                     val_tensors=(vx, vy))
+        assert len(ds) == 10 and ds.batch_size == 5
+        assert ds.validation[0].shape == (4, 3)
+
+    def test_from_feature_set(self):
+        from analytics_zoo_tpu.data.featureset import FeatureSet
+
+        rs = np.random.RandomState(0)
+        fs = FeatureSet.from_ndarrays([rs.randn(8, 2), rs.randn(8, 3)],
+                                      rs.randn(8))
+        ds = TFDataset.from_feature_set(fs)
+        assert len(ds.features) == 2 and ds.labels[0].shape == (8,)
+
+    def test_from_dataframe(self):
+        import pandas as pd
+
+        df = pd.DataFrame({"a": np.arange(6.0), "b": np.arange(6.0) * 2,
+                           "y": np.arange(6)})
+        ds = TFDataset.from_dataframe(df, ["a", "b"], ["y"])
+        assert ds.features[0].shape == (6,)
+
+    def test_from_tf_data_dataset(self):
+        x = np.arange(12, dtype=np.float32).reshape(6, 2)
+        y = np.arange(6, dtype=np.int32)
+        tfds = tf.data.Dataset.from_tensor_slices((x, y))
+        ds = TFDataset.from_tf_data_dataset(tfds, batch_size=2)
+        np.testing.assert_array_equal(ds.features[0], x)
+        np.testing.assert_array_equal(ds.labels[0], y)
+
+    def test_mismatched_leading_dim_raises(self):
+        with pytest.raises(ValueError):
+            TFDataset(np.zeros((4, 2)), np.zeros(5))
+
+
+class TestTorchModel:
+    def test_linear_stack_parity_and_training(self):
+        torch = pytest.importorskip("torch")
+        net = torch.nn.Sequential(torch.nn.Linear(5, 16), torch.nn.ReLU(),
+                                  torch.nn.Linear(16, 2))
+        rs = np.random.RandomState(0)
+        x = rs.randn(32, 5).astype(np.float32)
+        with torch.no_grad():
+            ref = net(torch.from_numpy(x)).numpy()
+        tm = TorchModel(net, loss="mse")
+        got = tm.predict(x, batch_size=32)
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+        y = rs.randn(32, 2).astype(np.float32)
+        before = tm.evaluate(x, y, batch_size=32)["loss"]
+        tm.fit(x, y, batch_size=32, epochs=10, verbose=False)
+        assert tm.evaluate(x, y, batch_size=32)["loss"] < before
+
+    def test_unsupported_torch_layer(self):
+        torch = pytest.importorskip("torch")
+        net = torch.nn.Sequential(torch.nn.LSTM(4, 4))
+        with pytest.raises(UnsupportedLayerError):
+            TorchModel(net)
