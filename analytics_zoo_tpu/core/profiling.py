@@ -1,0 +1,112 @@
+"""Tracing / profiling (SURVEY §5.1).
+
+Reference capability: ``Utils.timeIt(name){...}`` debug-log timers around
+hot calls (pipeline/api/net/TFNet.scala:179, tfpark/GraphRunner.scala:132)
+and per-iteration BigDL ``Metrics`` aggregation (Topology.scala:1192).
+
+TPU-native design: two complementary mechanisms —
+- ``timeit`` / ``scoped_timer``: host-side wall-clock scopes aggregated in
+  a process-wide registry (mean/total/count per name), for spotting
+  host-bound stages (data prep, device_put, checkpoint writes).
+- ``trace``: a context manager around ``jax.profiler`` that captures an
+  xprof/TensorBoard-viewable device trace; annotations via
+  ``jax.profiler.TraceAnnotation`` inside.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, Optional
+
+logger = logging.getLogger("analytics_zoo_tpu.profiling")
+
+
+@dataclass
+class _Stat:
+    count: int = 0
+    total_s: float = 0.0
+    max_s: float = 0.0
+
+    @property
+    def mean_s(self) -> float:
+        return self.total_s / self.count if self.count else 0.0
+
+
+class Timers:
+    """Process-wide named wall-clock scopes (thread-safe)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._stats: Dict[str, _Stat] = {}
+
+    @contextlib.contextmanager
+    def scope(self, name: str, log: bool = False) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            with self._lock:
+                s = self._stats.setdefault(name, _Stat())
+                s.count += 1
+                s.total_s += dt
+                s.max_s = max(s.max_s, dt)
+            if log:
+                logger.info("[timeit] %s: %.3fms", name, dt * 1e3)
+
+    def stats(self) -> Dict[str, Dict[str, float]]:
+        with self._lock:
+            return {k: {"count": v.count, "total_s": v.total_s,
+                        "mean_s": v.mean_s, "max_s": v.max_s}
+                    for k, v in self._stats.items()}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._stats.clear()
+
+    def report(self) -> str:
+        lines = ["name count total_s mean_ms max_ms"]
+        for k, v in sorted(self.stats().items(),
+                           key=lambda kv: -kv[1]["total_s"]):
+            lines.append(f"{k} {v['count']} {v['total_s']:.3f} "
+                         f"{v['mean_s'] * 1e3:.2f} {v['max_s'] * 1e3:.2f}")
+        return "\n".join(lines)
+
+
+TIMERS = Timers()
+
+
+def timeit(name: str, log: bool = False):
+    """``with timeit("shard_batch"): ...`` — scoped wall-clock timer."""
+    return TIMERS.scope(name, log=log)
+
+
+@contextlib.contextmanager
+def trace(log_dir: str, annotation: Optional[str] = None) -> Iterator[None]:
+    """Capture a ``jax.profiler`` device trace into ``log_dir``
+    (view with TensorBoard's profile plugin / xprof)."""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        if annotation:
+            with jax.profiler.TraceAnnotation(annotation):
+                yield
+        else:
+            yield
+    finally:
+        jax.profiler.stop_trace()
+        logger.info("profiler trace written to %s", log_dir)
+
+
+@contextlib.contextmanager
+def annotate(name: str) -> Iterator[None]:
+    """Named region that shows up on the device timeline inside a trace."""
+    import jax
+
+    with jax.profiler.TraceAnnotation(name):
+        yield

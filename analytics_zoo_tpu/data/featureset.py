@@ -108,13 +108,33 @@ class FeatureSet:
         return len(self)
 
     def batches(self, batch_size: int, shuffle: bool = False,
-                drop_remainder: bool = False, pad_to: int = 1
+                drop_remainder: bool = False, pad_to: int = 1,
+                shuffle_buffer: Optional[int] = None
                 ) -> Iterator[Tuple[np.ndarray, ...]]:
         """Yield batches; ``pad_to`` rounds batch_size up to a multiple
-        (device count) so every batch shards evenly over the mesh."""
+        (device count) so every batch shards evenly over the mesh.
+
+        ``shuffle_buffer`` (config ``shuffle_buffer`` knob) bounds the
+        shuffle window: rows are permuted within contiguous blocks of that
+        size and the block order is permuted — a locality-preserving
+        shuffle so disk-backed tiers (DISK_AND_DRAM/DIRECT mmaps) read
+        near-sequentially instead of seeking across the whole file
+        (replaces the reference's cached index-shuffled partitions,
+        feature/FeatureSet.scala:229).  ``None``/``>=n`` = full
+        permutation.
+        """
         n = len(self)
         bs = int(math.ceil(batch_size / pad_to)) * pad_to
-        order = self._rng.permutation(n) if shuffle else np.arange(n)
+        if not shuffle:
+            order = np.arange(n)
+        elif shuffle_buffer is not None and 0 < shuffle_buffer < n:
+            buf = int(shuffle_buffer)
+            starts = np.arange(0, n, buf)
+            self._rng.shuffle(starts)
+            order = np.concatenate([
+                s + self._rng.permutation(min(buf, n - s)) for s in starts])
+        else:
+            order = self._rng.permutation(n)
         steps = n // bs if drop_remainder else int(math.ceil(n / bs))
         for s in range(steps):
             idx = order[s * bs:(s + 1) * bs]

@@ -142,16 +142,31 @@ def squared_hinge(y_true, y_pred):
     return jnp.mean(jnp.square(jnp.maximum(1.0 - y_true * y_pred, 0.0)))
 
 
-def rank_hinge(y_true, y_pred, margin: float = 1.0):
+def rank_hinge(y_true, y_pred, margin: float = 1.0, mask=None):
     """Pairwise ranking hinge for (pos, neg) interleaved batches
-    (reference objectives/RankHinge.scala; used by KNRM/Ranker)."""
+    (reference objectives/RankHinge.scala; used by KNRM/Ranker).
+
+    ``mask`` is an optional per-row validity vector (B,): a pair counts
+    only when both its rows are real, so padded rows on a final partial
+    batch are excluded exactly instead of approximated.
+    """
     pos = y_pred[0::2]
     neg = y_pred[1::2]
-    return jnp.mean(jnp.maximum(margin - pos + neg, 0.0))
+    per_pair = jnp.maximum(margin - pos + neg, 0.0)
+    if mask is None:
+        return jnp.mean(per_pair)
+    pair_mask = (mask[0::2] * mask[1::2]).reshape(
+        (-1,) + (1,) * (per_pair.ndim - 1))
+    denom = jnp.maximum(jnp.sum(pair_mask), 1.0) * (
+        per_pair.size / per_pair.shape[0])
+    return jnp.sum(per_pair * pair_mask) / denom
 
 
 # rank_hinge couples rows across the batch — eval must not vmap it per-row.
 rank_hinge.batch_structured = True
+# accepts mask= for exact padded-row exclusion; pair count for aggregation:
+rank_hinge.supports_mask = True
+rank_hinge.mask_count = lambda mask: jnp.sum(mask[0::2] * mask[1::2])
 
 
 _REGISTRY = {

@@ -36,7 +36,9 @@ _ACTS = {
     "sigmoid": jax.nn.sigmoid,
     "tanh": jnp.tanh,
     "softmax": lambda x: jax.nn.softmax(x, axis=-1),
-    "gelu": jax.nn.gelu,
+    # keras gelu defaults to the exact (erf) form; jax.nn.gelu defaults to
+    # the tanh approximation — pin exact for parity
+    "gelu": lambda x: jax.nn.gelu(x, approximate=False),
     "elu": jax.nn.elu,
     "selu": jax.nn.selu,
     "softplus": jax.nn.softplus,
@@ -62,6 +64,16 @@ def _pair(v) -> Tuple[int, int]:
     if isinstance(v, (list, tuple)):
         return (int(v[0]), int(v[1]))
     return (int(v), int(v))
+
+
+def _require_channels_last(cfg: Dict, cn: str) -> None:
+    """All converted spatial ops assume NHWC; channels_first models must
+    not convert silently to wrong axes."""
+    df = cfg.get("data_format", "channels_last")
+    if df not in (None, "channels_last"):
+        raise UnsupportedLayerError(
+            f"{cn} with data_format={df!r} (only channels_last/NHWC "
+            f"converts; transpose the model or use InferenceModel.load_tf)")
 
 
 # ---------------------------------------------------------------------------
@@ -173,6 +185,10 @@ def _convert_batchnorm(cfg, w):
     eps = cfg.get("epsilon", 1e-3)
     momentum = cfg.get("momentum", 0.99)
     scale, center = cfg.get("scale", True), cfg.get("center", True)
+    bn_axis = cfg.get("axis", -1)
+    if isinstance(bn_axis, (list, tuple)):
+        bn_axis = bn_axis[0] if bn_axis else -1
+    bn_axis = int(bn_axis)
     i = 0
     p = {}
     if scale:
@@ -183,6 +199,12 @@ def _convert_batchnorm(cfg, w):
 
     def op(p, xs, training, rng, st):
         x = xs[0]
+        # the op normalizes the LAST axis; ndim is static at trace time,
+        # so a channels_first BN (axis=1 on 4D input) fails loudly here
+        if bn_axis not in (-1, x.ndim - 1):
+            raise UnsupportedLayerError(
+                f"BatchNormalization axis={bn_axis} on rank-{x.ndim} input "
+                f"(only last-axis / channels_last converts)")
         axes = tuple(range(x.ndim - 1))
         if training:
             mean = jnp.mean(x, axis=axes)
@@ -261,9 +283,20 @@ def _merge(fn2):
     return {}, _stateless(fn)
 
 
+_SPATIAL_LAYERS = frozenset({
+    "Conv2D", "Convolution2D", "Conv1D", "Convolution1D", "DepthwiseConv2D",
+    "MaxPooling2D", "AveragePooling2D", "MaxPooling1D", "AveragePooling1D",
+    "GlobalAveragePooling2D", "GlobalMaxPooling2D",
+    "GlobalAveragePooling1D", "GlobalMaxPooling1D",
+    "ZeroPadding2D", "SpatialDropout2D", "UpSampling2D",
+})
+
+
 def _convert_layer(class_name: str, cfg: Dict, weights: List[np.ndarray]):
     """Returns (params, op, state) for one keras layer."""
     cn = class_name
+    if cn in _SPATIAL_LAYERS:
+        _require_channels_last(cfg, cn)
     if cn == "Dense":
         return (*_convert_dense(cfg, weights), {})
     if cn == "Embedding":
@@ -333,8 +366,21 @@ def _convert_layer(class_name: str, cfg: Dict, weights: List[np.ndarray]):
         return _convert_batchnorm(cfg, weights)
     if cn == "LayerNormalization":
         return (*_convert_layernorm(cfg, weights), {})
-    if cn == "Dropout" or cn == "SpatialDropout2D":
+    if cn == "Dropout":
         return (*_convert_dropout(cfg, weights), {})
+    if cn == "SpatialDropout2D":
+        rate = cfg.get("rate", 0.5)
+
+        def sdrop(p, xs, training, rng):
+            x = xs[0]
+            if not training or rng is None or rate <= 0:
+                return x
+            # drop whole feature maps: noise shape (N, 1, 1, C)
+            keep = jax.random.bernoulli(
+                rng, 1.0 - rate, (x.shape[0], 1, 1, x.shape[-1]))
+            return jnp.where(keep, x / (1.0 - rate), 0.0)
+
+        return {}, _stateless(sdrop), {}
     if cn == "ZeroPadding2D":
         return (*_convert_zeropad(cfg, weights), {})
     if cn == "Add":

@@ -276,9 +276,11 @@ class TorchModel:
                 if (tuple(sub.dilation) != (1, 1) or sub.groups != 1):
                     raise UnsupportedLayerError(
                         "Conv2d with dilation/groups is not converted")
-                # torch OIHW on NCHW; native layout is NHWC/HWIO
-                w = sub.weight.detach().numpy().transpose(2, 3, 1, 0).copy()
-                p = {"kernel": w}
+                # Torch semantics are NCHW/OIHW — keep them verbatim so the
+                # converted program consumes the exact tensors the torch
+                # module does (and Flatten→Linear ordering stays C*H*W).
+                # XLA lays out NCHW convs onto the MXU itself.
+                p = {"kernel": sub.weight.detach().numpy().copy()}
                 if sub.bias is not None:
                     p["bias"] = sub.bias.detach().numpy().copy()
                 stride = tuple(sub.stride)
@@ -288,10 +290,12 @@ class TorchModel:
                 def conv_fn(p, xs, tr, r, _s=stride, _pad=pad):
                     dn = jax.lax.conv_dimension_numbers(
                         xs[0].shape, p["kernel"].shape,
-                        ("NHWC", "HWIO", "NHWC"))
+                        ("NCHW", "OIHW", "NCHW"))
                     y = jax.lax.conv_general_dilated(
                         xs[0], p["kernel"], _s, _pad, dimension_numbers=dn)
-                    return y + p.get("bias", 0.0)
+                    if "bias" in p:
+                        y = y + p["bias"][None, :, None, None]
+                    return y
 
                 op = _stateless(conv_fn)
             elif t == "ReLU":
@@ -326,9 +330,10 @@ class TorchModel:
                       else (sub.stride,) * 2) if sub.stride else ks
 
                 def pool_fn(p, xs, tr, r, _k=ks, _s=st):
+                    # NCHW window to match the torch layout kept above
                     return jax.lax.reduce_window(
-                        xs[0], -jnp.inf, jax.lax.max, (1,) + _k + (1,),
-                        (1,) + _s + (1,), "VALID")
+                        xs[0], -jnp.inf, jax.lax.max, (1, 1) + _k,
+                        (1, 1) + _s, "VALID")
 
                 p, op = {}, _stateless(pool_fn)
             else:

@@ -7,9 +7,14 @@ recovery (Topology.scala:1293-1306,1519-1536), retry-from-checkpoint
 
 Format: our own compact layout — one ``.npz`` holding every array leaf
 keyed by its pytree path, plus a pickled treedef skeleton.  This avoids a
-hard orbax dependency while staying host-portable; ``save_pytree`` is
-synchronous (checkpoints are host-side; TPU step proceeds as soon as the
-device→host copy completes).
+hard orbax dependency while staying host-portable.
+
+``CheckpointManager.save_async`` implements the ``async_checkpoint``
+config knob: the device→host copy happens synchronously (cheap — it only
+waits for in-flight steps touching the buffers), then serialization + the
+atomic rename run on a background thread so the training loop resumes
+immediately.  ``wait()`` joins the in-flight write and re-raises its
+error, and is called before any restore so readers never race a writer.
 """
 
 from __future__ import annotations
@@ -18,6 +23,7 @@ import os
 import pickle
 import re
 import tempfile
+import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -82,15 +88,48 @@ class CheckpointManager:
         self.directory = directory
         self.keep = keep
         os.makedirs(directory, exist_ok=True)
+        self._writer: Optional[threading.Thread] = None
+        self._writer_err: Optional[BaseException] = None
 
     def _path(self, step: int) -> str:
         return os.path.join(self.directory, f"ckpt_{step:010d}.npz")
 
     def save(self, step: int, tree: Any) -> str:
+        self.wait()
         path = self._path(step)
         save_pytree(path, tree)
         self._gc()
         return path
+
+    def save_async(self, step: int, tree: Any) -> str:
+        """Write the snapshot on a background thread (``async_checkpoint``).
+
+        The pytree is materialised to host numpy up front, so the caller
+        may keep mutating/donating its device buffers immediately.
+        """
+        self.wait()
+        host_tree = jax.tree_util.tree_map(np.asarray, jax.device_get(tree))
+        path = self._path(step)
+
+        def write():
+            try:
+                save_pytree(path, host_tree)
+                self._gc()
+            except BaseException as e:
+                self._writer_err = e
+
+        self._writer = threading.Thread(target=write, daemon=True)
+        self._writer.start()
+        return path
+
+    def wait(self) -> None:
+        """Join any in-flight async write; re-raise its failure."""
+        if self._writer is not None:
+            self._writer.join()
+            self._writer = None
+        if self._writer_err is not None:
+            err, self._writer_err = self._writer_err, None
+            raise err
 
     def all_steps(self) -> List[int]:
         steps = []
@@ -105,6 +144,7 @@ class CheckpointManager:
         return steps[-1] if steps else None
 
     def restore(self, step: Optional[int] = None) -> Tuple[int, Any]:
+        self.wait()
         if step is None:
             step = self.latest_step()
         if step is None:

@@ -15,9 +15,21 @@ and no host round-trip in the hot loop.
 
 Also carried over, re-designed:
 - trigger-driven validation/checkpointing (`ZooTrigger` → core.triggers)
-- failure retry from latest checkpoint (Topology.scala:1179-1261)
+- failure retry from latest checkpoint within a sliding time window
+  (Topology.scala:1179-1261; ``bigdl.failure.retryTimes`` /
+  ``retryTimeInterval`` sysprops → ``failure_retry_times`` /
+  ``failure_retry_interval_s`` config knobs)
 - LocalEstimator (LocalEstimator.scala:39) collapses into this same class
   on a 1-device mesh.
+
+TPU perf levers wired through config:
+- ``compute_dtype="bfloat16"`` — mixed precision: master params/opt-state
+  stay float32, forward/backward run in bf16 (MXU-native), loss and
+  gradients accumulate in float32.
+- ``data_prefetch`` — background-thread batch prep + device_put overlap
+  (train/prefetch.py) so the chip never waits on host indexing.
+- ``async_checkpoint`` — snapshot writes happen off-thread
+  (train/checkpoint.py::save_async).
 """
 
 from __future__ import annotations
@@ -34,11 +46,13 @@ import numpy as np
 import optax
 
 from analytics_zoo_tpu.core.context import ZooContext, get_zoo_context
+from analytics_zoo_tpu.core.profiling import timeit
 from analytics_zoo_tpu.core.triggers import (EveryEpoch, Trigger, TriggerState)
 from analytics_zoo_tpu.nn import metrics as metrics_lib
 from analytics_zoo_tpu.nn import objectives
 from analytics_zoo_tpu.train import checkpoint as ckpt_lib
 from analytics_zoo_tpu.train import optimizers as optim_lib
+from analytics_zoo_tpu.train import prefetch as prefetch_lib
 
 logger = logging.getLogger("analytics_zoo_tpu.train")
 
@@ -51,6 +65,20 @@ def _as_list(x) -> List[np.ndarray]:
     return [x]
 
 
+def _cast_floats(tree, dtype):
+    """Cast floating leaves of a pytree to ``dtype`` (ints/bools pass)."""
+    return jax.tree_util.tree_map(
+        lambda a: a.astype(dtype)
+        if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating) else a, tree)
+
+
+def _cast_like(tree, ref):
+    """Cast every leaf of ``tree`` to the dtype of the matching ``ref``
+    leaf (restores e.g. float32 BN statistics after a bf16 forward)."""
+    return jax.tree_util.tree_map(
+        lambda a, r: a.astype(jnp.asarray(r).dtype), tree, ref)
+
+
 class Estimator:
     """fit/evaluate/predict over a model following the Layer protocol."""
 
@@ -59,7 +87,7 @@ class Estimator:
                  ctx: Optional[ZooContext] = None,
                  grad_clip_norm: Optional[float] = None,
                  grad_clip_value: Optional[float] = None,
-                 sharding="dp"):
+                 sharding="dp", compute_dtype: Optional[str] = None):
         self.model = model
         self.tx = optim_lib.get(optimizer)
         self._sharding_strategy = sharding  # "dp" | "tp" | ShardingStrategy
@@ -70,6 +98,10 @@ class Estimator:
         self.loss_fn = objectives.get(loss)
         self.metrics = [metrics_lib.get(m) for m in (metrics or [])]
         self.ctx = ctx or get_zoo_context()
+        # mixed precision: config `compute_dtype` knob, overridable per-run
+        cd = compute_dtype or self.ctx.config.compute_dtype
+        self.compute_dtype = jnp.dtype(cd) if cd not in (None, "float32") \
+            else None
 
         # mutable training state (host handles to device arrays)
         self.params = None
@@ -175,13 +207,27 @@ class Estimator:
         model, loss_fn, tx = self.model, self.loss_fn, self.tx
         data_shard = self.ctx.data_sharding()
         rep = self.ctx.replicated_sharding()
+        cdtype = self.compute_dtype
 
         def step(params, state, opt_state, rng, step_i, xs, y):
             rng = jax.random.fold_in(rng, step_i)
 
             def lossf(p):
-                preds, new_state = model.call(p, state, *xs, training=True,
+                # Mixed precision: params + float inputs cast to the
+                # compute dtype for forward/backward (bf16 on the MXU);
+                # the cast's transpose re-accumulates grads in f32 against
+                # the f32 master params, and the loss is taken in f32.
+                if cdtype is not None:
+                    p_c = _cast_floats(p, cdtype)
+                    xs_c = _cast_floats(xs, cdtype)
+                    st_c = _cast_floats(state, cdtype)
+                else:
+                    p_c, xs_c, st_c = p, xs, state
+                preds, new_state = model.call(p_c, st_c, *xs_c, training=True,
                                               rng=rng)
+                if cdtype is not None:
+                    preds = _cast_floats(preds, jnp.float32)
+                    new_state = _cast_like(new_state, state)
                 loss = loss_fn(y, preds)
                 return loss, new_state
 
@@ -206,13 +252,29 @@ class Estimator:
         rep = self.ctx.replicated_sharding()
 
         batch_structured = getattr(loss_fn, "batch_structured", False)
+        supports_mask = getattr(loss_fn, "supports_mask", False)
+        mask_count = getattr(loss_fn, "mask_count", None)
+        cdtype = self.compute_dtype
 
         def step(params, state, xs, y, mask):
+            if cdtype is not None:
+                params = _cast_floats(params, cdtype)
+                state = _cast_floats(state, cdtype)
+                xs = _cast_floats(xs, cdtype)
             preds, _ = model.call(params, state, *xs, training=False, rng=None)
-            if batch_structured:
-                # Loss couples rows across the batch (e.g. rank_hinge):
-                # compute over the whole batch; padded rows are a small
-                # approximation on the final partial batch only.
+            if cdtype is not None:
+                preds = _cast_floats(preds, jnp.float32)
+            if batch_structured and supports_mask:
+                # Loss couples rows across the batch (e.g. rank_hinge) but
+                # can exclude padded rows exactly via its mask support;
+                # aggregation weight = the loss's own unit count (pairs).
+                cnt = mask_count(mask) if mask_count else jnp.sum(mask)
+                stats = {"loss_sum": loss_fn(y, preds, mask=mask) * cnt,
+                         "count": cnt}
+            elif batch_structured:
+                # Couples rows and has no mask support: compute over the
+                # whole batch; padded rows are a small approximation on
+                # the final partial batch only.
                 stats = {"loss_sum": loss_fn(y, preds) * jnp.sum(mask),
                          "count": jnp.sum(mask)}
             else:
@@ -235,9 +297,16 @@ class Estimator:
         model = self.model
         data_shard = self.ctx.data_sharding()
         rep = self.ctx.replicated_sharding()
+        cdtype = self.compute_dtype
 
         def step(params, state, xs):
+            if cdtype is not None:
+                params = _cast_floats(params, cdtype)
+                state = _cast_floats(state, cdtype)
+                xs = _cast_floats(xs, cdtype)
             preds, _ = model.call(params, state, *xs, training=False, rng=None)
+            if cdtype is not None:
+                preds = _cast_floats(preds, jnp.float32)
             return preds
 
         self._predict_step = jax.jit(
@@ -265,7 +334,8 @@ class Estimator:
 
     def _shard_batch(self, arrs: List[np.ndarray]):
         shard = self.ctx.data_sharding()
-        return [jax.device_put(jnp.asarray(a), shard) for a in arrs]
+        with timeit("estimator/shard_batch"):
+            return [jax.device_put(jnp.asarray(a), shard) for a in arrs]
 
     # ------------------------------------------------------------------
     # fit
@@ -307,20 +377,32 @@ class Estimator:
         if self._train_step is None:
             self._build_train_step()
 
-        retries = 0
+        fail_times: List[float] = []
         cfg = self.ctx.config
         epoch = self.finished_epochs
         rng_np = np.random.RandomState(cfg.seed)
+        y_arr = np.asarray(y)
 
         while epoch < epochs:
+            batches = None
             try:
                 t0 = time.time()
                 perm = rng_np.permutation(n) if shuffle else np.arange(n)
                 losses = []
-                for s in range(steps_per_epoch):
-                    idx = perm[s * eff_batch:(s + 1) * eff_batch]
-                    batch_x = self._shard_batch([a[idx] for a in xs])
-                    batch_y = self._shard_batch([np.asarray(y)[idx]])[0]
+
+                def gen(perm=perm):
+                    for s in range(steps_per_epoch):
+                        idx = perm[s * eff_batch:(s + 1) * eff_batch]
+                        yield [a[idx] for a in xs], y_arr[idx]
+
+                def prep(item):
+                    bx, by = item
+                    return self._shard_batch(bx), self._shard_batch([by])[0]
+
+                # overlap host batch prep + device_put with device compute
+                batches = prefetch_lib.prefetch(gen(), prep,
+                                                depth=cfg.data_prefetch)
+                for batch_x, batch_y in batches:
                     self.params, self.state, self.opt_state, loss = (
                         self._train_step(self.params, self.state,
                                          self.opt_state, self._rng,
@@ -359,42 +441,78 @@ class Estimator:
             except (KeyboardInterrupt,):
                 raise
             except Exception as e:  # failure-retry (Topology.scala:1179-1261)
-                retries += 1
+                if batches is not None and hasattr(batches, "close"):
+                    batches.close()
+                # Retries are counted within a sliding time window
+                # (``failure_retry_interval_s``) like the reference's
+                # retryTimes/retryTimeInterval pair: old failures age out,
+                # so a long-running job survives rare transient faults.
+                now = time.time()
+                fail_times = [t for t in fail_times
+                              if now - t < cfg.failure_retry_interval_s]
+                fail_times.append(now)
                 if (self._ckpt_mgr is None
                         or self._ckpt_mgr.latest_step() is None
-                        or retries > cfg.failure_retry_times):
+                        or len(fail_times) > cfg.failure_retry_times):
                     raise
-                logger.warning("step failed (%s); retry %d/%d from checkpoint",
-                               e, retries, cfg.failure_retry_times)
+                logger.warning(
+                    "step failed (%s); retry %d/%d (within %.0fs window) "
+                    "from checkpoint", e, len(fail_times),
+                    cfg.failure_retry_times, cfg.failure_retry_interval_s)
                 self._restore_checkpoint()
+                # re-sync the loop counter so rolled-back epochs re-train
+                epoch = self.finished_epochs
+        if self._ckpt_mgr is not None:
+            self._ckpt_mgr.wait()   # join any in-flight async write
         return self.history
 
     def _fit_featureset(self, fs, batch_size, epochs, validation_data,
                         end_trigger, verbose):
         """Train from a FeatureSet (iterator-based, supports DISK_AND_DRAM)."""
         first = True
+        cfg = self.ctx.config
+        # bounded shuffle window keeps disk-backed tiers near-sequential
+        shuffle_buffer = (cfg.shuffle_buffer
+                          if fs.memory_type != "DRAM" else None)
         for epoch in range(self.finished_epochs, epochs):
             t0 = time.time()
             losses = []
             count = 0
-            for batch in fs.batches(batch_size, shuffle=True,
-                                    drop_remainder=True,
-                                    pad_to=self.ctx.num_devices):
+            raw = fs.batches(batch_size, shuffle=True, drop_remainder=True,
+                             pad_to=self.ctx.num_devices,
+                             shuffle_buffer=shuffle_buffer)
+            if first:
+                # peek one batch to build params/steps, then chain it back
+                import itertools
+                raw = iter(raw)
+                peek = next(raw)
+                self._ensure_built(list(peek[:-1]))
+                if self._train_step is None:
+                    self._build_train_step()
+                first = False
+                raw = itertools.chain([peek], raw)
+
+            def prep(batch):
                 *bx, by = batch
-                if first:
-                    self._ensure_built(bx)
-                    if self._train_step is None:
-                        self._build_train_step()
-                    first = False
-                batch_x = self._shard_batch(bx)
-                batch_y = self._shard_batch([by])[0]
-                self.params, self.state, self.opt_state, loss = (
-                    self._train_step(self.params, self.state, self.opt_state,
-                                     self._rng, jnp.asarray(self.global_step),
-                                     batch_x, batch_y))
-                self.global_step += 1
-                count += by.shape[0]
-                losses.append(loss)
+                return self._shard_batch(bx), self._shard_batch([by])[0], \
+                    by.shape[0]
+
+            batches = prefetch_lib.prefetch(raw, prep,
+                                            depth=cfg.data_prefetch)
+            try:
+                for batch_x, batch_y, bn in batches:
+                    self.params, self.state, self.opt_state, loss = (
+                        self._train_step(self.params, self.state,
+                                         self.opt_state, self._rng,
+                                         jnp.asarray(self.global_step),
+                                         batch_x, batch_y))
+                    self.global_step += 1
+                    count += bn
+                    losses.append(loss)
+            except BaseException:
+                if hasattr(batches, "close"):
+                    batches.close()
+                raise
             self.finished_epochs = epoch + 1
             mean_loss = float(jnp.mean(jnp.stack(losses)))
             dt = time.time() - t0
@@ -420,6 +538,8 @@ class Estimator:
                 self._save_checkpoint()
             if end_trigger is not None and end_trigger(tstate):
                 break
+        if self._ckpt_mgr is not None:
+            self._ckpt_mgr.wait()   # join any in-flight async write
         return self.history
 
     # ------------------------------------------------------------------
@@ -495,7 +615,12 @@ class Estimator:
                          "finished_epochs": np.asarray(self.finished_epochs)}}
 
     def _save_checkpoint(self):
-        path = self._ckpt_mgr.save(self.global_step, self._snapshot())
+        with timeit("estimator/checkpoint_save"):
+            if self.ctx.config.async_checkpoint:
+                path = self._ckpt_mgr.save_async(self.global_step,
+                                                 self._snapshot())
+            else:
+                path = self._ckpt_mgr.save(self.global_step, self._snapshot())
         logger.info("checkpoint saved: %s", path)
 
     def _restore_checkpoint(self):

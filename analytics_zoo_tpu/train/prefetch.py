@@ -1,0 +1,91 @@
+"""Input-pipeline overlap: background-thread batch prefetch.
+
+Reference capability: the reference keeps workers fed via Spark partition
+locality + PMEM-cached partitions (feature/FeatureSet.scala:690-722) and
+multi-threaded minibatch assembly (feature/common/MTSampleToMiniBatch.scala).
+
+TPU-native design: the host prepares the *next* sharded batch (fancy
+indexing, per-batch transforms, ``device_put`` onto the mesh) on a
+background thread while the device executes the current step.  JAX
+dispatch is asynchronous, so one batch of lookahead is enough to hide
+host work; the queue depth is the ``data_prefetch`` config knob.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, Iterable, Iterator, Optional
+
+_SENTINEL = object()
+
+
+class PrefetchIterator:
+    """Wraps an iterator, running it (plus an optional per-item transform)
+    on a daemon thread ``depth`` items ahead of the consumer.
+
+    Exceptions raised by the producer are re-raised at the consumption
+    point, so failure-retry semantics in the Estimator are preserved.
+    """
+
+    def __init__(self, it: Iterable, transform: Optional[Callable] = None,
+                 depth: int = 2):
+        self._q: "queue.Queue" = queue.Queue(maxsize=max(1, depth))
+        self._err: Optional[BaseException] = None
+        self._stop = threading.Event()
+
+        def run():
+            try:
+                for item in it:
+                    if transform is not None:
+                        item = transform(item)
+                    while not self._stop.is_set():
+                        try:
+                            self._q.put(item, timeout=0.1)
+                            break
+                        except queue.Full:
+                            continue
+                    if self._stop.is_set():
+                        return
+            except BaseException as e:  # propagate to consumer
+                self._err = e
+            finally:
+                try:
+                    self._q.put(_SENTINEL, timeout=10)
+                except queue.Full:
+                    pass
+
+        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread.start()
+
+    def __iter__(self) -> Iterator[Any]:
+        return self
+
+    def __next__(self) -> Any:
+        item = self._q.get()
+        if item is _SENTINEL:
+            self._thread.join()
+            if self._err is not None:
+                raise self._err
+            raise StopIteration
+        return item
+
+    def close(self) -> None:
+        """Stop the producer (used on early exit / exception paths)."""
+        self._stop.set()
+        # drain so the producer can observe the stop flag
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+
+
+def prefetch(it: Iterable, transform: Optional[Callable] = None,
+             depth: int = 2) -> Iterable:
+    """``depth<=0`` disables prefetching (synchronous passthrough)."""
+    if depth <= 0:
+        if transform is None:
+            return it
+        return (transform(x) for x in it)
+    return PrefetchIterator(it, transform=transform, depth=depth)

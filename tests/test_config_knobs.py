@@ -1,0 +1,260 @@
+"""Config knobs that shape the hot path: mixed precision (compute_dtype),
+prefetch overlap, async checkpointing, bounded shuffle windows, and the
+sliding-window failure retry.  Mirrors the reference's engine/failure
+config surface (NNContext.scala:209-237, Topology.scala:1179-1261)."""
+
+import os
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu import init_zoo_context
+from analytics_zoo_tpu.core.profiling import TIMERS, timeit
+from analytics_zoo_tpu.data.featureset import FeatureSet
+from analytics_zoo_tpu.nn import objectives
+from analytics_zoo_tpu.nn.layers.core import Dense
+from analytics_zoo_tpu.nn.topology import Sequential
+from analytics_zoo_tpu.train.checkpoint import CheckpointManager
+from analytics_zoo_tpu.train.estimator import Estimator
+from analytics_zoo_tpu.train.prefetch import PrefetchIterator, prefetch
+
+
+def _toy_model():
+    m = Sequential()
+    m.add(Dense(8, activation="relu", input_shape=(4,)))
+    m.add(Dense(1))
+    return m
+
+
+def _toy_data(n=64):
+    rs = np.random.RandomState(0)
+    x = rs.randn(n, 4).astype(np.float32)
+    y = (x.sum(axis=1, keepdims=True) > 0).astype(np.float32)
+    return x, y
+
+
+# ---------------------------------------------------------------------------
+# compute_dtype (bf16 mixed precision)
+# ---------------------------------------------------------------------------
+class TestMixedPrecision:
+    def test_bf16_training_keeps_f32_master_params(self, zoo_ctx):
+        x, y = _toy_data()
+        est = Estimator(_toy_model(), optimizer="adam", loss="mse",
+                        compute_dtype="bfloat16")
+        assert est.compute_dtype == jnp.bfloat16
+        est.fit(x, y, batch_size=16, epochs=2, verbose=False)
+        # master params stay f32 even though compute ran in bf16
+        import jax
+        for leaf in jax.tree_util.tree_leaves(est.params):
+            assert leaf.dtype == jnp.float32
+        # training made progress
+        assert est.history[-1]["loss"] < est.history[0]["loss"] * 1.5
+
+    def test_bf16_matches_f32_loosely(self, zoo_ctx):
+        x, y = _toy_data()
+        est32 = Estimator(_toy_model(), loss="mse")
+        est16 = Estimator(_toy_model(), loss="mse", compute_dtype="bfloat16")
+        est32.fit(x, y, batch_size=16, epochs=3, verbose=False)
+        est16.fit(x, y, batch_size=16, epochs=3, verbose=False)
+        l32 = est32.history[-1]["loss"]
+        l16 = est16.history[-1]["loss"]
+        assert abs(l32 - l16) < 0.25 * max(abs(l32), 1e-2) + 0.05
+
+    def test_bf16_predict_returns_f32(self, zoo_ctx):
+        x, y = _toy_data(32)
+        est = Estimator(_toy_model(), loss="mse", compute_dtype="bfloat16")
+        est.fit(x, y, batch_size=16, epochs=1, verbose=False)
+        preds = est.predict(x, batch_size=16)
+        assert preds.dtype == np.float32
+        # embedding-style int inputs must not be cast
+        out = est.evaluate(x, y, batch_size=16)
+        assert np.isfinite(out["loss"])
+
+
+# ---------------------------------------------------------------------------
+# prefetch
+# ---------------------------------------------------------------------------
+class TestPrefetch:
+    def test_prefetch_preserves_order_and_values(self):
+        items = list(range(100))
+        got = list(prefetch(iter(items), transform=lambda v: v * 2, depth=4))
+        assert got == [v * 2 for v in items]
+
+    def test_prefetch_depth_zero_is_passthrough(self):
+        it = prefetch(iter([1, 2, 3]), depth=0)
+        assert list(it) == [1, 2, 3]
+
+    def test_prefetch_propagates_producer_error(self):
+        def gen():
+            yield 1
+            raise RuntimeError("boom")
+
+        it = PrefetchIterator(gen(), depth=2)
+        assert next(it) == 1
+        with pytest.raises(RuntimeError, match="boom"):
+            for _ in it:
+                pass
+
+    def test_prefetch_overlaps_producer(self):
+        # producer sleeps; with depth=2 total time ~= max(producer, consumer)
+        def slow_gen():
+            for i in range(5):
+                time.sleep(0.02)
+                yield i
+
+        t0 = time.time()
+        for _ in prefetch(slow_gen(), depth=2):
+            time.sleep(0.02)
+        overlapped = time.time() - t0
+        # fully serial would be >= 0.2s; overlap should be well under
+        assert overlapped < 0.18
+
+    def test_fit_with_prefetch_enabled(self, zoo_ctx):
+        x, y = _toy_data()
+        ctx = init_zoo_context(data_prefetch=3)
+        est = Estimator(_toy_model(), loss="mse", ctx=ctx)
+        hist = est.fit(x, y, batch_size=16, epochs=2, verbose=False)
+        assert len(hist) == 2
+        init_zoo_context()  # restore default ctx for other tests
+
+
+# ---------------------------------------------------------------------------
+# async checkpoint
+# ---------------------------------------------------------------------------
+class TestAsyncCheckpoint:
+    def test_save_async_then_restore(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path), keep=2)
+        tree = {"w": np.arange(6, dtype=np.float32).reshape(2, 3),
+                "meta": {"step": np.asarray(7)}}
+        mgr.save_async(3, tree)
+        step, restored = mgr.restore()
+        assert step == 3
+        np.testing.assert_array_equal(restored["w"], tree["w"])
+
+    def test_async_gc_keeps_latest(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path), keep=2)
+        for s in range(5):
+            mgr.save_async(s, {"v": np.asarray(s)})
+        mgr.wait()
+        assert mgr.all_steps() == [3, 4]
+
+    def test_fit_with_async_checkpoint(self, zoo_ctx, tmp_path):
+        x, y = _toy_data()
+        ctx = init_zoo_context(async_checkpoint=True)
+        est = Estimator(_toy_model(), loss="mse", ctx=ctx)
+        est.set_checkpoint(str(tmp_path))
+        est.fit(x, y, batch_size=16, epochs=2, verbose=False)
+        assert est._ckpt_mgr.latest_step() is not None
+        # restoring from the async-written snapshot round-trips
+        est2 = Estimator(_toy_model(), loss="mse", ctx=ctx)
+        est2.load_checkpoint(str(tmp_path))
+        assert est2.finished_epochs == 2
+        init_zoo_context()
+
+
+# ---------------------------------------------------------------------------
+# shuffle_buffer
+# ---------------------------------------------------------------------------
+class TestShuffleBuffer:
+    def test_windowed_shuffle_covers_all_rows(self):
+        x = np.arange(100, dtype=np.float32)[:, None]
+        y = np.arange(100, dtype=np.float32)
+        fs = FeatureSet.from_ndarrays(x, y)
+        seen = []
+        for bx, by in fs.batches(10, shuffle=True, shuffle_buffer=25):
+            seen.extend(by.tolist())
+        assert sorted(seen) == list(range(100))
+
+    def test_windowed_shuffle_bounds_displacement(self):
+        # each row stays within its block: position error < 2 * buffer
+        x = np.arange(1000, dtype=np.float32)[:, None]
+        fs = FeatureSet.from_ndarrays(x, np.arange(1000, dtype=np.float32))
+        order = []
+        for _, by in fs.batches(50, shuffle=True, shuffle_buffer=100):
+            order.extend(by.tolist())
+        # rows from the same block of 100 remain contiguous as a block
+        blocks = [sorted(order[i:i + 100]) for i in range(0, 1000, 100)]
+        for b in blocks:
+            assert b[-1] - b[0] == 99  # exactly one original block
+
+    def test_full_shuffle_when_buffer_none(self):
+        x = np.arange(64, dtype=np.float32)[:, None]
+        fs = FeatureSet.from_ndarrays(x, np.arange(64, dtype=np.float32))
+        seen = []
+        for _, by in fs.batches(8, shuffle=True):
+            seen.extend(by.tolist())
+        assert sorted(seen) == list(range(64))
+
+
+# ---------------------------------------------------------------------------
+# sliding-window retry
+# ---------------------------------------------------------------------------
+class TestRetryWindow:
+    def test_retry_recovers_from_transient_failure(self, zoo_ctx, tmp_path):
+        x, y = _toy_data()
+        ctx = init_zoo_context(failure_retry_times=3,
+                               failure_retry_interval_s=60.0,
+                               async_checkpoint=False)
+        est = Estimator(_toy_model(), loss="mse", ctx=ctx)
+        est.set_checkpoint(str(tmp_path))
+        est.fit(x, y, batch_size=16, epochs=1, verbose=False)
+
+        # sabotage one epoch: a transform-level failure via corrupted input
+        calls = {"n": 0}
+        orig = est._shard_batch
+
+        def flaky(arrs):
+            calls["n"] += 1
+            if calls["n"] == 2:
+                raise RuntimeError("injected fault")
+            return orig(arrs)
+
+        est._shard_batch = flaky
+        est.fit(x, y, batch_size=16, epochs=3, verbose=False)
+        assert est.finished_epochs == 3
+        init_zoo_context()
+
+
+# ---------------------------------------------------------------------------
+# rank_hinge exact masking
+# ---------------------------------------------------------------------------
+class TestRankHingeMask:
+    def test_mask_excludes_padded_pairs(self):
+        y_pred = jnp.asarray([2.0, 1.0, 0.0, 5.0, 9., 9.])  # 3 pairs
+        y_true = jnp.zeros(6)
+        mask = jnp.asarray([1.0, 1.0, 1.0, 1.0, 0.0, 0.0])  # last pair padded
+        full = objectives.rank_hinge(y_true, y_pred[:4])
+        masked = objectives.rank_hinge(y_true, y_pred, mask=mask)
+        assert np.allclose(float(full), float(masked), atol=1e-6)
+
+    def test_eval_partial_batch_exact(self, zoo_ctx):
+        # dataset size NOT a multiple of batch: padded rows must not move
+        # the rank_hinge eval loss — compare against a numpy oracle
+        rs = np.random.RandomState(1)
+        x = rs.randn(36, 4).astype(np.float32)   # 36 rows = 18 pairs
+        y = np.zeros((36, 1), np.float32)
+        est = Estimator(_toy_model(), loss="rank_hinge")
+        est.fit(x, y, batch_size=8, epochs=1, verbose=False)
+        preds = est.predict_raw(x)[0].reshape(-1)
+        expected = np.mean(np.maximum(1.0 - preds[0::2] + preds[1::2], 0.0))
+        one_batch = est.evaluate(x, y, batch_size=40)["loss"]  # pad to 40
+        multi = est.evaluate(x, y, batch_size=8)["loss"]       # partial tail
+        assert np.allclose(one_batch, expected, rtol=1e-4)
+        assert np.allclose(multi, expected, rtol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# profiling timers
+# ---------------------------------------------------------------------------
+class TestTimers:
+    def test_timeit_aggregates(self):
+        TIMERS.reset()
+        for _ in range(3):
+            with timeit("unit/test_scope"):
+                time.sleep(0.003)
+        st = TIMERS.stats()["unit/test_scope"]
+        assert st["count"] == 3
+        assert st["total_s"] >= 0.008
+        assert "unit/test_scope" in TIMERS.report()

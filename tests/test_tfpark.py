@@ -126,6 +126,54 @@ class TestConverterParity:
         self._check(m, x, rtol=1e-3, atol=1e-4)
 
 
+class TestConverterGuards:
+    """Configs the converter cannot honor must fail loudly, not silently
+    compute on wrong axes."""
+
+    def test_channels_first_conv_raises(self):
+        m = tf.keras.Sequential([
+            tf.keras.layers.Input(shape=(3, 8, 8)),
+            tf.keras.layers.Conv2D(4, 3, data_format="channels_first")])
+        with pytest.raises(UnsupportedLayerError, match="channels_last"):
+            convert_keras_model(m)
+
+    def test_channels_first_batchnorm_raises(self):
+        m = tf.keras.Sequential([
+            tf.keras.layers.Input(shape=(3, 8, 8)),
+            tf.keras.layers.BatchNormalization(axis=1)])
+        prog = convert_keras_model(m)     # axis check needs input rank
+        x = np.zeros((2, 3, 8, 8), np.float32)
+        with pytest.raises(UnsupportedLayerError, match="axis"):
+            prog.call(prog.params, prog.state, x)
+
+    def test_gelu_exact_parity(self):
+        m = tf.keras.Sequential([
+            tf.keras.layers.Input(shape=(16,)),
+            tf.keras.layers.Dense(16, activation="gelu")])
+        x = np.random.RandomState(0).randn(4, 16).astype(np.float32) * 3
+        prog = convert_keras_model(m)
+        ref = m(tf.constant(x), training=False)
+        got = _forward(prog, [x])
+        np.testing.assert_allclose(got, np.asarray(ref), rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_spatial_dropout_drops_whole_channels(self):
+        import jax
+
+        m = tf.keras.Sequential([
+            tf.keras.layers.Input(shape=(6, 6, 8)),
+            tf.keras.layers.SpatialDropout2D(0.5)])
+        prog = convert_keras_model(m)
+        x = np.ones((2, 6, 6, 8), np.float32)
+        out, _ = prog.call(prog.params, prog.state, x, training=True,
+                           rng=jax.random.PRNGKey(0))
+        out = np.asarray(out)
+        # every (sample, channel) plane is uniformly kept or dropped
+        per_channel = out.reshape(2, 36, 8)
+        assert np.all((per_channel == per_channel[:, :1, :]))
+        assert (out == 0).any() and (out != 0).any()
+
+
 class TestResNet50Ingestion:
     def test_full_resnet50_parity(self):
         """The whole tf.keras.applications ResNet-50 graph converts and
@@ -233,6 +281,27 @@ class TestTorchModel:
         before = tm.evaluate(x, y, batch_size=32)["loss"]
         tm.fit(x, y, batch_size=32, epochs=10, verbose=False)
         assert tm.evaluate(x, y, batch_size=32)["loss"] < before
+
+    def test_conv_stack_parity(self):
+        # conv nets keep torch's NCHW layout: same input tensor, same
+        # Flatten(C*H*W)->Linear ordering, outputs match the source module
+        torch = pytest.importorskip("torch")
+        torch.manual_seed(0)
+        net = torch.nn.Sequential(
+            torch.nn.Conv2d(3, 8, 3, stride=1, padding=1),
+            torch.nn.ReLU(),
+            torch.nn.MaxPool2d(2),
+            torch.nn.Conv2d(8, 4, 3),
+            torch.nn.ReLU(),
+            torch.nn.Flatten(),
+            torch.nn.Linear(4 * 6 * 6, 5))
+        rs = np.random.RandomState(1)
+        x = rs.randn(8, 3, 16, 16).astype(np.float32)   # NCHW, as torch
+        with torch.no_grad():
+            ref = net(torch.from_numpy(x)).numpy()
+        tm = TorchModel(net, loss="mse")
+        got = tm.predict(x, batch_size=8)
+        np.testing.assert_allclose(got, ref, rtol=1e-3, atol=1e-4)
 
     def test_unsupported_torch_layer(self):
         torch = pytest.importorskip("torch")
