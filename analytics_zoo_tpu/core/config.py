@@ -36,6 +36,10 @@ class ZooConfig:
     compute_dtype: str = "float32"
 
     # --- training --------------------------------------------------------
+    # Steps fused into one XLA dispatch (lax.scan over a device-resident
+    # superbatch).  >1 amortizes per-step host->device latency — essential
+    # on remote-tunnel links, and still removes dispatch overhead on-host.
+    steps_per_execution: int = 1
     # Failure-retry semantics of InternalDistriOptimizer.train
     # (reference Topology.scala:1179-1261).
     failure_retry_times: int = 5

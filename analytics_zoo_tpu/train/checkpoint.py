@@ -122,14 +122,21 @@ class CheckpointManager:
         self._writer.start()
         return path
 
-    def wait(self) -> None:
-        """Join any in-flight async write; re-raise its failure."""
+    def wait(self, raise_errors: bool = True) -> None:
+        """Join any in-flight async write; re-raise its failure (unless
+        ``raise_errors=False`` — used by restore, where a stale write
+        error must not mask recovery from an older good snapshot)."""
         if self._writer is not None:
             self._writer.join()
             self._writer = None
         if self._writer_err is not None:
             err, self._writer_err = self._writer_err, None
-            raise err
+            if raise_errors:
+                raise err
+            import logging
+            logging.getLogger("analytics_zoo_tpu.train").warning(
+                "ignoring failed async checkpoint write during restore: %s",
+                err)
 
     def all_steps(self) -> List[int]:
         steps = []
@@ -144,7 +151,7 @@ class CheckpointManager:
         return steps[-1] if steps else None
 
     def restore(self, step: Optional[int] = None) -> Tuple[int, Any]:
-        self.wait()
+        self.wait(raise_errors=False)
         if step is None:
             step = self.latest_step()
         if step is None:

@@ -117,6 +117,7 @@ class Estimator:
         self._rng = jax.random.PRNGKey(self.ctx.config.seed)
 
         self._train_step = None
+        self._multi_step = None
         self._eval_step = None
         self._predict_step = None
 
@@ -209,10 +210,13 @@ class Estimator:
         rep = self.ctx.replicated_sharding()
         cdtype = self.compute_dtype
 
-        def step(params, state, opt_state, rng, step_i, xs, y):
-            rng = jax.random.fold_in(rng, step_i)
+        def step(params, state, opt_state, rng, xs, y):
+            # rng is carried ON DEVICE and split inside the step — passing
+            # a host step counter per step would cost a blocking scalar
+            # transfer (tens of ms over remote-tunnel links) per iteration
+            rng, sub = jax.random.split(rng)
 
-            def lossf(p):
+            def lossf(p, rng=sub):
                 # Mixed precision: params + float inputs cast to the
                 # compute dtype for forward/backward (bf16 on the MXU);
                 # the cast's transpose re-accumulates grads in f32 against
@@ -235,16 +239,59 @@ class Estimator:
                 lossf, has_aux=True)(params)
             updates, new_opt = tx.update(grads, opt_state, params)
             new_params = optax.apply_updates(params, updates)
-            return new_params, new_state, new_opt, loss
+            return new_params, new_state, new_opt, rng, loss
 
         # params/state/opt shardings are inherited from their device_put
         # placement (replicated for DP, model-axis split for TP) — pinning
         # only the batch keeps one step implementation for every strategy.
         self._train_step = jax.jit(
             step,
-            in_shardings=(None, None, None, rep, None, data_shard, data_shard),
-            donate_argnums=(0, 1, 2),
+            in_shardings=(None, None, None, rep, data_shard, data_shard),
+            donate_argnums=(0, 1, 2, 3),
         )
+        self._single_step_fn = step
+
+    def _build_multi_step(self):
+        """K steps per dispatch: lax.scan over a (K, B, ...) superbatch
+        uploaded in ONE transfer (``steps_per_execution`` config knob).
+
+        Amortizes per-step host->device latency — the TPU-native answer to
+        the reference's per-iteration Spark job launches (wp-bigdl.md:171
+        measured >10%% overhead at 500 tasks/iter; here the dispatch cost
+        goes to ~zero for K >> 1).
+        """
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        if self._train_step is None:
+            self._build_train_step()
+        single = self._single_step_fn
+        rep = self.ctx.replicated_sharding()
+        # batch axis is axis 1 of the (K, B, ...) superbatch
+        chunk_shard = NamedSharding(self.ctx.mesh, P(None, self.ctx.data_axis))
+
+        def multi(params, state, opt_state, rng, xs_stack, y_stack):
+            def body(carry, batch):
+                p, s, o, r = carry
+                bxs, by = batch
+                p, s, o, r, loss = single(p, s, o, r, bxs, by)
+                return (p, s, o, r), loss
+
+            (params, state, opt_state, rng), losses = jax.lax.scan(
+                body, (params, state, opt_state, rng), (xs_stack, y_stack))
+            return params, state, opt_state, rng, losses
+
+        self._multi_step = jax.jit(
+            multi,
+            in_shardings=(None, None, None, rep, chunk_shard, chunk_shard),
+            donate_argnums=(0, 1, 2, 3),
+        )
+
+    def _shard_chunk(self, arrs: List[np.ndarray]):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        shard = NamedSharding(self.ctx.mesh, P(None, self.ctx.data_axis))
+        with timeit("estimator/shard_chunk"):
+            return [jax.device_put(jnp.asarray(a), shard) for a in arrs]
 
     def _build_eval_step(self):
         model, loss_fn, mets = self.model, self.loss_fn, self.metrics
@@ -379,6 +426,11 @@ class Estimator:
 
         fail_times: List[float] = []
         cfg = self.ctx.config
+        K = max(1, int(cfg.steps_per_execution))
+        if K > 1 and self._multi_step is None:
+            self._build_multi_step()
+        n_chunks = steps_per_epoch // K if K > 1 else 0
+        rem = steps_per_epoch - n_chunks * K
         epoch = self.finished_epochs
         rng_np = np.random.RandomState(cfg.seed)
         y_arr = np.asarray(y)
@@ -391,28 +443,42 @@ class Estimator:
                 losses = []
 
                 def gen(perm=perm):
-                    for s in range(steps_per_epoch):
-                        idx = perm[s * eff_batch:(s + 1) * eff_batch]
-                        yield [a[idx] for a in xs], y_arr[idx]
+                    ofs = 0
+                    for _ in range(n_chunks):
+                        idx = perm[ofs:ofs + K * eff_batch]
+                        ofs += K * eff_batch
+                        yield ("K",
+                               [a[idx].reshape((K, eff_batch) + a.shape[1:])
+                                for a in xs],
+                               y_arr[idx].reshape(
+                                   (K, eff_batch) + y_arr.shape[1:]))
+                    for _ in range(rem):
+                        idx = perm[ofs:ofs + eff_batch]
+                        ofs += eff_batch
+                        yield ("1", [a[idx] for a in xs], y_arr[idx])
 
                 def prep(item):
-                    bx, by = item
-                    return self._shard_batch(bx), self._shard_batch([by])[0]
+                    kind, bx, by = item
+                    put = self._shard_chunk if kind == "K" else \
+                        self._shard_batch
+                    return kind, put(bx), put([by])[0]
 
                 # overlap host batch prep + device_put with device compute
                 batches = prefetch_lib.prefetch(gen(), prep,
                                                 depth=cfg.data_prefetch)
-                for batch_x, batch_y in batches:
-                    self.params, self.state, self.opt_state, loss = (
-                        self._train_step(self.params, self.state,
-                                         self.opt_state, self._rng,
-                                         jnp.asarray(self.global_step), batch_x,
-                                         batch_y))
-                    self.global_step += 1
+                for kind, batch_x, batch_y in batches:
+                    step_fn = (self._multi_step if kind == "K"
+                               else self._train_step)
+                    (self.params, self.state, self.opt_state, self._rng,
+                     loss) = step_fn(self.params, self.state,
+                                     self.opt_state, self._rng,
+                                     batch_x, batch_y)
+                    self.global_step += K if kind == "K" else 1
                     losses.append(loss)
                 epoch += 1
                 self.finished_epochs = epoch
-                mean_loss = float(jnp.mean(jnp.stack(losses)))
+                mean_loss = float(jnp.mean(jnp.concatenate(
+                    [jnp.atleast_1d(l) for l in losses])))
                 dt = time.time() - t0
                 rec = {"epoch": epoch, "loss": mean_loss,
                        "throughput": steps_per_epoch * eff_batch / dt}
@@ -471,6 +537,7 @@ class Estimator:
         """Train from a FeatureSet (iterator-based, supports DISK_AND_DRAM)."""
         first = True
         cfg = self.ctx.config
+        K = max(1, int(cfg.steps_per_execution))
         # bounded shuffle window keeps disk-backed tiers near-sequential
         shuffle_buffer = (cfg.shuffle_buffer
                           if fs.memory_type != "DRAM" else None)
@@ -485,28 +552,53 @@ class Estimator:
                 # peek one batch to build params/steps, then chain it back
                 import itertools
                 raw = iter(raw)
-                peek = next(raw)
+                try:
+                    peek = next(raw)
+                except StopIteration:
+                    raise ValueError(
+                        f"FeatureSet ({len(fs)} rows) yields no full batch "
+                        f"of {batch_size} (drop_remainder)") from None
                 self._ensure_built(list(peek[:-1]))
                 if self._train_step is None:
                     self._build_train_step()
+                if K > 1 and self._multi_step is None:
+                    self._build_multi_step()
                 first = False
                 raw = itertools.chain([peek], raw)
 
-            def prep(batch):
-                *bx, by = batch
-                return self._shard_batch(bx), self._shard_batch([by])[0], \
-                    by.shape[0]
+            def chunked(it):
+                """Group K same-shape batches into (K, B, ...) stacks
+                (drop_remainder=True guarantees uniform shapes)."""
+                buf = []
+                for b in it:
+                    buf.append(b)
+                    if len(buf) == K:
+                        yield ("K", [np.stack([bb[j] for bb in buf])
+                                     for j in range(len(buf[0]))])
+                        buf = []
+                for b in buf:
+                    yield ("1", list(b))
 
-            batches = prefetch_lib.prefetch(raw, prep,
+            def prep(item):
+                kind, arrs = item
+                *bx, by = arrs
+                put = self._shard_chunk if kind == "K" else self._shard_batch
+                rows = (by.shape[0] * by.shape[1] if kind == "K"
+                        else by.shape[0])
+                return kind, put(bx), put([by])[0], rows
+
+            src = chunked(raw) if K > 1 else (("1", list(b)) for b in raw)
+            batches = prefetch_lib.prefetch(src, prep,
                                             depth=cfg.data_prefetch)
             try:
-                for batch_x, batch_y, bn in batches:
-                    self.params, self.state, self.opt_state, loss = (
-                        self._train_step(self.params, self.state,
-                                         self.opt_state, self._rng,
-                                         jnp.asarray(self.global_step),
-                                         batch_x, batch_y))
-                    self.global_step += 1
+                for kind, batch_x, batch_y, bn in batches:
+                    step_fn = (self._multi_step if kind == "K"
+                               else self._train_step)
+                    (self.params, self.state, self.opt_state, self._rng,
+                     loss) = step_fn(self.params, self.state,
+                                     self.opt_state, self._rng,
+                                     batch_x, batch_y)
+                    self.global_step += K if kind == "K" else 1
                     count += bn
                     losses.append(loss)
             except BaseException:
@@ -514,7 +606,8 @@ class Estimator:
                     batches.close()
                 raise
             self.finished_epochs = epoch + 1
-            mean_loss = float(jnp.mean(jnp.stack(losses)))
+            mean_loss = float(jnp.mean(jnp.concatenate(
+                    [jnp.atleast_1d(l) for l in losses])))
             dt = time.time() - t0
             rec = {"epoch": epoch + 1, "loss": mean_loss,
                    "throughput": count / dt}
@@ -612,7 +705,8 @@ class Estimator:
         return {"params": self.params, "state": self.state,
                 "opt_state": self.opt_state,
                 "meta": {"global_step": np.asarray(self.global_step),
-                         "finished_epochs": np.asarray(self.finished_epochs)}}
+                         "finished_epochs": np.asarray(self.finished_epochs),
+                         "rng": np.asarray(self._rng)}}
 
     def _save_checkpoint(self):
         with timeit("estimator/checkpoint_save"):
@@ -640,6 +734,8 @@ class Estimator:
             self.opt_state = jax.device_put(tree["opt_state"], rep)
         self.global_step = int(tree["meta"]["global_step"])
         self.finished_epochs = int(tree["meta"]["finished_epochs"])
+        if "rng" in tree["meta"]:   # resume the dropout/shuffle rng stream
+            self._rng = jnp.asarray(tree["meta"]["rng"])
         logger.info("restored checkpoint step %d", step)
 
     def load_checkpoint(self, directory: str):
