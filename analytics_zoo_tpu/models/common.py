@@ -52,6 +52,14 @@ class ZooModel:
     def predict(self, *a, **kw):
         return self.model.predict(*a, **kw)
 
+    def set_checkpoint(self, path: str, over_write: bool = True):
+        self.model.set_checkpoint(path, over_write=over_write)
+        return self
+
+    def set_tensorboard(self, log_dir: str, app_name: str = "zoo"):
+        self.model.set_tensorboard(log_dir, app_name)
+        return self
+
     @property
     def estimator(self):
         return self.model.estimator

@@ -1,0 +1,25 @@
+"""NNFrames — DataFrame-native ML pipeline (Spark-ML-style Estimators).
+
+Reference capability: ``pipeline/nnframes/`` — ``NNEstimator[T]``
+(NNEstimator.scala:198, internalFit:414-491), ``NNModel`` Transformer,
+``NNClassifier``/``NNClassifierModel`` (NNClassifier 306 LoC),
+``NNImageReader`` (182 LoC), with preprocessing composed through
+``FeatureLabelPreprocessing`` params.
+
+TPU-native design: the DataFrame is a *host-side* pandas/pyarrow object —
+there is no Spark on the data plane (SURVEY §7: the driver role collapses
+into the single-controller JAX program).  ``fit`` lowers the frame's
+columns to numpy, routes them through the FeatureSet tier, and trains with
+the SPMD Estimator; ``transform`` appends a prediction column.  The
+Spark-ML param surface (setBatchSize/setMaxEpoch/...) is kept so reference
+pipelines port 1:1.
+"""
+
+from analytics_zoo_tpu.nnframes.nn_estimator import (NNClassifier,
+                                                     NNClassifierModel,
+                                                     NNEstimator, NNModel)
+from analytics_zoo_tpu.nnframes.nn_image_reader import (NNImageReader,
+                                                        NNImageSchema)
+
+__all__ = ["NNEstimator", "NNModel", "NNClassifier", "NNClassifierModel",
+           "NNImageReader", "NNImageSchema"]

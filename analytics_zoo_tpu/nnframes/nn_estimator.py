@@ -1,0 +1,313 @@
+"""NNEstimator / NNModel / NNClassifier — DataFrame in, model out.
+
+Reference behavior being matched (not ported):
+- ``NNEstimator.fit(df)`` extracts (featuresCol, labelCol), applies the
+  sample preprocessing, builds a FeatureSet at the configured caching
+  level and trains under the distributed optimizer
+  (NNEstimator.scala:381-412 getDataSet, :414-479 internalFit).
+- ``NNModel.transform(df)`` broadcasts the trained model and appends a
+  prediction column per row (NNEstimator.scala:484-491 wrapBigDLModel).
+- ``NNClassifier`` fixes the criterion to classification and its model
+  argmaxes into a ``Double`` label column (NNClassifier.scala).
+
+Here a "DataFrame" is pandas (or anything with ``to_pandas()``, e.g. a
+pyarrow Table); columns hold scalars, lists, or ndarrays.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Callable, List, Optional, Sequence, Union
+
+import numpy as np
+
+
+def _to_pandas(df):
+    if hasattr(df, "to_pandas"):        # pyarrow.Table, polars, ...
+        return df.to_pandas()
+    return df
+
+
+def _col_to_array(col, dtype=None) -> np.ndarray:
+    """Lower a DataFrame column of scalars/lists/arrays to a dense
+    ndarray (the SeqToTensor/MLlibVectorToTensor role,
+    feature/common/Preprocessing.scala)."""
+    vals = col.to_numpy() if hasattr(col, "to_numpy") else np.asarray(col)
+    if vals.dtype == object:
+        vals = np.stack([np.asarray(v) for v in vals])
+    if dtype is not None:
+        vals = vals.astype(dtype)
+    return vals
+
+
+class _Params:
+    """Spark-ML-style param plumbing: every setX returns self;
+    ``copy()`` clones the stage (Estimator/Model share this base)."""
+
+    def copy(self):
+        return copy.copy(self)
+
+    def __init__(self):
+        self.batch_size = 32
+        self.max_epoch = 1
+        self.features_col = "features"
+        self.label_col = "label"
+        self.prediction_col = "prediction"
+        self.caching_sample = "DRAM"     # memory tier for the FeatureSet
+        self.learning_rate = 1e-3
+        self.end_trigger = None
+        self.validation = None           # (trigger, df, batch_size) parity
+        self.checkpoint_path = None
+        self.tensorboard_dir = None
+
+    # -- setters (reference NNEstimator.scala param surface) --------------
+    def set_batch_size(self, v: int):
+        self.batch_size = int(v)
+        return self
+
+    def set_max_epoch(self, v: int):
+        self.max_epoch = int(v)
+        return self
+
+    def set_learning_rate(self, v: float):
+        self.learning_rate = float(v)
+        return self
+
+    def set_features_col(self, name: str):
+        self.features_col = name
+        return self
+
+    def set_label_col(self, name: str):
+        self.label_col = name
+        return self
+
+    def set_prediction_col(self, name: str):
+        self.prediction_col = name
+        return self
+
+    def set_caching_sample(self, tier: str):
+        self.caching_sample = tier
+        return self
+
+    def set_end_when(self, trigger):
+        self.end_trigger = trigger
+        return self
+
+    def set_validation(self, trigger, df, batch_size: int = 32):
+        self.validation = (trigger, df, batch_size)
+        return self
+
+    def set_checkpoint(self, path: str):
+        self.checkpoint_path = path
+        return self
+
+    def set_tensorboard(self, log_dir: str):
+        self.tensorboard_dir = log_dir
+        return self
+
+    # camelCase aliases so reference pipelines paste over
+    setBatchSize = set_batch_size
+    setMaxEpoch = set_max_epoch
+    setLearningRate = set_learning_rate
+    setFeaturesCol = set_features_col
+    setLabelCol = set_label_col
+    setPredictionCol = set_prediction_col
+    setCachingSample = set_caching_sample
+    setEndWhen = set_end_when
+    setValidation = set_validation
+    setCheckpoint = set_checkpoint
+    setTensorboard = set_tensorboard
+
+
+class NNEstimator(_Params):
+    """Fit a Layer-protocol model from a DataFrame
+    (reference NNEstimator.scala:198).
+
+    ``feature_preprocessing`` / ``label_preprocessing``: callables
+    ``ndarray -> ndarray`` applied to the whole extracted column (the
+    FeatureLabelPreprocessing composition, NNEstimator.scala:92-130);
+    image preprocessors from ``data.image`` compose here too.
+    """
+
+    def __init__(self, model, criterion: Union[str, Callable] = "mse",
+                 feature_preprocessing: Optional[Callable] = None,
+                 label_preprocessing: Optional[Callable] = None,
+                 optimizer: Union[str, Any] = None):
+        super().__init__()
+        self.model = model
+        self.criterion = criterion
+        self.feature_preprocessing = feature_preprocessing
+        self.label_preprocessing = label_preprocessing
+        self.optimizer = optimizer
+
+    def _extract(self, df, with_label: bool = True):
+        df = _to_pandas(df)
+        feats = self.features_col
+        feats = [feats] if isinstance(feats, str) else list(feats)
+        xs = [_col_to_array(df[c]) for c in feats]
+        if self.feature_preprocessing is not None:
+            xs = [self.feature_preprocessing(x) for x in xs]
+        xs = [x.astype(np.float32) if x.dtype == np.float64 else x
+              for x in xs]
+        y = None
+        if with_label and self.label_col in getattr(df, "columns", []):
+            y = _col_to_array(df[self.label_col])
+            if self.label_preprocessing is not None:
+                y = self.label_preprocessing(y)
+            if y.dtype == np.float64:
+                y = y.astype(np.float32)
+        return xs, y
+
+    def _build_estimator(self):
+        from analytics_zoo_tpu.train.estimator import Estimator
+        from analytics_zoo_tpu.train.optimizers import Adam
+
+        opt = self.optimizer or Adam(lr=self.learning_rate)
+        est = Estimator(self.model, optimizer=opt, loss=self.criterion)
+        if self.checkpoint_path:
+            est.set_checkpoint(self.checkpoint_path)
+        if self.tensorboard_dir:
+            est.set_tensorboard(self.tensorboard_dir)
+        return est
+
+    def fit(self, df) -> "NNModel":
+        """DataFrame -> FeatureSet(tier) -> SPMD training -> NNModel
+        (reference internalFit, NNEstimator.scala:414-479)."""
+        from analytics_zoo_tpu.data.featureset import FeatureSet
+
+        xs, y = self._extract(df)
+        if y is None:
+            raise ValueError(f"label column {self.label_col!r} not in frame")
+        est = self._build_estimator()
+        fs = FeatureSet(xs + [y], memory_type=self.caching_sample)
+        validation_data, val_trigger, val_batch = None, None, None
+        if self.validation is not None:
+            val_trigger, vdf, val_batch = self.validation
+            vx, vy = self._extract(vdf)
+            validation_data = (vx, vy)
+        est.fit(fs, batch_size=self.batch_size, epochs=self.max_epoch,
+                validation_data=validation_data,
+                validation_trigger=val_trigger,
+                validation_batch_size=val_batch,
+                end_trigger=self.end_trigger, verbose=False)
+        return self._wrap_model(est)
+
+    def _wrap_model(self, est) -> "NNModel":
+        m = NNModel(self.model, estimator=est,
+                    feature_preprocessing=self.feature_preprocessing)
+        m.features_col = self.features_col
+        m.prediction_col = self.prediction_col
+        m.batch_size = self.batch_size
+        return m
+
+
+class NNModel(_Params):
+    """Transformer: appends model predictions to a DataFrame
+    (reference NNModel, NNEstimator.scala:484-491)."""
+
+    def __init__(self, model, estimator=None,
+                 feature_preprocessing: Optional[Callable] = None):
+        super().__init__()
+        self.model = model
+        self.feature_preprocessing = feature_preprocessing
+        if estimator is None:
+            from analytics_zoo_tpu.train.estimator import Estimator
+
+            estimator = Estimator(model, loss="mse")
+        self.estimator = estimator
+
+    def _extract_features(self, df):
+        df = _to_pandas(df)
+        feats = self.features_col
+        feats = [feats] if isinstance(feats, str) else list(feats)
+        xs = [_col_to_array(df[c]) for c in feats]
+        if self.feature_preprocessing is not None:
+            xs = [self.feature_preprocessing(x) for x in xs]
+        return df, [x.astype(np.float32) if x.dtype == np.float64 else x
+                    for x in xs]
+
+    def _predict_array(self, xs) -> np.ndarray:
+        return self.estimator.predict(xs, batch_size=self.batch_size)
+
+    def transform(self, df):
+        df, xs = self._extract_features(df)
+        preds = self._predict_array(xs)
+        out = df.copy()
+        if preds.ndim > 1 and preds.shape[-1] == 1:
+            preds = preds[..., 0]
+        out[self.prediction_col] = (list(preds) if preds.ndim > 1
+                                    else preds)
+        return out
+
+    # -- persistence (reference NNModel.write/read) ------------------------
+    def save(self, path: str) -> None:
+        from analytics_zoo_tpu.train import checkpoint as ckpt
+
+        ckpt.save_pytree(path, {"params": self.estimator.params,
+                                "state": self.estimator.state or {}})
+
+    def load_weights(self, path: str) -> "NNModel":
+        from analytics_zoo_tpu.train import checkpoint as ckpt
+
+        tree = ckpt.load_pytree(path)
+        self.estimator.set_initial_weights(tree["params"],
+                                           tree.get("state", {}))
+        return self
+
+
+class NNClassifier(NNEstimator):
+    """NNEstimator specialised for classification
+    (reference NNClassifier.scala): integer/float labels, and the fitted
+    model predicts a class index column."""
+
+    def __init__(self, model, criterion: Union[str, Callable] =
+                 "sparse_categorical_crossentropy",
+                 feature_preprocessing: Optional[Callable] = None,
+                 zero_based_label: bool = True, **kw):
+        super().__init__(model, criterion=criterion,
+                         feature_preprocessing=feature_preprocessing, **kw)
+        self.zero_based_label = zero_based_label
+
+    def _extract(self, df, with_label: bool = True):
+        xs, y = super()._extract(df, with_label)
+        if y is not None:
+            y = y.astype(np.int32)
+            if not self.zero_based_label:   # reference 1-based labels
+                y = y - 1
+        return xs, y
+
+    def _wrap_model(self, est) -> "NNClassifierModel":
+        m = NNClassifierModel(
+            self.model, estimator=est,
+            feature_preprocessing=self.feature_preprocessing,
+            zero_based_label=self.zero_based_label)
+        m.features_col = self.features_col
+        m.prediction_col = self.prediction_col
+        m.batch_size = self.batch_size
+        return m
+
+
+class NNClassifierModel(NNModel):
+    """Argmaxes class scores into the prediction column
+    (reference NNClassifierModel)."""
+
+    def __init__(self, model, estimator=None,
+                 feature_preprocessing: Optional[Callable] = None,
+                 zero_based_label: bool = True):
+        super().__init__(model, estimator=estimator,
+                         feature_preprocessing=feature_preprocessing)
+        self.zero_based_label = zero_based_label
+
+    def transform(self, df):
+        df, xs = self._extract_features(df)
+        scores = self.estimator.predict(xs, batch_size=self.batch_size)
+        if scores.ndim == 1 or scores.shape[-1] == 1:
+            cls = (np.asarray(scores).reshape(len(scores)) > 0.5).astype(
+                np.int64)
+        else:
+            cls = np.argmax(scores, axis=-1).astype(np.int64)
+        if not self.zero_based_label:
+            cls = cls + 1
+        out = df.copy()
+        out[self.prediction_col] = cls.astype(np.float64)  # Spark-ML Double
+        return out

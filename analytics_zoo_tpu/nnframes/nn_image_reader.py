@@ -1,0 +1,46 @@
+"""NNImageReader — images as a DataFrame (reference NNImageReader.scala).
+
+The reference reads images into a Spark DataFrame with the standard image
+schema struct (origin, height, width, nChannels, mode, data).  Here the
+same schema lands in a pandas DataFrame; ``data`` holds the raw
+ndarray (H, W, C uint8, BGR — matching the OpenCV convention the
+reference inherits from BigDL's OpenCVMat).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+#: column order of the image schema (Spark's ImageSchema parity)
+NNImageSchema = ("origin", "height", "width", "nChannels", "mode", "data")
+
+
+class NNImageReader:
+    """Read image files into an image-schema DataFrame
+    (reference NNImageReader.readImages).  Listing + decoding is
+    ``data.image.ImageSet.read`` — one implementation for both the
+    ImageSet and DataFrame front doors."""
+
+    @staticmethod
+    def read_images(path: str, resize_h: Optional[int] = None,
+                    resize_w: Optional[int] = None):
+        import cv2
+        import pandas as pd
+
+        from analytics_zoo_tpu.data.image import ImageSet
+
+        rows = []
+        for feat in ImageSet.read(path).features:
+            img = feat["image"]
+            if resize_h and resize_w:
+                img = cv2.resize(img, (resize_w, resize_h))
+            h, w = img.shape[:2]
+            c = img.shape[2] if img.ndim == 3 else 1
+            rows.append({"origin": os.path.abspath(feat["path"]),
+                         "height": h, "width": w, "nChannels": c,
+                         "mode": 16 if c == 3 else 0,   # CV_8UC3 / CV_8UC1
+                         "data": img})
+        return pd.DataFrame(rows, columns=list(NNImageSchema))
+
+    readImages = read_images

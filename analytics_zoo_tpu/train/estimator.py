@@ -113,6 +113,8 @@ class Estimator:
 
         self._ckpt_mgr: Optional[ckpt_lib.CheckpointManager] = None
         self._ckpt_trigger: Trigger = EveryEpoch()
+        self._val_trigger: Optional[Trigger] = None
+        self._val_batch: Optional[int] = None
         self._tb_writer = None
         self._rng = jax.random.PRNGKey(self.ctx.config.seed)
 
@@ -389,14 +391,22 @@ class Estimator:
     # ------------------------------------------------------------------
     def fit(self, x, y=None, batch_size: int = 32, epochs: int = 1,
             validation_data=None, end_trigger: Optional[Trigger] = None,
-            shuffle: bool = True, verbose: bool = True):
+            shuffle: bool = True, verbose: bool = True,
+            validation_trigger: Optional[Trigger] = None,
+            validation_batch_size: Optional[int] = None):
         """Synchronous SPMD training with retry-from-checkpoint.
 
         ``x`` — array or list of arrays (multi-input models); or a
         FeatureSet/dataset yielding ``(inputs..., y)`` batches.
+        ``validation_trigger`` — evaluate only when it fires (default:
+        every epoch); ``validation_batch_size`` defaults to the training
+        batch (reference setValidation trigger/batch semantics,
+        Topology.scala:223-244).
         """
         from analytics_zoo_tpu.data.featureset import FeatureSet
 
+        self._val_trigger = validation_trigger
+        self._val_batch = validation_batch_size
         if isinstance(x, FeatureSet):
             return self._fit_featureset(x, batch_size, epochs,
                                         validation_data, end_trigger, verbose)
@@ -484,9 +494,12 @@ class Estimator:
                        "throughput": steps_per_epoch * eff_batch / dt}
                 tstate = TriggerState(epoch=epoch, iteration=self.global_step,
                                       epoch_finished=True, loss=mean_loss)
-                if validation_data is not None:
+                if validation_data is not None and (
+                        self._val_trigger is None
+                        or self._val_trigger(tstate)):
                     val = self.evaluate(validation_data[0], validation_data[1],
-                                        batch_size=eff_batch)
+                                        batch_size=self._val_batch
+                                        or eff_batch)
                     rec.update({f"val_{k}": v for k, v in val.items()})
                     tstate.score = val.get(
                         self.metrics[0].name if self.metrics else "loss")
@@ -613,9 +626,12 @@ class Estimator:
                    "throughput": count / dt}
             tstate = TriggerState(epoch=epoch + 1, iteration=self.global_step,
                                   epoch_finished=True, loss=mean_loss)
-            if validation_data is not None:
+            if validation_data is not None and (
+                    getattr(self, "_val_trigger", None) is None
+                    or self._val_trigger(tstate)):
                 val = self.evaluate(validation_data[0], validation_data[1],
-                                    batch_size=batch_size)
+                                    batch_size=getattr(self, "_val_batch",
+                                                       None) or batch_size)
                 rec.update({f"val_{k}": v for k, v in val.items()})
                 tstate.score = val.get(
                     self.metrics[0].name if self.metrics else "loss")
@@ -736,6 +752,11 @@ class Estimator:
         self.finished_epochs = int(tree["meta"]["finished_epochs"])
         if "rng" in tree["meta"]:   # resume the dropout/shuffle rng stream
             self._rng = jnp.asarray(tree["meta"]["rng"])
+        else:
+            # pre-rng-meta checkpoint: the live key may be a donated
+            # (deleted) buffer after a failed step — re-seed so retry works
+            self._rng = jax.random.fold_in(
+                jax.random.PRNGKey(self.ctx.config.seed), step)
         logger.info("restored checkpoint step %d", step)
 
     def load_checkpoint(self, directory: str):

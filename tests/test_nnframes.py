@@ -1,0 +1,148 @@
+"""NNFrames pipeline tests (BASELINE config #3): DataFrame in ->
+NNEstimator.fit -> NNModel.transform appends predictions
+(reference NNEstimator.scala:198,414-491 + test suites under
+zoo/src/test/scala/.../nnframes)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from analytics_zoo_tpu.nn.layers.core import Dense
+from analytics_zoo_tpu.nn.topology import Sequential
+from analytics_zoo_tpu.nnframes import (NNClassifier, NNClassifierModel,
+                                        NNEstimator, NNImageReader, NNModel)
+
+
+def _mlp(out_dim, in_dim=4, activation=None):
+    m = Sequential()
+    m.add(Dense(16, activation="relu", input_shape=(in_dim,)))
+    m.add(Dense(out_dim, activation=activation))
+    return m
+
+
+def _regression_df(n=96, in_dim=4, seed=0):
+    rs = np.random.RandomState(seed)
+    x = rs.randn(n, in_dim).astype(np.float32)
+    y = (x @ rs.randn(in_dim)).astype(np.float32)
+    return pd.DataFrame({"features": list(x), "label": y})
+
+
+class TestNNEstimator:
+    def test_fit_transform_regression(self, zoo_ctx):
+        df = _regression_df()
+        est = (NNEstimator(_mlp(1), criterion="mse")
+               .setBatchSize(32).setMaxEpoch(8).setLearningRate(1e-2))
+        model = est.fit(df)
+        assert isinstance(model, NNModel)
+        out = model.transform(df)
+        assert "prediction" in out.columns
+        assert len(out) == len(df)
+        # trained predictions correlate with the labels
+        corr = np.corrcoef(out["prediction"], df["label"])[0, 1]
+        assert corr > 0.5, corr
+
+    def test_param_surface(self):
+        est = NNEstimator(_mlp(1))
+        ret = (est.set_batch_size(16).set_max_epoch(2)
+               .set_features_col("f").set_label_col("l")
+               .set_prediction_col("p").set_caching_sample("DISK_AND_DRAM"))
+        assert ret is est
+        assert (est.batch_size, est.max_epoch) == (16, 2)
+        assert (est.features_col, est.label_col, est.prediction_col) == (
+            "f", "l", "p")
+
+    def test_custom_columns_and_disk_tier(self, zoo_ctx):
+        rs = np.random.RandomState(1)
+        df = pd.DataFrame({
+            "f": list(rs.randn(64, 4).astype(np.float32)),
+            "l": rs.randn(64).astype(np.float32)})
+        est = (NNEstimator(_mlp(1), criterion="mse")
+               .set_features_col("f").set_label_col("l")
+               .set_prediction_col("yhat")
+               .set_caching_sample("DISK_AND_DRAM")
+               .set_batch_size(32).set_max_epoch(1))
+        out = est.fit(df).set_features_col("f") \
+                 .set_prediction_col("yhat").transform(df)
+        assert "yhat" in out.columns
+
+    def test_feature_preprocessing(self, zoo_ctx):
+        # preprocessing runs on the extracted column before training
+        df = _regression_df()
+        seen = {}
+
+        def scale(x):
+            seen["called"] = True
+            return x * 0.5
+
+        est = NNEstimator(_mlp(1), criterion="mse",
+                          feature_preprocessing=scale).set_max_epoch(1)
+        est.set_batch_size(32).fit(df)
+        assert seen.get("called")
+
+    def test_missing_label_raises(self):
+        df = pd.DataFrame({"features": list(np.zeros((8, 4), np.float32))})
+        with pytest.raises(ValueError, match="label"):
+            NNEstimator(_mlp(1)).fit(df)
+
+    def test_validation_and_pyarrow_input(self, zoo_ctx):
+        pa = pytest.importorskip("pyarrow")
+        df = _regression_df(64)
+        table = pa.Table.from_pandas(df)
+        est = (NNEstimator(_mlp(1), criterion="mse")
+               .set_batch_size(32).set_max_epoch(1))
+        est.set_validation(None, df, 32)
+        model = est.fit(table)
+        out = model.transform(table)
+        assert "prediction" in out.columns
+
+
+class TestNNClassifier:
+    def test_fit_predict_classes(self, zoo_ctx):
+        rs = np.random.RandomState(0)
+        x = rs.randn(96, 4).astype(np.float32)
+        y = (x[:, 0] + x[:, 1] > 0).astype(np.int64)
+        df = pd.DataFrame({"features": list(x), "label": y})
+        clf = (NNClassifier(_mlp(2, activation="softmax"),
+                            criterion="sparse_categorical_crossentropy")
+               .setBatchSize(32).setMaxEpoch(10).setLearningRate(1e-2))
+        model = clf.fit(df)
+        assert isinstance(model, NNClassifierModel)
+        out = model.transform(df)
+        acc = float((out["prediction"].to_numpy() == y).mean())
+        assert acc > 0.8, acc
+        assert out["prediction"].dtype == np.float64  # Spark-ML Double
+
+    def test_one_based_labels(self, zoo_ctx):
+        rs = np.random.RandomState(0)
+        x = rs.randn(64, 4).astype(np.float32)
+        y = (x[:, 0] > 0).astype(np.int64) + 1        # labels in {1, 2}
+        df = pd.DataFrame({"features": list(x), "label": y})
+        clf = (NNClassifier(_mlp(2, activation="softmax"),
+                            zero_based_label=False)
+               .setBatchSize(32).setMaxEpoch(5))
+        out = clf.fit(df).transform(df)
+        assert set(np.unique(out["prediction"])) <= {1.0, 2.0}
+
+
+class TestNNImageReader:
+    def test_read_images_schema(self, tmp_path):
+        import cv2
+
+        for i in range(3):
+            img = np.full((10 + i, 12, 3), i * 40, np.uint8)
+            cv2.imwrite(str(tmp_path / f"im{i}.png"), img)
+        df = NNImageReader.read_images(str(tmp_path))
+        assert list(df.columns) == ["origin", "height", "width",
+                                    "nChannels", "mode", "data"]
+        assert len(df) == 3
+        assert df.iloc[0]["height"] == 10
+        assert df.iloc[0]["data"].shape == (10, 12, 3)
+
+    def test_read_images_resize(self, tmp_path):
+        import cv2
+
+        cv2.imwrite(str(tmp_path / "a.jpg"), np.zeros((32, 48, 3), np.uint8))
+        df = NNImageReader.read_images(str(tmp_path), resize_h=8, resize_w=9)
+        assert df.iloc[0]["data"].shape == (8, 9, 3)
+        # origin column keeps provenance
+        assert df.iloc[0]["origin"].endswith("a.jpg")
