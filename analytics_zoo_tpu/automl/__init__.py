@@ -1,0 +1,32 @@
+"""AutoML for time series (reference pyzoo/zoo/automl, 4.7k LoC).
+
+Capability parity, TPU-native design:
+- ``TimeSequencePredictor.fit(df)`` drives a hyper-parameter search over
+  rolling-window feature configs + model configs and returns a fitted
+  ``TimeSequencePipeline`` (reference regression/time_sequence_predictor.py).
+- The search engine is **in-process** with a ray.tune-shaped API
+  (search/__init__.py): the reference bootstraps a second Ray runtime on
+  Spark executors (RayOnSpark) because its training is JVM-cluster-bound;
+  here every trial is a jitted JAX program on the local mesh, so trials
+  run in a thread pool and ray is not required (used if installed).
+- Feature engineering (rolling windows, datetime features, scaling) in
+  feature/time_sequence.py (reference feature/time_sequence.py:30-540).
+- Models: VanillaLSTM (future_seq_len==1) and Seq2Seq (>1) on the native
+  nn stack (reference automl/model/VanillaLSTM.py, Seq2Seq.py).
+"""
+
+from analytics_zoo_tpu.automl.common.metrics import Evaluator
+from analytics_zoo_tpu.automl.feature.time_sequence import (
+    TimeSequenceFeatureTransformer)
+from analytics_zoo_tpu.automl.pipeline.time_sequence import (
+    TimeSequencePipeline, load_ts_pipeline)
+from analytics_zoo_tpu.automl.regression.time_sequence_predictor import (
+    TimeSequencePredictor)
+from analytics_zoo_tpu.automl.search import (GridRandomRecipe, RandomRecipe,
+                                             Recipe, SearchEngine,
+                                             SmokeRecipe)
+
+__all__ = ["TimeSequencePredictor", "TimeSequencePipeline",
+           "load_ts_pipeline", "TimeSequenceFeatureTransformer",
+           "Evaluator", "SearchEngine", "Recipe", "SmokeRecipe",
+           "RandomRecipe", "GridRandomRecipe"]

@@ -1,0 +1,65 @@
+"""TimeSequencePipeline — fitted transformer + model, save/load
+(reference automl/pipeline/time_sequence.py)."""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Optional
+
+import numpy as np
+import pandas as pd
+
+from analytics_zoo_tpu.automl.common.metrics import Evaluator
+from analytics_zoo_tpu.automl.feature.time_sequence import (
+    TimeSequenceFeatureTransformer)
+from analytics_zoo_tpu.automl.model.time_sequence import VanillaLSTM
+
+
+class TimeSequencePipeline:
+    """Predict/evaluate on raw DataFrames with the best found config."""
+
+    def __init__(self, feature_transformer: TimeSequenceFeatureTransformer,
+                 model, config: Dict):
+        self.feature_transformer = feature_transformer
+        self.model = model
+        self.config = dict(config)
+
+    def predict(self, input_df: pd.DataFrame) -> pd.DataFrame:
+        x, _ = self.feature_transformer.transform(input_df, is_train=False)
+        y = self.model.predict(x)
+        return self.feature_transformer.post_processing(input_df, y,
+                                                        is_train=False)
+
+    def evaluate(self, input_df: pd.DataFrame, metric: str = "mse") -> float:
+        x, y = self.feature_transformer.transform(input_df, is_train=True)
+        pred = self.model.predict(x)
+        y_true = self.feature_transformer._unscale_y(y)
+        y_pred = self.feature_transformer._unscale_y(np.asarray(pred))
+        return Evaluator.evaluate(metric, y_true, y_pred)
+
+    # -- persistence -------------------------------------------------------
+    def save(self, pipeline_dir: str) -> None:
+        os.makedirs(pipeline_dir, exist_ok=True)
+        self.feature_transformer.save(
+            os.path.join(pipeline_dir, "feature_transformer.json"))
+        self.model.save(os.path.join(pipeline_dir, "model.npz"))
+        meta = {"config": {k: (list(v) if isinstance(v, (list, tuple))
+                               else v) for k, v in self.config.items()},
+                "future_seq_len": self.feature_transformer.future_seq_len}
+        with open(os.path.join(pipeline_dir, "pipeline.json"), "w") as f:
+            json.dump(meta, f)
+
+
+def load_ts_pipeline(pipeline_dir: str) -> TimeSequencePipeline:
+    with open(os.path.join(pipeline_dir, "pipeline.json")) as f:
+        meta = json.load(f)
+    ft = TimeSequenceFeatureTransformer.load(
+        os.path.join(pipeline_dir, "feature_transformer.json"))
+    config = meta["config"]
+    model = VanillaLSTM()
+    past = int(config.get("past_seq_len", 2))
+    n_feat = 1 + len(config.get("selected_features", []))
+    model.restore(os.path.join(pipeline_dir, "model.npz"),
+                  (past, n_feat), meta["future_seq_len"], config)
+    return TimeSequencePipeline(ft, model, config)

@@ -1,0 +1,267 @@
+"""Hyper-parameter search: sampling primitives, recipes, and an
+in-process engine with a ray.tune-shaped API.
+
+Reference capability: ``RayTuneSearchEngine`` (automl/search/
+RayTuneSearchEngine.py:28) running trials as Ray actors over RayOnSpark.
+TPU-native redesign: a trial is a jitted JAX program on the local mesh,
+so the engine runs trials in a thread pool in-process — no second
+runtime to bootstrap (RayOnSpark's barrier-stage dance,
+ray/util/raycontext.py:155-189, is obsolete by construction).  If ray is
+installed the same search space works with ray.tune unchanged.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures as cf
+import itertools
+import logging
+import random
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+logger = logging.getLogger("analytics_zoo_tpu.automl")
+
+
+# ---------------------------------------------------------------------------
+# sampling primitives (tune.choice / randint / uniform / grid_search)
+# ---------------------------------------------------------------------------
+
+class Sampler:
+    def sample(self, rng: random.Random) -> Any:
+        raise NotImplementedError
+
+
+@dataclass
+class Choice(Sampler):
+    values: Sequence[Any]
+
+    def sample(self, rng):
+        return rng.choice(list(self.values))
+
+
+@dataclass
+class RandInt(Sampler):
+    low: int
+    high: int    # inclusive
+
+    def sample(self, rng):
+        return rng.randint(self.low, self.high)
+
+
+@dataclass
+class Uniform(Sampler):
+    low: float
+    high: float
+
+    def sample(self, rng):
+        return rng.uniform(self.low, self.high)
+
+
+@dataclass
+class LogUniform(Sampler):
+    low: float
+    high: float
+
+    def sample(self, rng):
+        import math
+
+        return math.exp(rng.uniform(math.log(self.low), math.log(self.high)))
+
+
+@dataclass
+class GridSearch(Sampler):
+    """Expanded exhaustively (cartesian with other GridSearch dims)."""
+
+    values: Sequence[Any]
+
+
+def sample_config(space: Dict[str, Any], rng: random.Random) -> Dict[str, Any]:
+    out = {}
+    for k, v in space.items():
+        if isinstance(v, GridSearch):
+            out[k] = rng.choice(list(v.values))
+        elif isinstance(v, Sampler):
+            out[k] = v.sample(rng)
+        else:
+            out[k] = v
+    return out
+
+
+def expand_grid(space: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """Cartesian product over GridSearch dims (non-grid dims untouched)."""
+    grid_keys = [k for k, v in space.items() if isinstance(v, GridSearch)]
+    if not grid_keys:
+        return [dict(space)]
+    combos = itertools.product(*[space[k].values for k in grid_keys])
+    out = []
+    for combo in combos:
+        d = dict(space)
+        d.update(dict(zip(grid_keys, combo)))
+        out.append(d)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# recipes (reference time_sequence_predictor.py:37-334)
+# ---------------------------------------------------------------------------
+
+class Recipe:
+    """A search space + trial budget."""
+
+    num_samples: int = 1
+    training_iteration: int = 10
+
+    def search_space(self, all_available_features: Sequence[str]
+                     ) -> Dict[str, Any]:
+        raise NotImplementedError
+
+
+class SmokeRecipe(Recipe):
+    """Tiny space to validate the plumbing (reference SmokeRecipe)."""
+
+    num_samples = 1
+    training_iteration = 1
+
+    def search_space(self, all_available_features):
+        return {
+            "selected_features": list(all_available_features),
+            "past_seq_len": 2,
+            "lstm_1_units": 16,
+            "lstm_2_units": 16,
+            "dropout": 0.2,
+            "lr": 1e-3,
+            "batch_size": 32,
+            "epochs": 1,
+        }
+
+
+class RandomRecipe(Recipe):
+    """Random sampling over the LSTM space (reference RandomRecipe)."""
+
+    def __init__(self, num_rand_samples: int = 1, look_back: int = 2):
+        self.num_samples = num_rand_samples
+        self.training_iteration = 10
+        self.look_back = look_back
+
+    def search_space(self, all_available_features):
+        return {
+            "selected_features": FeatureSubset(all_available_features),
+            "past_seq_len": (RandInt(self.look_back[0], self.look_back[1])
+                             if isinstance(self.look_back, (tuple, list))
+                             else self.look_back),
+            "lstm_1_units": Choice([16, 32, 64, 128]),
+            "lstm_2_units": Choice([16, 32, 64]),
+            "dropout": Uniform(0.2, 0.5),
+            "lr": LogUniform(1e-4, 1e-2),
+            "batch_size": Choice([32, 64, 128]),
+            "epochs": 5,
+        }
+
+
+class GridRandomRecipe(Recipe):
+    """Grid over structure x random over the rest (reference
+    GridRandomRecipe)."""
+
+    def __init__(self, num_rand_samples: int = 1, look_back: int = 2):
+        self.num_samples = num_rand_samples
+        self.training_iteration = 10
+        self.look_back = look_back
+
+    def search_space(self, all_available_features):
+        return {
+            "selected_features": FeatureSubset(all_available_features),
+            "past_seq_len": (RandInt(self.look_back[0], self.look_back[1])
+                             if isinstance(self.look_back, (tuple, list))
+                             else self.look_back),
+            "lstm_1_units": GridSearch([16, 64]),
+            "lstm_2_units": GridSearch([16, 64]),
+            "dropout": Uniform(0.2, 0.5),
+            "lr": LogUniform(1e-4, 1e-2),
+            "batch_size": Choice([32, 64]),
+            "epochs": 5,
+        }
+
+
+@dataclass
+class FeatureSubset(Sampler):
+    """Random non-empty subset of generated features (the reference's
+    per-feature Choice([0,1]) encoding, RayTuneSearchEngine.py)."""
+
+    values: Sequence[str]
+
+    def sample(self, rng):
+        vals = list(self.values)
+        if not vals:
+            return []
+        picked = [v for v in vals if rng.random() < 0.5]
+        return picked or [rng.choice(vals)]
+
+
+# ---------------------------------------------------------------------------
+# engine
+# ---------------------------------------------------------------------------
+
+@dataclass
+class TrialResult:
+    config: Dict[str, Any]
+    metric: float
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+
+class SearchEngine:
+    """Run trials over a search space, keep the best by metric.
+
+    ``trainable(config) -> float | (float, extra_dict)`` — like a
+    ray.tune trainable's final reported metric.
+    """
+
+    def __init__(self, search_space: Dict[str, Any], metric_mode: str = "min",
+                 num_samples: int = 1, max_parallel: int = 1, seed: int = 42):
+        self.search_space = search_space
+        self.metric_mode = metric_mode
+        self.num_samples = num_samples
+        self.max_parallel = max(1, max_parallel)
+        self.seed = seed
+        self.results: List[TrialResult] = []
+
+    def _configs(self) -> List[Dict[str, Any]]:
+        rng = random.Random(self.seed)
+        configs = []
+        for grid_cfg in expand_grid(self.search_space):
+            for _ in range(self.num_samples):
+                configs.append(sample_config(grid_cfg, rng))
+        return configs
+
+    def run(self, trainable: Callable[[Dict[str, Any]], Any]
+            ) -> List[TrialResult]:
+        configs = self._configs()
+
+        def one(cfg):
+            out = trainable(dict(cfg))
+            if isinstance(out, tuple):
+                score, extra = out
+            else:
+                score, extra = out, {}
+            return TrialResult(cfg, float(score), extra)
+
+        if self.max_parallel == 1:
+            self.results = [one(c) for c in configs]
+        else:
+            with cf.ThreadPoolExecutor(self.max_parallel) as pool:
+                self.results = list(pool.map(one, configs))
+        for i, r in enumerate(self.results):
+            logger.info("trial %d/%d metric=%.6g", i + 1,
+                        len(self.results), r.metric)
+        return self.results
+
+    def best(self) -> TrialResult:
+        if not self.results:
+            raise RuntimeError("run() first")
+        key = (max if self.metric_mode == "max" else min)
+        return key(self.results, key=lambda r: r.metric)
+
+
+__all__ = ["SearchEngine", "TrialResult", "Recipe", "SmokeRecipe",
+           "RandomRecipe", "GridRandomRecipe", "Choice", "RandInt",
+           "Uniform", "LogUniform", "GridSearch", "FeatureSubset",
+           "sample_config", "expand_grid"]

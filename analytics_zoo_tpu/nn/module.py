@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import collections
 import functools
+import threading
 from typing import Any, Callable, Dict, Optional, Sequence, Tuple
 
 import jax
@@ -28,17 +29,27 @@ import jax.numpy as jnp
 Params = Any   # pytree
 State = Any    # pytree
 
-_NAME_COUNTERS: Dict[str, int] = collections.defaultdict(int)
+# Auto-naming counters are THREAD-LOCAL: concurrent model builds (e.g.
+# parallel AutoML trials in a thread pool) each get an isolated scope, so
+# two threads never race a shared counter into colliding layer names.
+_NAME_SCOPE = threading.local()
+
+
+def _counters() -> Dict[str, int]:
+    if not hasattr(_NAME_SCOPE, "counters"):
+        _NAME_SCOPE.counters = collections.defaultdict(int)
+    return _NAME_SCOPE.counters
 
 
 def _auto_name(cls_name: str) -> str:
-    _NAME_COUNTERS[cls_name] += 1
-    return f"{cls_name.lower()}_{_NAME_COUNTERS[cls_name]}"
+    c = _counters()
+    c[cls_name] += 1
+    return f"{cls_name.lower()}_{c[cls_name]}"
 
 
 def reset_name_scope() -> None:
-    """Reset auto-naming counters (test isolation)."""
-    _NAME_COUNTERS.clear()
+    """Reset the calling thread's auto-naming counters (test isolation)."""
+    _counters().clear()
 
 
 class Layer:

@@ -40,6 +40,18 @@ def _col_to_array(col, dtype=None) -> np.ndarray:
     return vals
 
 
+def _extract_features(df, features_col, preprocessing) -> List[np.ndarray]:
+    """THE feature-column lowering (shared by NNEstimator and NNModel so
+    dtype/preprocessing behavior cannot drift between fit and transform)."""
+    cols = [features_col] if isinstance(features_col, str) \
+        else list(features_col)
+    xs = [_col_to_array(df[c]) for c in cols]
+    if preprocessing is not None:
+        xs = [preprocessing(x) for x in xs]
+    return [x.astype(np.float32) if x.dtype == np.float64 else x
+            for x in xs]
+
+
 class _Params:
     """Spark-ML-style param plumbing: every setX returns self;
     ``copy()`` clones the stage (Estimator/Model share this base)."""
@@ -142,13 +154,8 @@ class NNEstimator(_Params):
 
     def _extract(self, df, with_label: bool = True):
         df = _to_pandas(df)
-        feats = self.features_col
-        feats = [feats] if isinstance(feats, str) else list(feats)
-        xs = [_col_to_array(df[c]) for c in feats]
-        if self.feature_preprocessing is not None:
-            xs = [self.feature_preprocessing(x) for x in xs]
-        xs = [x.astype(np.float32) if x.dtype == np.float64 else x
-              for x in xs]
+        xs = _extract_features(df, self.features_col,
+                               self.feature_preprocessing)
         y = None
         if with_label and self.label_col in getattr(df, "columns", []):
             y = _col_to_array(df[self.label_col])
@@ -184,6 +191,9 @@ class NNEstimator(_Params):
         if self.validation is not None:
             val_trigger, vdf, val_batch = self.validation
             vx, vy = self._extract(vdf)
+            if vy is None:
+                raise ValueError(
+                    f"validation frame lacks label column {self.label_col!r}")
             validation_data = (vx, vy)
         est.fit(fs, batch_size=self.batch_size, epochs=self.max_epoch,
                 validation_data=validation_data,
@@ -218,25 +228,22 @@ class NNModel(_Params):
 
     def _extract_features(self, df):
         df = _to_pandas(df)
-        feats = self.features_col
-        feats = [feats] if isinstance(feats, str) else list(feats)
-        xs = [_col_to_array(df[c]) for c in feats]
-        if self.feature_preprocessing is not None:
-            xs = [self.feature_preprocessing(x) for x in xs]
-        return df, [x.astype(np.float32) if x.dtype == np.float64 else x
-                    for x in xs]
+        return df, _extract_features(df, self.features_col,
+                                     self.feature_preprocessing)
 
-    def _predict_array(self, xs) -> np.ndarray:
-        return self.estimator.predict(xs, batch_size=self.batch_size)
+    def _postprocess_scores(self, scores: np.ndarray):
+        """Raw model outputs -> prediction-column values (overridden by
+        NNClassifierModel to argmax into class labels)."""
+        if scores.ndim > 1 and scores.shape[-1] == 1:
+            scores = scores[..., 0]
+        return list(scores) if scores.ndim > 1 else scores
 
     def transform(self, df):
         df, xs = self._extract_features(df)
-        preds = self._predict_array(xs)
+        scores = self.estimator.predict(xs, batch_size=self.batch_size)
         out = df.copy()
-        if preds.ndim > 1 and preds.shape[-1] == 1:
-            preds = preds[..., 0]
-        out[self.prediction_col] = (list(preds) if preds.ndim > 1
-                                    else preds)
+        out[self.prediction_col] = self._postprocess_scores(
+            np.asarray(scores))
         return out
 
     # -- persistence (reference NNModel.write/read) ------------------------
@@ -298,16 +305,11 @@ class NNClassifierModel(NNModel):
                          feature_preprocessing=feature_preprocessing)
         self.zero_based_label = zero_based_label
 
-    def transform(self, df):
-        df, xs = self._extract_features(df)
-        scores = self.estimator.predict(xs, batch_size=self.batch_size)
+    def _postprocess_scores(self, scores: np.ndarray):
         if scores.ndim == 1 or scores.shape[-1] == 1:
-            cls = (np.asarray(scores).reshape(len(scores)) > 0.5).astype(
-                np.int64)
+            cls = (scores.reshape(len(scores)) > 0.5).astype(np.int64)
         else:
             cls = np.argmax(scores, axis=-1).astype(np.int64)
         if not self.zero_based_label:
             cls = cls + 1
-        out = df.copy()
-        out[self.prediction_col] = cls.astype(np.float64)  # Spark-ML Double
-        return out
+        return cls.astype(np.float64)                      # Spark-ML Double

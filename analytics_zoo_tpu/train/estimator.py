@@ -386,6 +386,28 @@ class Estimator:
         with timeit("estimator/shard_batch"):
             return [jax.device_put(jnp.asarray(a), shard) for a in arrs]
 
+    def _maybe_midepoch_validation(self, validation_data, epoch: int):
+        """Iteration-granular validation: when a ``validation_trigger``
+        (e.g. SeveralIteration) fires between epoch boundaries, evaluate
+        now and record a history row (reference validates at arbitrary
+        trigger points inside the optimizer loop, Topology.scala:223-244).
+        Loss is not materialised here to avoid a per-step device sync."""
+        if validation_data is None or self._val_trigger is None:
+            return
+        tstate = TriggerState(epoch=epoch, iteration=self.global_step,
+                              epoch_finished=False)
+        if not self._val_trigger(tstate):
+            return
+        val = self.evaluate(validation_data[0], validation_data[1],
+                            batch_size=self._val_batch or 32)
+        rec = {"iteration": self.global_step}
+        rec.update({f"val_{k}": v for k, v in val.items()})
+        self.history.append(rec)
+        if self._tb_writer is not None:
+            for k, v in rec.items():
+                if k != "iteration":
+                    self._tb_writer.add_scalar(k, v, self.global_step)
+
     # ------------------------------------------------------------------
     # fit
     # ------------------------------------------------------------------
@@ -485,6 +507,8 @@ class Estimator:
                                      batch_x, batch_y)
                     self.global_step += K if kind == "K" else 1
                     losses.append(loss)
+                    self._maybe_midepoch_validation(validation_data,
+                                                    epoch + 1)
                 epoch += 1
                 self.finished_epochs = epoch
                 mean_loss = float(jnp.mean(jnp.concatenate(
@@ -614,6 +638,8 @@ class Estimator:
                     self.global_step += K if kind == "K" else 1
                     count += bn
                     losses.append(loss)
+                    self._maybe_midepoch_validation(validation_data,
+                                                    epoch + 1)
             except BaseException:
                 if hasattr(batches, "close"):
                     batches.close()

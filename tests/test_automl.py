@@ -1,0 +1,189 @@
+"""AutoML tests: feature transformer rolling/scaling round-trips, the
+in-process search engine, and an end-to-end TimeSequencePredictor run
+that must actually learn a synthetic series (reference
+pyzoo/test/zoo/automl/)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from analytics_zoo_tpu.automl import (Evaluator, GridRandomRecipe,
+                                      RandomRecipe, SearchEngine, SmokeRecipe,
+                                      TimeSequenceFeatureTransformer,
+                                      TimeSequencePredictor, load_ts_pipeline)
+from analytics_zoo_tpu.automl.search import (Choice, GridSearch, RandInt,
+                                             Uniform, expand_grid,
+                                             sample_config)
+
+
+def _series_df(n=200, freq="h", seed=0):
+    rs = np.random.RandomState(seed)
+    dt = pd.date_range("2019-01-01", periods=n, freq=freq)
+    t = np.arange(n)
+    value = (np.sin(2 * np.pi * t / 24.0) + 0.1 * rs.randn(n) + 2.0)
+    return pd.DataFrame({"datetime": dt, "value": value.astype(np.float32)})
+
+
+class TestEvaluator:
+    def test_metrics(self):
+        y = np.asarray([1.0, 2.0, 3.0])
+        p = np.asarray([1.0, 2.0, 4.0])
+        assert Evaluator.evaluate("mse", y, p) == pytest.approx(1 / 3)
+        assert Evaluator.evaluate("mae", y, p) == pytest.approx(1 / 3)
+        assert Evaluator.evaluate("rmse", y, p) == pytest.approx(
+            np.sqrt(1 / 3))
+        assert Evaluator.evaluate("r_square", y, y) == pytest.approx(1.0)
+        assert Evaluator.get_metric_mode("r2") == "max"
+        assert Evaluator.get_metric_mode("mse") == "min"
+        with pytest.raises(ValueError, match="known"):
+            Evaluator.evaluate("nope", y, p)
+
+
+class TestFeatureTransformer:
+    def test_rolling_shapes(self):
+        df = _series_df(50)
+        ft = TimeSequenceFeatureTransformer(future_seq_len=1)
+        x, y = ft.fit_transform(df, past_seq_len=5,
+                                selected_features=ft.get_feature_list(df))
+        assert x.shape == (45, 5, 1 + 8)   # target + 8 calendar features
+        assert y.shape == (45, 1)
+
+    def test_rolling_values_align(self):
+        # y[i] must be the target right after x[i]'s window
+        df = _series_df(30)
+        ft = TimeSequenceFeatureTransformer(future_seq_len=2)
+        x, y = ft.fit_transform(df, past_seq_len=4, selected_features=[])
+        # un-scale and compare against the raw series
+        raw = df["value"].to_numpy()
+        y0 = ft._unscale_y(y[0])
+        np.testing.assert_allclose(y0, raw[4:6], rtol=1e-5)
+        x0 = ft._unscale_y(x[0][:, 0])
+        np.testing.assert_allclose(x0, raw[0:4], rtol=1e-5)
+
+    def test_scaling_bounds_and_transform_reuse(self):
+        df = _series_df(60)
+        ft = TimeSequenceFeatureTransformer()
+        x, y = ft.fit_transform(df, past_seq_len=3, selected_features=[])
+        assert x.min() >= 0.0 and x.max() <= 1.0
+        x2, y2 = ft.transform(df, is_train=True)
+        np.testing.assert_allclose(x, x2)
+
+    def test_test_mode_tail_windows(self):
+        df = _series_df(10)
+        ft = TimeSequenceFeatureTransformer()
+        ft.fit_transform(df, past_seq_len=4, selected_features=[])
+        xt, yt = ft.transform(df.iloc[:4], is_train=False)
+        assert xt.shape[0] == 1 and yt is None
+
+    def test_save_load_roundtrip(self, tmp_path):
+        df = _series_df(40)
+        ft = TimeSequenceFeatureTransformer(future_seq_len=1)
+        x, _ = ft.fit_transform(df, past_seq_len=3, selected_features=[])
+        p = str(tmp_path / "ft.json")
+        ft.save(p)
+        ft2 = TimeSequenceFeatureTransformer.load(p)
+        x2, _ = ft2.transform(df, is_train=True)
+        np.testing.assert_allclose(x, x2)
+
+    def test_too_short_series_raises(self):
+        df = _series_df(4)
+        ft = TimeSequenceFeatureTransformer(future_seq_len=2)
+        with pytest.raises(ValueError, match="too short"):
+            ft.fit_transform(df, past_seq_len=5, selected_features=[])
+
+
+class TestSearchEngine:
+    def test_grid_expansion(self):
+        space = {"a": GridSearch([1, 2]), "b": GridSearch([10, 20]), "c": 5}
+        grids = expand_grid(space)
+        assert len(grids) == 4
+        assert all(g["c"] == 5 for g in grids)
+
+    def test_sampling(self):
+        import random
+
+        rng = random.Random(0)
+        cfg = sample_config({"a": Choice([1, 2, 3]), "b": RandInt(0, 5),
+                             "c": Uniform(0.0, 1.0), "d": "fixed"}, rng)
+        assert cfg["a"] in (1, 2, 3)
+        assert 0 <= cfg["b"] <= 5
+        assert 0.0 <= cfg["c"] <= 1.0
+        assert cfg["d"] == "fixed"
+
+    def test_engine_minimizes(self):
+        space = {"x": GridSearch([0.0, 1.0, 2.0, 3.0])}
+        eng = SearchEngine(space, metric_mode="min", num_samples=1)
+        eng.run(lambda cfg: (cfg["x"] - 2.0) ** 2)
+        assert eng.best().config["x"] == 2.0
+
+    def test_engine_parallel_and_maximize(self):
+        space = {"x": GridSearch(list(range(8)))}
+        eng = SearchEngine(space, metric_mode="max", num_samples=1,
+                           max_parallel=4)
+        eng.run(lambda cfg: cfg["x"])
+        assert eng.best().config["x"] == 7
+        assert len(eng.results) == 8
+
+
+class TestTimeSequencePredictor:
+    def test_smoke_fit_predict_evaluate(self, zoo_ctx, tmp_path):
+        train = _series_df(180)
+        test = _series_df(60, seed=1)
+        tsp = TimeSequencePredictor(future_seq_len=1)
+        pipeline = tsp.fit(train, metric="mse", recipe=SmokeRecipe())
+        # prediction frame carries the datetime index + target column
+        pred = pipeline.predict(test)
+        assert list(pred.columns) == ["datetime", "value"]
+        assert len(pred) > 0
+        err = pipeline.evaluate(test, metric="rmse")
+        assert np.isfinite(err)
+        # save -> load -> identical predictions
+        d = str(tmp_path / "pipe")
+        pipeline.save(d)
+        loaded = load_ts_pipeline(d)
+        pred2 = loaded.predict(test)
+        np.testing.assert_allclose(pred["value"].to_numpy(),
+                                   pred2["value"].to_numpy(), rtol=1e-5)
+
+    def test_automl_actually_learns(self, zoo_ctx):
+        # a sine wave is learnable: best trial must beat the mean-predictor
+        train = _series_df(240)
+
+        class TinyRecipe(SmokeRecipe):
+            def search_space(self, feats):
+                s = super().search_space(feats)
+                s.update(past_seq_len=12, epochs=15, lstm_1_units=32,
+                         lstm_2_units=32, dropout=0.0)
+                return s
+
+        tsp = TimeSequencePredictor(future_seq_len=1)
+        pipeline = tsp.fit(train, metric="mse", recipe=TinyRecipe())
+        r2 = pipeline.evaluate(train, metric="r2")
+        assert r2 > 0.5, r2
+
+    def test_multi_step_forecast(self, zoo_ctx):
+        train = _series_df(150)
+        tsp = TimeSequencePredictor(future_seq_len=3)
+        pipeline = tsp.fit(train, metric="mse", recipe=SmokeRecipe())
+        pred = pipeline.predict(train.iloc[:20])
+        assert {"value_0", "value_1", "value_2"} <= set(pred.columns)
+
+    def test_bad_metric_raises(self):
+        with pytest.raises(ValueError):
+            TimeSequencePredictor().fit(_series_df(50), metric="nope")
+
+    def test_missing_column_raises(self):
+        df = _series_df(50).rename(columns={"value": "v"})
+        with pytest.raises(ValueError, match="value"):
+            TimeSequencePredictor().fit(df)
+
+    def test_extra_features_col(self, zoo_ctx):
+        df = _series_df(120)
+        df["promo"] = (np.arange(len(df)) % 7 == 0).astype(np.float32)
+        tsp = TimeSequencePredictor(future_seq_len=1,
+                                    extra_features_col=["promo"])
+        feats = TimeSequenceFeatureTransformer(
+            extra_features_col=["promo"]).get_feature_list(df)
+        assert "promo" in feats
+        pipeline = tsp.fit(df, recipe=SmokeRecipe())
+        assert np.isfinite(pipeline.evaluate(df))

@@ -246,6 +246,57 @@ class TestRankHingeMask:
 
 
 # ---------------------------------------------------------------------------
+# validation triggers
+# ---------------------------------------------------------------------------
+class TestValidationTrigger:
+    def test_midepoch_iteration_trigger(self, zoo_ctx):
+        from analytics_zoo_tpu.core.triggers import SeveralIteration
+
+        x, y = _toy_data(128)
+        est = Estimator(_toy_model(), loss="mse")
+        # 8 steps/epoch (batch 16); validate every 3 iterations mid-epoch
+        est.fit(x, y, batch_size=16, epochs=2, verbose=False,
+                validation_data=(x, y),
+                validation_trigger=SeveralIteration(3))
+        iter_rows = [h for h in est.history if "iteration" in h]
+        assert iter_rows, est.history
+        assert all("val_loss" in h for h in iter_rows)
+        # fires at iterations 3, 6, 9, 12, 15 over 16 steps
+        assert [h["iteration"] for h in iter_rows] == [3, 6, 9, 12, 15]
+
+    def test_validation_batch_size_honored(self, zoo_ctx):
+        x, y = _toy_data(64)
+        est = Estimator(_toy_model(), loss="mse")
+        hist = est.fit(x, y, batch_size=16, epochs=1, verbose=False,
+                       validation_data=(x, y), validation_batch_size=64)
+        assert any("val_loss" in h for h in hist)
+
+
+# ---------------------------------------------------------------------------
+# thread-local name scoping (parallel AutoML trials)
+# ---------------------------------------------------------------------------
+class TestThreadLocalNames:
+    def test_concurrent_builds_do_not_collide(self):
+        import concurrent.futures as cf
+
+        from analytics_zoo_tpu.nn import reset_name_scope
+
+        def build(_):
+            reset_name_scope()
+            m = Sequential()
+            m.add(Dense(4, input_shape=(3,)))
+            m.add(Dense(4))
+            m.add(Dense(4))
+            return [l.name for l in m.layers]
+
+        with cf.ThreadPoolExecutor(8) as pool:
+            results = list(pool.map(build, range(32)))
+        for names in results:
+            assert len(set(names)) == 3, names   # unique within a model
+        assert len({tuple(r) for r in results}) == 1  # deterministic
+
+
+# ---------------------------------------------------------------------------
 # profiling timers
 # ---------------------------------------------------------------------------
 class TestTimers:
