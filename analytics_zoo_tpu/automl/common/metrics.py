@@ -3,7 +3,7 @@
 
 from __future__ import annotations
 
-from typing import Callable, Dict, Sequence, Union
+from typing import Callable, Dict
 
 import numpy as np
 

@@ -11,7 +11,6 @@ predictions back into a datetime-indexed frame.
 from __future__ import annotations
 
 import json
-import os
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -114,6 +113,9 @@ class TimeSequenceFeatureTransformer:
         self.config = dict(config)
         selected = config.get("selected_features",
                               self.get_feature_list(input_df))
+        # persist the RESOLVED selection: save/load must rebuild the
+        # exact input width even when the recipe omitted the key
+        self.config["selected_features"] = list(selected)
         past = int(config.get("past_seq_len", 2))
         mat = self._feature_frame(input_df, selected)
         mat = self._fit_scale(mat)
@@ -127,13 +129,11 @@ class TimeSequenceFeatureTransformer:
                                    self.get_feature_list(input_df))
         past = int(self.config.get("past_seq_len", 2))
         mat = self._scale(self._feature_frame(input_df, selected))
-        if is_train or mat.shape[0] >= past + self.future_seq_len:
-            try:
-                return self._roll(mat, past, self.future_seq_len)
-            except ValueError:
-                if is_train:
-                    raise
-        # test mode, tail windows only (predict beyond the frame)
+        if is_train:
+            return self._roll(mat, past, self.future_seq_len)
+        # Test mode: EVERY window of length `past`, including the final
+        # one whose forecast lies beyond the frame — predict() must be
+        # able to forecast the actual future, not just in-frame steps.
         n = mat.shape[0] - past + 1
         if n <= 0:
             raise ValueError("series shorter than past_seq_len")

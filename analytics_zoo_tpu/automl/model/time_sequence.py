@@ -8,7 +8,7 @@ trainable contract the search engine scores.
 
 from __future__ import annotations
 
-from typing import Dict, Optional, Tuple
+from typing import Dict
 
 import numpy as np
 

@@ -58,8 +58,11 @@ def load_ts_pipeline(pipeline_dir: str) -> TimeSequencePipeline:
         os.path.join(pipeline_dir, "feature_transformer.json"))
     config = meta["config"]
     model = VanillaLSTM()
-    past = int(config.get("past_seq_len", 2))
-    n_feat = 1 + len(config.get("selected_features", []))
+    # the transformer's config holds the RESOLVED feature selection and
+    # window length (fit_transform persists them), so the model input
+    # width is reconstructed exactly
+    past = int(ft.config.get("past_seq_len", 2))
+    n_feat = 1 + len(ft.config["selected_features"])
     model.restore(os.path.join(pipeline_dir, "model.npz"),
                   (past, n_feat), meta["future_seq_len"], config)
     return TimeSequencePipeline(ft, model, config)

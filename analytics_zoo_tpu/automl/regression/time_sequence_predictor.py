@@ -10,7 +10,6 @@ from __future__ import annotations
 import logging
 from typing import Dict, Optional, Sequence
 
-import numpy as np
 import pandas as pd
 
 from analytics_zoo_tpu.automl.common.metrics import Evaluator

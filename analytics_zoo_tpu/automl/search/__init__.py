@@ -235,9 +235,18 @@ class SearchEngine:
     def run(self, trainable: Callable[[Dict[str, Any]], Any]
             ) -> List[TrialResult]:
         configs = self._configs()
+        fail_score = float("-inf") if self.metric_mode == "max" \
+            else float("inf")
 
         def one(cfg):
-            out = trainable(dict(cfg))
+            # a failing trial is recorded as worst-possible, not fatal —
+            # one bad sampled config must not lose the whole search
+            # (ray.tune's failed-trial tolerance)
+            try:
+                out = trainable(dict(cfg))
+            except Exception as e:
+                logger.warning("trial failed for config %s: %s", cfg, e)
+                return TrialResult(cfg, fail_score, {"error": str(e)})
             if isinstance(out, tuple):
                 score, extra = out
             else:
@@ -257,8 +266,13 @@ class SearchEngine:
     def best(self) -> TrialResult:
         if not self.results:
             raise RuntimeError("run() first")
+        ok = [r for r in self.results if "error" not in r.extra]
+        if not ok:
+            raise RuntimeError(
+                f"all {len(self.results)} trials failed; first error: "
+                f"{self.results[0].extra.get('error')}")
         key = (max if self.metric_mode == "max" else min)
-        return key(self.results, key=lambda r: r.metric)
+        return key(ok, key=lambda r: r.metric)
 
 
 __all__ = ["SearchEngine", "TrialResult", "Recipe", "SmokeRecipe",

@@ -115,6 +115,7 @@ class Estimator:
         self._ckpt_trigger: Trigger = EveryEpoch()
         self._val_trigger: Optional[Trigger] = None
         self._val_batch: Optional[int] = None
+        self._last_val_iter = -1
         self._tb_writer = None
         self._rng = jax.random.PRNGKey(self.ctx.config.seed)
 
@@ -386,7 +387,8 @@ class Estimator:
         with timeit("estimator/shard_batch"):
             return [jax.device_put(jnp.asarray(a), shard) for a in arrs]
 
-    def _maybe_midepoch_validation(self, validation_data, epoch: int):
+    def _maybe_midepoch_validation(self, validation_data, epoch: int,
+                                   train_batch: int):
         """Iteration-granular validation: when a ``validation_trigger``
         (e.g. SeveralIteration) fires between epoch boundaries, evaluate
         now and record a history row (reference validates at arbitrary
@@ -398,8 +400,9 @@ class Estimator:
                               epoch_finished=False)
         if not self._val_trigger(tstate):
             return
+        self._last_val_iter = self.global_step
         val = self.evaluate(validation_data[0], validation_data[1],
-                            batch_size=self._val_batch or 32)
+                            batch_size=self._val_batch or train_batch)
         rec = {"iteration": self.global_step}
         rec.update({f"val_{k}": v for k, v in val.items()})
         self.history.append(rec)
@@ -459,6 +462,10 @@ class Estimator:
         fail_times: List[float] = []
         cfg = self.ctx.config
         K = max(1, int(cfg.steps_per_execution))
+        if K > 1 and self._val_trigger is not None:
+            logger.warning(
+                "steps_per_execution=%d: validation/trigger checks happen "
+                "every K-th iteration (K-step chunks are one dispatch)", K)
         if K > 1 and self._multi_step is None:
             self._build_multi_step()
         n_chunks = steps_per_epoch // K if K > 1 else 0
@@ -508,7 +515,7 @@ class Estimator:
                     self.global_step += K if kind == "K" else 1
                     losses.append(loss)
                     self._maybe_midepoch_validation(validation_data,
-                                                    epoch + 1)
+                                                    epoch + 1, eff_batch)
                 epoch += 1
                 self.finished_epochs = epoch
                 mean_loss = float(jnp.mean(jnp.concatenate(
@@ -520,7 +527,8 @@ class Estimator:
                                       epoch_finished=True, loss=mean_loss)
                 if validation_data is not None and (
                         self._val_trigger is None
-                        or self._val_trigger(tstate)):
+                        or (self._val_trigger(tstate)
+                            and self._last_val_iter != self.global_step)):
                     val = self.evaluate(validation_data[0], validation_data[1],
                                         batch_size=self._val_batch
                                         or eff_batch)
@@ -639,7 +647,7 @@ class Estimator:
                     count += bn
                     losses.append(loss)
                     self._maybe_midepoch_validation(validation_data,
-                                                    epoch + 1)
+                                                    epoch + 1, batch_size)
             except BaseException:
                 if hasattr(batches, "close"):
                     batches.close()
@@ -653,11 +661,11 @@ class Estimator:
             tstate = TriggerState(epoch=epoch + 1, iteration=self.global_step,
                                   epoch_finished=True, loss=mean_loss)
             if validation_data is not None and (
-                    getattr(self, "_val_trigger", None) is None
-                    or self._val_trigger(tstate)):
+                    self._val_trigger is None
+                    or (self._val_trigger(tstate)
+                        and self._last_val_iter != self.global_step)):
                 val = self.evaluate(validation_data[0], validation_data[1],
-                                    batch_size=getattr(self, "_val_batch",
-                                                       None) or batch_size)
+                                    batch_size=self._val_batch or batch_size)
                 rec.update({f"val_{k}": v for k, v in val.items()})
                 tstate.score = val.get(
                     self.metrics[0].name if self.metrics else "loss")
