@@ -421,3 +421,295 @@ class ImageSet:
 
         x, y = self.to_arrays()
         return FeatureSet.from_ndarrays(x, y, memory_type=memory_type)
+
+
+class ImageBytesToMat(ImagePreprocessing):
+    """Decode encoded image bytes (jpeg/png) into a BGR mat
+    (reference ImageBytesToMat.scala)."""
+
+    def __init__(self, byte_key: str = "bytes"):
+        self.byte_key = byte_key
+
+    def apply(self, feat, rng):
+        buf = np.frombuffer(feat[self.byte_key], np.uint8)
+        feat.image = cv2.imdecode(buf, cv2.IMREAD_COLOR)
+        if feat.image is None:
+            raise ValueError("undecodable image bytes")
+        return feat
+
+
+class ImagePixelBytesToMat(ImagePreprocessing):
+    """Raw pixel bytes (H*W*C uint8) -> mat (reference
+    ImagePixelBytesToMat.scala); shape from feature keys or kwargs."""
+
+    def __init__(self, byte_key: str = "bytes"):
+        self.byte_key = byte_key
+
+    def apply(self, feat, rng):
+        h, w = int(feat["height"]), int(feat["width"])
+        c = int(feat.get("nChannels", 3))
+        arr = np.frombuffer(feat[self.byte_key], np.uint8)
+        feat.image = arr.reshape(h, w, c).copy()
+        return feat
+
+
+class ImageMatToFloats(ImagePreprocessing):
+    """Mat -> float32 HWC array under key "floats" (reference
+    ImageMatToFloats.scala)."""
+
+    def apply(self, feat, rng):
+        img = np.asarray(feat.image, np.float32)
+        if img.ndim == 2:
+            img = img[..., None]
+        feat["floats"] = img
+        return feat
+
+
+class ImageFeatureToTensor(ImagePreprocessing):
+    """Finalize feature -> training tensor (reference
+    ImageFeatureToTensor.scala); same contract as ImageSetToSample."""
+
+    def apply(self, feat, rng):
+        return ImageSetToSample().apply(feat, rng)
+
+
+class ImageFiller(ImagePreprocessing):
+    """Fill a (normalized-coordinate) region with a constant value
+    (reference ImageFiller.scala — occlusion augmentation)."""
+
+    def __init__(self, start_x: float, start_y: float, end_x: float,
+                 end_y: float, value: float = 255.0):
+        self.box = (start_x, start_y, end_x, end_y)
+        self.value = value
+
+    def apply(self, feat, rng):
+        img = feat.image
+        h, w = img.shape[:2]
+        x1, y1, x2, y2 = self.box
+        img = img.copy()
+        img[int(y1 * h):int(y2 * h), int(x1 * w):int(x2 * w)] = self.value
+        feat.image = img
+        return feat
+
+
+class ImageFixedCrop(ImagePreprocessing):
+    """Crop a fixed box; coords normalized (0..1) or absolute pixels
+    (reference ImageFixedCrop.scala)."""
+
+    def __init__(self, x1: float, y1: float, x2: float, y2: float,
+                 normalized: bool = True):
+        self.box = (x1, y1, x2, y2)
+        self.normalized = normalized
+
+    def apply(self, feat, rng):
+        img = feat.image
+        h, w = img.shape[:2]
+        x1, y1, x2, y2 = self.box
+        if self.normalized:
+            x1, x2 = x1 * w, x2 * w
+            y1, y2 = y1 * h, y2 * h
+        feat.image = img[int(y1):int(y2), int(x1):int(x2)].copy()
+        feat["crop"] = (int(x1), int(y1), int(x2), int(y2))
+        return feat
+
+
+class ImageMirror(ImageHFlip):
+    """Horizontal mirror (reference ImageMirror.scala — same op as
+    HFlip)."""
+
+
+class ImageChannelScaledNormalizer(ImagePreprocessing):
+    """(x - channel_mean) * scale (reference
+    ImageChannelScaledNormalizer.scala)."""
+
+    def __init__(self, mean_r: float, mean_g: float, mean_b: float,
+                 scale: float = 1.0):
+        self.mean = np.asarray([mean_b, mean_g, mean_r], np.float32)  # BGR
+        self.scale = scale
+
+    def apply(self, feat, rng):
+        feat.image = (feat.image.astype(np.float32) - self.mean) * self.scale
+        return feat
+
+
+class ImageRandomPreprocessing(ImagePreprocessing):
+    """Apply an inner preprocessing with probability ``prob``
+    (reference ImageRandomPreprocessing.scala)."""
+
+    def __init__(self, preprocessing: ImagePreprocessing, prob: float = 0.5):
+        self.inner = preprocessing
+        self.prob = prob
+
+    def apply(self, feat, rng):
+        if rng.rand() < self.prob:
+            return self.inner.apply(feat, rng)
+        return feat
+
+
+class ImageRandomResize(ImagePreprocessing):
+    """Resize to a random square size in [min_size, max_size]
+    (reference ImageRandomResize.scala)."""
+
+    def __init__(self, min_size: int, max_size: int):
+        self.min_size, self.max_size = min_size, max_size
+
+    def apply(self, feat, rng):
+        s = int(rng.randint(self.min_size, self.max_size + 1))
+        feat.image = cv2.resize(feat.image, (s, s))
+        return feat
+
+
+class ImageRandomCropper(ImagePreprocessing):
+    """Random crop to fixed (crop_w, crop_h) with optional mirroring
+    (reference ImageRandomCropper.scala)."""
+
+    def __init__(self, crop_width: int, crop_height: int,
+                 mirror: bool = True):
+        self.cw, self.ch = crop_width, crop_height
+        self.mirror = mirror
+
+    def apply(self, feat, rng):
+        img = feat.image
+        h, w = img.shape[:2]
+        if h < self.ch or w < self.cw:
+            img = cv2.resize(img, (max(w, self.cw), max(h, self.ch)))
+            h, w = img.shape[:2]
+        top = rng.randint(0, h - self.ch + 1)
+        left = rng.randint(0, w - self.cw + 1)
+        img = img[top:top + self.ch, left:left + self.cw]
+        if self.mirror and rng.rand() < 0.5:
+            img = img[:, ::-1]
+        feat.image = np.ascontiguousarray(img)
+        return feat
+
+
+# ---------------------------------------------------------------------------
+# ROI-aware ops: transforms that keep ground-truth boxes consistent with
+# the image (reference feature/image/roi/ + RoiTransformer.scala wrapping
+# BigDL RoiNormalize/RoiHFlip/RoiResize).  Boxes live in
+# feat["bboxes"]: (N, 4) [x1, y1, x2, y2] pixels unless noted.
+# ---------------------------------------------------------------------------
+
+class RoiNormalize(ImagePreprocessing):
+    """Pixel boxes -> normalized [0, 1] coords (reference RoiNormalize)."""
+
+    def apply(self, feat, rng):
+        if "bboxes" in feat:
+            h, w = feat.image.shape[:2]
+            b = np.asarray(feat["bboxes"], np.float32).copy()
+            b[:, [0, 2]] /= w
+            b[:, [1, 3]] /= h
+            feat["bboxes"] = b
+            feat["bboxes_normalized"] = True
+        return feat
+
+
+class RoiHFlip(ImagePreprocessing):
+    """Flip image AND boxes horizontally (reference RoiHFlip)."""
+
+    def __init__(self, normalized: bool = True):
+        self.normalized = normalized
+
+    def apply(self, feat, rng):
+        feat.image = feat.image[:, ::-1].copy()
+        if "bboxes" in feat:
+            b = np.asarray(feat["bboxes"], np.float32).copy()
+            width = 1.0 if self.normalized else feat.image.shape[1]
+            b[:, [0, 2]] = width - b[:, [2, 0]]
+            feat["bboxes"] = b
+        return feat
+
+
+class RoiResize(ImagePreprocessing):
+    """Resize image; scale pixel boxes accordingly (reference RoiResize)."""
+
+    def __init__(self, resize_h: int, resize_w: int):
+        self.h, self.w = resize_h, resize_w
+
+    def apply(self, feat, rng):
+        h0, w0 = feat.image.shape[:2]
+        feat.image = cv2.resize(feat.image, (self.w, self.h))
+        if "bboxes" in feat and not feat.get("bboxes_normalized"):
+            b = np.asarray(feat["bboxes"], np.float32).copy()
+            b[:, [0, 2]] *= self.w / w0
+            b[:, [1, 3]] *= self.h / h0
+            feat["bboxes"] = b
+        return feat
+
+
+class RandomSampler(ImagePreprocessing):
+    """SSD-style random IoU-constrained crop sampler (reference
+    RandomSampler.scala / BigDL BatchSampler): pick a random crop whose
+    IoU with some ground-truth box meets a sampled threshold; keep boxes
+    whose centers fall inside, clipped and shifted."""
+
+    def __init__(self, min_scale: float = 0.3,
+                 min_ious=(0.1, 0.3, 0.5, 0.7, 0.9), max_trials: int = 25):
+        self.min_scale = min_scale
+        self.min_ious = list(min_ious) + [None]   # None = no constraint
+        self.max_trials = max_trials
+
+    @staticmethod
+    def _iou(boxes, crop):
+        x1 = np.maximum(boxes[:, 0], crop[0])
+        y1 = np.maximum(boxes[:, 1], crop[1])
+        x2 = np.minimum(boxes[:, 2], crop[2])
+        y2 = np.minimum(boxes[:, 3], crop[3])
+        inter = np.clip(x2 - x1, 0, None) * np.clip(y2 - y1, 0, None)
+        area_b = (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
+        area_c = (crop[2] - crop[0]) * (crop[3] - crop[1])
+        return inter / np.maximum(area_b + area_c - inter, 1e-9)
+
+    def apply(self, feat, rng):
+        if "bboxes" not in feat or len(feat["bboxes"]) == 0:
+            return feat
+        img = feat.image
+        h, w = img.shape[:2]
+        boxes = np.asarray(feat["bboxes"], np.float32)
+        labels = np.asarray(feat.get("label", np.zeros(len(boxes))))
+        min_iou = self.min_ious[rng.randint(len(self.min_ious))]
+        if min_iou is None:
+            return feat
+        for _ in range(self.max_trials):
+            cw = rng.uniform(self.min_scale, 1.0) * w
+            chh = rng.uniform(self.min_scale, 1.0) * h
+            if not 0.5 <= cw / chh <= 2.0:
+                continue
+            left = rng.uniform(0, w - cw)
+            top = rng.uniform(0, h - chh)
+            # integer crop box so the cropped image and the shifted boxes
+            # share the exact same coordinate frame
+            crop = np.array([int(left), int(top), int(left + cw),
+                             int(top + chh)], np.float32)
+            if self._iou(boxes, crop).max() < min_iou:
+                continue
+            cx = (boxes[:, 0] + boxes[:, 2]) / 2
+            cy = (boxes[:, 1] + boxes[:, 3]) / 2
+            keep = ((cx >= crop[0]) & (cx <= crop[2])
+                    & (cy >= crop[1]) & (cy <= crop[3]))
+            if not keep.any():
+                continue
+            kept = boxes[keep].copy()
+            kept[:, [0, 2]] = np.clip(kept[:, [0, 2]], crop[0], crop[2]) \
+                - crop[0]
+            kept[:, [1, 3]] = np.clip(kept[:, [1, 3]], crop[1], crop[3]) \
+                - crop[1]
+            feat.image = img[int(crop[1]):int(crop[3]),
+                             int(crop[0]):int(crop[2])].copy()
+            feat["bboxes"] = kept
+            feat["label"] = labels[keep]
+            return feat
+        return feat
+
+
+class RowToImageFeature(ImagePreprocessing):
+    """nnframes image-schema row (origin/height/width/nChannels/mode/data)
+    -> ImageFeature (reference RowToImageFeature.scala)."""
+
+    def apply(self, feat, rng):
+        return feat          # already an ImageFeature
+
+    @staticmethod
+    def from_row(row) -> ImageFeature:
+        return ImageFeature(image=np.asarray(row["data"]),
+                            path=row.get("origin", ""))

@@ -143,3 +143,23 @@ class SpatialDropout2D(SpatialDropoutND):
 
 class SpatialDropout3D(SpatialDropoutND):
     spatial = 3
+
+
+class RReLU(StatelessLayer):
+    """Randomized leaky ReLU (reference BigDL RReLU via keras layer
+    surface): negative slope ~ U[lower, upper] per element in training,
+    the fixed mean slope at inference."""
+
+    def __init__(self, lower: float = 1.0 / 8, upper: float = 1.0 / 3,
+                 **kw):
+        super().__init__(**kw)
+        self.lower = lower
+        self.upper = upper
+
+    def forward(self, params, x, training=False, rng=None):
+        if training and rng is not None:
+            a = jax.random.uniform(rng, x.shape, x.dtype,
+                                   self.lower, self.upper)
+        else:
+            a = (self.lower + self.upper) / 2.0
+        return jnp.where(x >= 0, x, a * x)

@@ -493,3 +493,52 @@ class UpSampling3D(UpSamplingND):
 Conv1D = Convolution1D
 Conv2D = Convolution2D
 Conv3D = Convolution3D
+
+
+class ResizeBilinear(StatelessLayer):
+    """Bilinear spatial resize (reference api/keras/layers/
+    ResizeBilinear.scala wrapping BigDL ResizeBilinear).
+
+    ``align_corners=True`` maps corner pixels exactly (the BigDL/TF-v1
+    convention); ``False`` uses the half-pixel convention of
+    ``jax.image.resize``.
+    """
+
+    def __init__(self, output_height: int, output_width: int,
+                 align_corners: bool = False, dim_ordering: str = "tf",
+                 **kw):
+        super().__init__(**kw)
+        self.output_height = output_height
+        self.output_width = output_width
+        self.align_corners = align_corners
+        self.dim_ordering = dim_ordering
+
+    def forward(self, params, x, training=False, rng=None):
+        x = _to_channels_last(x, self.dim_ordering, 2)
+        oh, ow = self.output_height, self.output_width
+        if not self.align_corners:
+            y = jax.image.resize(x, (x.shape[0], oh, ow, x.shape[3]),
+                                 method="bilinear")
+        else:
+            ih, iw = x.shape[1], x.shape[2]
+            ys = jnp.linspace(0.0, ih - 1.0, oh)
+            xs = jnp.linspace(0.0, iw - 1.0, ow)
+            y0 = jnp.clip(jnp.floor(ys).astype(jnp.int32), 0, ih - 1)
+            x0 = jnp.clip(jnp.floor(xs).astype(jnp.int32), 0, iw - 1)
+            y1 = jnp.minimum(y0 + 1, ih - 1)
+            x1 = jnp.minimum(x0 + 1, iw - 1)
+            wy = (ys - y0).reshape(1, oh, 1, 1)
+            wx = (xs - x0).reshape(1, 1, ow, 1)
+            g = lambda yy, xx: x[:, yy][:, :, xx]
+            y = ((1 - wy) * (1 - wx) * g(y0, x0)
+                 + (1 - wy) * wx * g(y0, x1)
+                 + wy * (1 - wx) * g(y1, x0)
+                 + wy * wx * g(y1, x1))
+        return _from_channels_last(y, self.dim_ordering, 2)
+
+
+class ShareConvolution2D(Convolution2D):
+    """API-parity alias for the reference's ShareConvolution2D
+    (ShareConvolution.scala shares workspace buffers across JVM threads —
+    a memory trick with no TPU analogue: XLA owns buffer reuse, and conv
+    weights are a single HBM allocation under jit already)."""

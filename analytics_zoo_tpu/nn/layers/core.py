@@ -165,3 +165,52 @@ class InputLayer(StatelessLayer):
 
     def forward(self, params, x, training=False, rng=None):
         return x
+
+
+class MaxoutDense(StatelessLayer):
+    """Maxout over ``nb_feature`` linear pieces
+    (reference api/keras/layers/MaxoutDense.scala):
+    y_j = max_k (x W_k + b_k)_j.
+
+    One (in, nb_feature*out) matmul feeds the MXU; the max is a cheap
+    fused reduce."""
+
+    def __init__(self, output_dim: int, nb_feature: int = 4,
+                 init="glorot_uniform", bias: bool = True, **kw):
+        super().__init__(**kw)
+        self.output_dim = output_dim
+        self.nb_feature = nb_feature
+        self.initializer = initializers.get(init)
+        self.use_bias = bias
+
+    def build_params(self, rng, input_shape):
+        d = input_shape[-1]
+        params = {"kernel": self.initializer(
+            rng, (d, self.nb_feature * self.output_dim), jnp.float32)}
+        if self.use_bias:
+            params["bias"] = jnp.zeros(
+                (self.nb_feature * self.output_dim,), jnp.float32)
+        return params
+
+    def forward(self, params, x, training=False, rng=None):
+        y = x @ params["kernel"]
+        if self.use_bias:
+            y = y + params["bias"]
+        y = y.reshape(y.shape[:-1] + (self.nb_feature, self.output_dim))
+        return jnp.max(y, axis=-2)
+
+
+class GaussianSampler(StatelessLayer):
+    """Reparameterised gaussian sampling for VAEs
+    (reference api/keras/layers/GaussianSampler.scala):
+    inputs (mean, log_var) -> mean + exp(log_var/2) * eps."""
+
+    def call(self, params, state, mean, log_var=None, training=False,
+             rng=None):
+        if log_var is None:   # single stacked input [mean, log_var]
+            mean, log_var = mean
+        if not training or rng is None:
+            # deterministic eval: the distribution mean
+            return mean, state
+        eps = jax.random.normal(rng, mean.shape, mean.dtype)
+        return mean + jnp.exp(log_var * 0.5) * eps, state

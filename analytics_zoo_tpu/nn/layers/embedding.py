@@ -17,7 +17,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from analytics_zoo_tpu.nn import initializers
+from analytics_zoo_tpu.nn import activations, initializers
 from analytics_zoo_tpu.nn.module import StatelessLayer
 
 
@@ -93,3 +93,89 @@ class WordEmbedding(Embedding):
             if word in vectors:
                 table[idx] = vectors[word]
         return WordEmbedding(n, dim, weights=table, trainable=trainable, **kw)
+
+
+class SparseEmbedding(StatelessLayer):
+    """Embedding over sparse multi-hot id rows
+    (reference api/keras/layers/SparseEmbedding.scala — embeddings for
+    SparseTensor input).
+
+    TPU layout decision (SURVEY §7 risk #2): sparse ids are densified
+    host-side to a fixed-width ``(B, max_nnz)`` int array padded with
+    ``pad_id`` (default 0 — row 0 of the table is reserved/zeroed), and
+    the lookup is a dense gather + masked combine — gathers are the
+    MXU/HBM-friendly realisation of sparsity on TPU (no SparseCore
+    dependency, shapes static for XLA).
+    """
+
+    def __init__(self, input_dim: int, output_dim: int,
+                 combiner: str = "sum", init="uniform", pad_id: int = 0,
+                 **kw):
+        super().__init__(**kw)
+        if combiner not in ("sum", "mean", "sqrtn"):
+            raise ValueError(f"combiner must be sum|mean|sqrtn, got "
+                             f"{combiner!r}")
+        self.input_dim = input_dim
+        self.output_dim = output_dim
+        self.combiner = combiner
+        self.initializer = initializers.get(init)
+        self.pad_id = pad_id
+
+    def build_params(self, rng, input_shape):
+        table = self.initializer(
+            rng, (self.input_dim, self.output_dim), jnp.float32)
+        table = table.at[self.pad_id].set(0.0)
+        return {"table": table}
+
+    def forward(self, params, x, training=False, rng=None):
+        ids = x.astype(jnp.int32)                     # (B, max_nnz)
+        mask = (ids != self.pad_id).astype(jnp.float32)[..., None]
+        emb = jnp.take(params["table"], ids, axis=0) * mask
+        out = jnp.sum(emb, axis=-2)
+        if self.combiner != "sum":
+            n = jnp.maximum(jnp.sum(mask, axis=-2), 1.0)
+            out = out / (n if self.combiner == "mean" else jnp.sqrt(n))
+        return out
+
+
+class SparseDense(StatelessLayer):
+    """Dense layer over sparse multi-hot inputs
+    (reference api/keras/layers/SparseDense.scala: y = act(sparse_x W + b)).
+
+    Input is ``(B, max_nnz)`` feature INDICES (padded with ``pad_id``),
+    optionally paired with ``(B, max_nnz)`` float values for weighted
+    multi-hot rows.  Realised as a gather of W's rows + segment sum —
+    mathematically sparse W.T x, physically one dense gather (TPU-native
+    sparsity, no scatter, static shapes).
+    """
+
+    def __init__(self, output_dim: int, input_dim: int,
+                 activation=None, init="glorot_uniform", bias: bool = True,
+                 pad_id: int = 0, **kw):
+        super().__init__(**kw)
+        self.output_dim = output_dim
+        self.input_dim = input_dim
+        self.activation = activations.get(activation)
+        self.initializer = initializers.get(init)
+        self.use_bias = bias
+        self.pad_id = pad_id
+
+    def build_params(self, rng, *input_shapes):
+        params = {"kernel": self.initializer(
+            rng, (self.input_dim, self.output_dim), jnp.float32)}
+        if self.use_bias:
+            params["bias"] = jnp.zeros((self.output_dim,), jnp.float32)
+        return params
+
+    def call(self, params, state, indices, values=None, training=False,
+             rng=None):
+        ids = indices.astype(jnp.int32)
+        mask = (ids != self.pad_id).astype(jnp.float32)
+        w = jnp.take(params["kernel"], ids, axis=0)   # (B, nnz, out)
+        coeff = mask if values is None else mask * values
+        y = jnp.sum(w * coeff[..., None], axis=-2)
+        if self.use_bias:
+            y = y + params["bias"]
+        if self.activation is not None:
+            y = self.activation(y)
+        return y, state

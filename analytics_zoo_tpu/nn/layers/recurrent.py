@@ -234,3 +234,106 @@ class TimeDistributed(Layer):
         flat = x.reshape((b * t,) + x.shape[2:])
         y, ns = self.inner.call(params, state, flat, training=training, rng=rng)
         return y.reshape((b, t) + y.shape[1:]), ns
+
+
+class ConvLSTMND(StatelessLayer):
+    """Convolutional LSTM over (B, T, spatial..., C) channels-last
+    (reference api/keras/layers/ConvLSTM2D.scala / ConvLSTM3D.scala).
+
+    TPU-first: the input-side convolution for ALL timesteps is hoisted out
+    of the scan as one batched conv over (B*T, ...) — only the recurrent
+    conv on the carry lives inside the ``lax.scan`` loop, mirroring the
+    hoisted input projection of the dense RNNs above.  Gate order (i, f,
+    c, o); SAME padding keeps the spatial shape step-invariant (the
+    reference likewise pads to preserve shape).
+    """
+
+    spatial = 2
+
+    def __init__(self, nb_filter: int, kernel_size, activation="tanh",
+                 inner_activation="hard_sigmoid", return_sequences=False,
+                 go_backwards=False, border_mode: str = "same",
+                 subsample=1, init="glorot_uniform",
+                 inner_init="orthogonal", **kw):
+        super().__init__(**kw)
+        if border_mode != "same":
+            raise ValueError("ConvLSTM requires border_mode='same' (the "
+                             "carry must keep a step-invariant shape)")
+        self.nb_filter = nb_filter
+        ks = (kernel_size,) * self.spatial if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        self.kernel_size = ks
+        self.strides = (subsample,) * self.spatial \
+            if isinstance(subsample, int) else tuple(subsample)
+        self.activation = activations.get(activation)
+        self.inner_activation = activations.get(inner_activation)
+        self.return_sequences = return_sequences
+        self.go_backwards = go_backwards
+        self.initializer = initializers.get(init)
+        self.inner_initializer = initializers.get(inner_init)
+
+    def _dn(self):
+        if self.spatial == 2:
+            return ("NHWC", "HWIO", "NHWC")
+        return ("NDHWC", "DHWIO", "NDHWC")
+
+    def build_params(self, rng, input_shape):
+        cin = input_shape[-1]
+        f = self.nb_filter
+        k1, k2 = jax.random.split(rng)
+        bias = jnp.zeros((4 * f,), jnp.float32)
+        bias = bias.at[f:2 * f].set(1.0)      # unit forget gate
+        return {
+            "kernel": self.initializer(
+                k1, self.kernel_size + (cin, 4 * f), jnp.float32),
+            "recurrent": self.inner_initializer(
+                k2, self.kernel_size + (f, 4 * f), jnp.float32),
+            "bias": bias,
+        }
+
+    def forward(self, params, x, training=False, rng=None):
+        b, t = x.shape[0], x.shape[1]
+        f = self.nb_filter
+        if self.go_backwards:
+            x = jnp.flip(x, axis=1)
+        dn = jax.lax.conv_dimension_numbers(
+            x.shape[1:], params["kernel"].shape, self._dn())
+        # hoisted input conv for all timesteps: (B*T, spatial..., 4F)
+        zx = jax.lax.conv_general_dilated(
+            x.reshape((b * t,) + x.shape[2:]), params["kernel"],
+            window_strides=self.strides, padding="SAME",
+            dimension_numbers=dn) + params["bias"]
+        zx = zx.reshape((b, t) + zx.shape[1:]).swapaxes(0, 1)  # (T, B, ...)
+        spatial_shape = zx.shape[2:-1]
+        h0 = jnp.zeros((b,) + spatial_shape + (f,), jnp.float32)
+        rec_dn = jax.lax.conv_dimension_numbers(
+            h0.shape, params["recurrent"].shape, self._dn())
+
+        def step(carry, z):
+            h_prev, c_prev = carry
+            z = z + jax.lax.conv_general_dilated(
+                h_prev, params["recurrent"],
+                window_strides=(1,) * self.spatial, padding="SAME",
+                dimension_numbers=rec_dn)
+            i = self.inner_activation(z[..., :f])
+            fg = self.inner_activation(z[..., f:2 * f])
+            g = self.activation(z[..., 2 * f:3 * f])
+            o = self.inner_activation(z[..., 3 * f:])
+            c = fg * c_prev + i * g
+            h = o * self.activation(c)
+            return (h, c), h
+
+        (h_last, _), ys = jax.lax.scan(step, (h0, h0), zx)
+        return ys.swapaxes(0, 1) if self.return_sequences else h_last
+
+
+class ConvLSTM2D(ConvLSTMND):
+    """Reference ConvLSTM2D.scala — input (B, T, H, W, C)."""
+
+    spatial = 2
+
+
+class ConvLSTM3D(ConvLSTMND):
+    """Reference ConvLSTM3D.scala — input (B, T, D, H, W, C)."""
+
+    spatial = 3

@@ -116,6 +116,7 @@ class Estimator:
         self._val_trigger: Optional[Trigger] = None
         self._val_batch: Optional[int] = None
         self._last_val_iter = -1
+        self._last_val_result: Optional[Dict[str, float]] = None
         self._tb_writer = None
         self._rng = jax.random.PRNGKey(self.ctx.config.seed)
 
@@ -403,6 +404,7 @@ class Estimator:
         self._last_val_iter = self.global_step
         val = self.evaluate(validation_data[0], validation_data[1],
                             batch_size=self._val_batch or train_batch)
+        self._last_val_result = val
         rec = {"iteration": self.global_step}
         rec.update({f"val_{k}": v for k, v in val.items()})
         self.history.append(rec)
@@ -527,11 +529,16 @@ class Estimator:
                                       epoch_finished=True, loss=mean_loss)
                 if validation_data is not None and (
                         self._val_trigger is None
-                        or (self._val_trigger(tstate)
-                            and self._last_val_iter != self.global_step)):
-                    val = self.evaluate(validation_data[0], validation_data[1],
-                                        batch_size=self._val_batch
-                                        or eff_batch)
+                        or self._val_trigger(tstate)):
+                    # reuse a mid-epoch eval that just ran on this exact
+                    # step instead of evaluating twice
+                    if self._last_val_iter == self.global_step:
+                        val = self._last_val_result
+                    else:
+                        val = self.evaluate(validation_data[0],
+                                            validation_data[1],
+                                            batch_size=self._val_batch
+                                            or eff_batch)
                     rec.update({f"val_{k}": v for k, v in val.items()})
                     tstate.score = val.get(
                         self.metrics[0].name if self.metrics else "loss")
@@ -662,10 +669,14 @@ class Estimator:
                                   epoch_finished=True, loss=mean_loss)
             if validation_data is not None and (
                     self._val_trigger is None
-                    or (self._val_trigger(tstate)
-                        and self._last_val_iter != self.global_step)):
-                val = self.evaluate(validation_data[0], validation_data[1],
-                                    batch_size=self._val_batch or batch_size)
+                    or self._val_trigger(tstate)):
+                if self._last_val_iter == self.global_step:
+                    val = self._last_val_result
+                else:
+                    val = self.evaluate(validation_data[0],
+                                        validation_data[1],
+                                        batch_size=self._val_batch
+                                        or batch_size)
                 rec.update({f"val_{k}": v for k, v in val.items()})
                 tstate.score = val.get(
                     self.metrics[0].name if self.metrics else "loss")

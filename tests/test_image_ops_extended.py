@@ -1,0 +1,196 @@
+"""Tests for the extended image preprocessor set (VERDICT: ~15 missing
+ops: bytes decode, fillers, ROI family, random sampler, 3D warp)."""
+
+import numpy as np
+import pytest
+
+cv2 = pytest.importorskip("cv2")
+
+from analytics_zoo_tpu.data.image import (ImageBytesToMat,
+                                          ImageChannelScaledNormalizer,
+                                          ImageFeature, ImageFeatureToTensor,
+                                          ImageFiller, ImageFixedCrop,
+                                          ImageMatToFloats, ImageMirror,
+                                          ImagePixelBytesToMat,
+                                          ImageRandomCropper,
+                                          ImageRandomPreprocessing,
+                                          ImageRandomResize, ImageResize,
+                                          RandomSampler, RoiHFlip,
+                                          RoiNormalize, RoiResize,
+                                          RowToImageFeature)
+from analytics_zoo_tpu.data.image3d import Warp3D
+
+
+def _feat(h=8, w=10, c=3, seed=0):
+    rs = np.random.RandomState(seed)
+    return ImageFeature(image=rs.randint(0, 255, (h, w, c)).astype(np.uint8))
+
+
+def _rng(seed=0):
+    return np.random.RandomState(seed)
+
+
+class TestDecodeOps:
+    def test_bytes_to_mat(self):
+        img = np.full((6, 7, 3), 128, np.uint8)
+        ok, buf = cv2.imencode(".png", img)
+        feat = ImageFeature(bytes=buf.tobytes())
+        out = ImageBytesToMat().apply(feat, _rng())
+        np.testing.assert_array_equal(out.image, img)
+
+    def test_bytes_to_mat_bad_bytes(self):
+        with pytest.raises(ValueError, match="undecodable"):
+            ImageBytesToMat().apply(ImageFeature(bytes=b"nope"), _rng())
+
+    def test_pixel_bytes_to_mat(self):
+        img = np.arange(6 * 4 * 3, dtype=np.uint8).reshape(6, 4, 3)
+        feat = ImageFeature(bytes=img.tobytes(), height=6, width=4,
+                            nChannels=3)
+        out = ImagePixelBytesToMat().apply(feat, _rng())
+        np.testing.assert_array_equal(out.image, img)
+
+    def test_mat_to_floats_and_tensor(self):
+        feat = _feat()
+        out = ImageMatToFloats().apply(feat, _rng())
+        assert out["floats"].dtype == np.float32
+        out = ImageFeatureToTensor().apply(feat, _rng())
+        assert out["sample"].dtype == np.float32
+
+
+class TestGeometricOps:
+    def test_filler(self):
+        feat = _feat()
+        out = ImageFiller(0.0, 0.0, 0.5, 0.5, value=7).apply(feat, _rng())
+        assert (out.image[:4, :5] == 7).all()
+        assert not (out.image[5:, 6:] == 7).all()
+
+    def test_fixed_crop_normalized_and_absolute(self):
+        feat = _feat(10, 10)
+        out = ImageFixedCrop(0.2, 0.2, 0.8, 0.8).apply(feat, _rng())
+        assert out.image.shape == (6, 6, 3)
+        feat = _feat(10, 10)
+        out = ImageFixedCrop(1, 2, 7, 9, normalized=False).apply(
+            feat, _rng())
+        assert out.image.shape == (7, 6, 3)
+
+    def test_mirror(self):
+        feat = _feat()
+        orig = feat.image.copy()
+        out = ImageMirror().apply(feat, _rng())
+        np.testing.assert_array_equal(out.image, orig[:, ::-1])
+
+    def test_channel_scaled_normalizer(self):
+        feat = _feat()
+        orig = feat.image.astype(np.float32)
+        out = ImageChannelScaledNormalizer(10, 20, 30, scale=0.5).apply(
+            feat, _rng())
+        np.testing.assert_allclose(
+            out.image, (orig - np.array([30, 20, 10])) * 0.5, rtol=1e-6)
+
+    def test_random_preprocessing_prob(self):
+        always = ImageRandomPreprocessing(ImageMirror(), prob=1.0)
+        never = ImageRandomPreprocessing(ImageMirror(), prob=0.0)
+        feat = _feat()
+        orig = feat.image.copy()
+        out = never.apply(feat, _rng())
+        np.testing.assert_array_equal(out.image, orig)
+        out = always.apply(feat, _rng())
+        np.testing.assert_array_equal(out.image, orig[:, ::-1])
+
+    def test_random_resize_bounds(self):
+        out = ImageRandomResize(5, 9).apply(_feat(), _rng())
+        s = out.image.shape
+        assert 5 <= s[0] <= 9 and s[0] == s[1]
+
+    def test_random_cropper(self):
+        out = ImageRandomCropper(4, 5, mirror=True).apply(_feat(), _rng())
+        assert out.image.shape == (5, 4, 3)
+        # upscales when the source is smaller than the crop
+        out = ImageRandomCropper(16, 16).apply(_feat(4, 4), _rng())
+        assert out.image.shape == (16, 16, 3)
+
+
+class TestRoiOps:
+    def _det_feat(self):
+        feat = _feat(10, 20)
+        feat["bboxes"] = np.array([[2.0, 1.0, 10.0, 8.0]], np.float32)
+        feat["label"] = np.array([3])
+        return feat
+
+    def test_roi_normalize(self):
+        out = RoiNormalize().apply(self._det_feat(), _rng())
+        np.testing.assert_allclose(out["bboxes"],
+                                   [[0.1, 0.1, 0.5, 0.8]], rtol=1e-6)
+
+    def test_roi_hflip_pixels(self):
+        out = RoiHFlip(normalized=False).apply(self._det_feat(), _rng())
+        np.testing.assert_allclose(out["bboxes"], [[10., 1., 18., 8.]])
+
+    def test_roi_hflip_normalized(self):
+        feat = self._det_feat()
+        feat = RoiNormalize().apply(feat, _rng())
+        out = RoiHFlip(normalized=True).apply(feat, _rng())
+        np.testing.assert_allclose(out["bboxes"], [[0.5, 0.1, 0.9, 0.8]],
+                                   rtol=1e-6)
+
+    def test_roi_resize_scales_boxes(self):
+        out = RoiResize(20, 40).apply(self._det_feat(), _rng())
+        assert out.image.shape[:2] == (20, 40)
+        np.testing.assert_allclose(out["bboxes"], [[4., 2., 20., 16.]])
+
+    def test_random_sampler_keeps_box_consistency(self):
+        rs = _rng(3)
+        for seed in range(5):
+            feat = _feat(40, 40, seed=seed)
+            feat["bboxes"] = np.array([[10.0, 10.0, 30.0, 30.0]], np.float32)
+            feat["label"] = np.array([1])
+            out = RandomSampler().apply(feat, np.random.RandomState(seed))
+            h, w = out.image.shape[:2]
+            b = out["bboxes"]
+            assert (b[:, 0] >= 0).all() and (b[:, 2] <= w + 1e-3).all()
+            assert (b[:, 1] >= 0).all() and (b[:, 3] <= h + 1e-3).all()
+            assert len(out["label"]) == len(b)
+
+    def test_row_to_image_feature(self):
+        row = {"data": np.zeros((4, 5, 3), np.uint8), "origin": "/x/y.png"}
+        feat = RowToImageFeature.from_row(row)
+        assert feat.image.shape == (4, 5, 3)
+        assert feat["path"] == "/x/y.png"
+
+
+class TestWarp3D:
+    def test_zero_field_is_identity(self):
+        vol = np.random.RandomState(0).rand(4, 5, 6).astype(np.float32)
+        field = np.zeros((4, 5, 6, 3), np.float32)
+        feat = ImageFeature(image=vol)
+        out = Warp3D(field).apply(feat, _rng())
+        np.testing.assert_allclose(out.image, vol, rtol=1e-6)
+
+    def test_integer_shift(self):
+        vol = np.arange(4 * 4 * 4, dtype=np.float32).reshape(4, 4, 4)
+        field = np.zeros((4, 4, 4, 3), np.float32)
+        field[..., 2] = 1.0          # sample from x+1
+        out = Warp3D(field).apply(ImageFeature(image=vol), _rng())
+        np.testing.assert_allclose(out.image[:, :, :3], vol[:, :, 1:],
+                                   rtol=1e-6)
+
+    def test_fractional_shift_interpolates(self):
+        vol = np.zeros((3, 3, 3), np.float32)
+        vol[1, 1, 1] = 10.0
+        field = np.zeros((3, 3, 3, 3), np.float32)
+        field[..., 2] = 0.5
+        out = Warp3D(field).apply(ImageFeature(image=vol), _rng())
+        assert np.isclose(out.image[1, 1, 0], 5.0)
+        assert np.isclose(out.image[1, 1, 1], 5.0)
+
+    def test_unclamped_outside_is_zero_not_wrapped(self):
+        # sources outside the volume contribute zeros — never wrap to the
+        # opposite edge
+        vol = np.zeros((4, 4, 4), np.float32)
+        vol[3] = 100.0
+        field = np.zeros((4, 4, 4, 3), np.float32)
+        field[..., 0] = -1.5                       # sample from z - 1.5
+        out = Warp3D(field, clamp=False).apply(ImageFeature(image=vol),
+                                               _rng())
+        assert np.allclose(out.image[0], 0.0), out.image[0]
+        assert np.allclose(out.image[1], 0.0)

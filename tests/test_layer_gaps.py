@@ -1,0 +1,225 @@
+"""Tests for the layer-gap closure (VERDICT #9): ConvLSTM2D/3D,
+SparseDense/SparseEmbedding, MaxoutDense, ResizeBilinear, GaussianSampler,
+RReLU, ShareConvolution2D, and the keras2 arg-name surface."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.nn import keras2
+from analytics_zoo_tpu.nn.layers import (ConvLSTM2D, ConvLSTM3D,
+                                         GaussianSampler, MaxoutDense,
+                                         ResizeBilinear, RReLU,
+                                         ShareConvolution2D, SparseDense,
+                                         SparseEmbedding)
+
+
+def _run(layer, *xs, training=False, rng=None, seed=0):
+    params, state = layer.init(jax.random.PRNGKey(seed),
+                               *[np.asarray(x).shape for x in xs])
+    out, _ = layer.call(params, state, *[jnp.asarray(x) for x in xs],
+                        training=training, rng=rng)
+    return np.asarray(out), params, state
+
+
+class TestConvLSTM:
+    def test_shapes_last_and_sequences(self):
+        x = np.random.RandomState(0).randn(2, 5, 8, 8, 3).astype(np.float32)
+        out, _, _ = _run(ConvLSTM2D(4, 3), x)
+        assert out.shape == (2, 8, 8, 4)
+        out, _, _ = _run(ConvLSTM2D(4, 3, return_sequences=True), x)
+        assert out.shape == (2, 5, 8, 8, 4)
+
+    def test_3d(self):
+        x = np.random.RandomState(0).randn(1, 3, 4, 4, 4, 2).astype(
+            np.float32)
+        out, _, _ = _run(ConvLSTM3D(3, 2), x)
+        assert out.shape == (1, 4, 4, 4, 3)
+
+    def test_golden_vs_keras(self):
+        tf = pytest.importorskip("tensorflow")
+        x = (np.random.RandomState(1).randn(2, 4, 6, 6, 2) * 0.5).astype(
+            np.float32)
+        k = tf.keras.layers.ConvLSTM2D(
+            3, 3, padding="same", recurrent_activation="sigmoid",
+            return_sequences=True)
+        y_ref = k(tf.constant(x)).numpy()
+        kw = [np.asarray(w) for w in k.get_weights()]
+        zoo = ConvLSTM2D(3, 3, inner_activation="sigmoid",
+                         return_sequences=True)
+        params, state = zoo.init(jax.random.PRNGKey(0), x.shape)
+        params = dict(params, kernel=kw[0], recurrent=kw[1], bias=kw[2])
+        out, _ = zoo.call(params, state, jnp.asarray(x))
+        np.testing.assert_allclose(np.asarray(out), y_ref, rtol=5e-4,
+                                   atol=5e-5)
+
+    def test_gradients_flow(self):
+        x = np.random.RandomState(0).randn(1, 3, 4, 4, 2).astype(np.float32)
+        layer = ConvLSTM2D(2, 3)
+        params, state = layer.init(jax.random.PRNGKey(0), x.shape)
+
+        def loss(p):
+            out, _ = layer.call(p, state, jnp.asarray(x))
+            return jnp.sum(out ** 2)
+
+        g = jax.grad(loss)(params)
+        assert all(np.isfinite(v).all() and np.abs(v).sum() > 0
+                   for v in jax.tree_util.tree_leaves(g))
+
+
+class TestSparseLayers:
+    def test_sparse_embedding_sum_matches_dense(self):
+        ids = np.array([[1, 2, 0, 0], [3, 0, 0, 0]], np.int32)
+        layer = SparseEmbedding(5, 4, combiner="sum")
+        out, params, _ = _run(layer, ids)
+        table = np.asarray(params["table"])
+        np.testing.assert_allclose(out[0], table[1] + table[2], rtol=1e-6)
+        np.testing.assert_allclose(out[1], table[3], rtol=1e-6)
+        assert np.allclose(table[0], 0.0)   # pad row zeroed
+
+    def test_sparse_embedding_mean(self):
+        ids = np.array([[1, 2, 4, 0]], np.int32)
+        layer = SparseEmbedding(5, 3, combiner="mean")
+        out, params, _ = _run(layer, ids)
+        t = np.asarray(params["table"])
+        np.testing.assert_allclose(out[0], (t[1] + t[2] + t[4]) / 3.0,
+                                   rtol=1e-6)
+
+    def test_sparse_dense_equals_dense_on_multihot(self):
+        # gather+sum == W.T x for the equivalent multi-hot dense vector
+        rs = np.random.RandomState(0)
+        ids = np.array([[1, 3, 0], [2, 2, 4]], np.int32)
+        layer = SparseDense(6, input_dim=5)
+        out, params, _ = _run(layer, ids)
+        W = np.asarray(params["kernel"])
+        b = np.asarray(params["bias"])
+        dense0 = W[1] + W[3] + b
+        dense1 = W[2] * 2 + W[4] + b
+        np.testing.assert_allclose(out[0], dense0, rtol=1e-5)
+        np.testing.assert_allclose(out[1], dense1, rtol=1e-5)
+
+    def test_sparse_dense_with_values(self):
+        ids = np.array([[1, 2, 0]], np.int32)
+        vals = np.array([[0.5, 2.0, 9.0]], np.float32)  # pad value ignored
+        layer = SparseDense(4, input_dim=5, bias=False)
+        params, state = layer.init(jax.random.PRNGKey(0), ids.shape,
+                                   vals.shape)
+        out, _ = layer.call(params, state, jnp.asarray(ids),
+                            jnp.asarray(vals))
+        W = np.asarray(params["kernel"])
+        np.testing.assert_allclose(np.asarray(out)[0],
+                                   0.5 * W[1] + 2.0 * W[2], rtol=1e-5)
+
+
+class TestMaxoutDense:
+    def test_maxout_semantics(self):
+        x = np.random.RandomState(0).randn(3, 5).astype(np.float32)
+        layer = MaxoutDense(4, nb_feature=3)
+        out, params, _ = _run(layer, x)
+        W = np.asarray(params["kernel"]).reshape(5, 3, 4)
+        b = np.asarray(params["bias"]).reshape(3, 4)
+        expect = np.max(np.einsum("bi,ikf->bkf", x, W) + b, axis=1)
+        np.testing.assert_allclose(out, expect, rtol=1e-5)
+
+
+class TestGaussianSampler:
+    def test_eval_returns_mean(self):
+        mean = np.ones((2, 3), np.float32) * 5
+        logv = np.zeros((2, 3), np.float32)
+        layer = GaussianSampler()
+        params, state = layer.init(jax.random.PRNGKey(0), mean.shape,
+                                   logv.shape)
+        out, _ = layer.call(params, state, jnp.asarray(mean),
+                            jnp.asarray(logv), rng=None)
+        np.testing.assert_allclose(np.asarray(out), mean)
+
+    def test_training_samples_with_spread(self):
+        mean = np.zeros((400, 8), np.float32)
+        logv = np.zeros((400, 8), np.float32)   # std = 1
+        layer = GaussianSampler()
+        params, state = layer.init(jax.random.PRNGKey(0), mean.shape,
+                                   logv.shape)
+        out, _ = layer.call(params, state, jnp.asarray(mean),
+                            jnp.asarray(logv), training=True,
+                            rng=jax.random.PRNGKey(7))
+        s = np.asarray(out).std()
+        assert 0.9 < s < 1.1, s
+
+
+class TestRReLU:
+    def test_eval_uses_mean_slope(self):
+        x = np.array([[-2.0, 2.0]], np.float32)
+        layer = RReLU(0.1, 0.3)
+        out, _, _ = _run(layer, x)
+        np.testing.assert_allclose(out, [[-2.0 * 0.2, 2.0]], rtol=1e-6)
+
+    def test_train_slope_in_range(self):
+        x = -np.ones((200, 10), np.float32)
+        layer = RReLU(0.1, 0.3)
+        params, state = layer.init(jax.random.PRNGKey(0), x.shape)
+        out, _ = layer.call(params, state, jnp.asarray(x), training=True,
+                            rng=jax.random.PRNGKey(3))
+        slopes = -np.asarray(out)
+        assert slopes.min() >= 0.1 and slopes.max() <= 0.3
+        assert slopes.std() > 0.01   # actually random
+
+
+class TestResizeBilinear:
+    def test_matches_tf_half_pixel(self):
+        tf = pytest.importorskip("tensorflow")
+        x = np.random.RandomState(0).rand(2, 5, 7, 3).astype(np.float32)
+        ref = tf.image.resize(x, (10, 14), method="bilinear").numpy()
+        out, _, _ = _run(ResizeBilinear(10, 14, align_corners=False), x)
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+    def test_align_corners_endpoints(self):
+        # corners map exactly under align_corners=True
+        x = np.arange(12, dtype=np.float32).reshape(1, 3, 4, 1)
+        out, _, _ = _run(ResizeBilinear(5, 7, align_corners=True), x)
+        assert out[0, 0, 0, 0] == x[0, 0, 0, 0]
+        assert out[0, -1, -1, 0] == x[0, -1, -1, 0]
+
+
+class TestShareConv:
+    def test_alias_of_conv2d(self):
+        x = np.random.RandomState(0).randn(2, 6, 6, 3).astype(np.float32)
+        share = ShareConvolution2D(4, 3, 3, name="c")
+        out, params, _ = _run(share, x)
+        assert out.shape == (2, 4, 4, 4)
+        from analytics_zoo_tpu.nn.layers import Convolution2D
+
+        assert isinstance(share, Convolution2D)
+
+
+class TestKeras2Surface:
+    def test_dense_units_arg(self):
+        x = np.random.RandomState(0).randn(4, 6).astype(np.float32)
+        out, _, _ = _run(keras2.Dense(units=8, activation="relu"), x)
+        assert out.shape == (4, 8)
+
+    def test_conv2d_filters_padding(self):
+        x = np.random.RandomState(0).randn(2, 8, 8, 3).astype(np.float32)
+        out, _, _ = _run(keras2.Conv2D(filters=5, kernel_size=3,
+                                       strides=2, padding="same"), x)
+        assert out.shape == (2, 4, 4, 5)
+
+    def test_pool_and_rnn_args(self):
+        x = np.random.RandomState(0).randn(2, 8, 8, 3).astype(np.float32)
+        out, _, _ = _run(keras2.MaxPooling2D(pool_size=2), x)
+        assert out.shape == (2, 4, 4, 3)
+        seq = np.random.RandomState(0).randn(2, 5, 4).astype(np.float32)
+        out, _, _ = _run(keras2.LSTM(units=6), seq)
+        assert out.shape == (2, 6)
+
+    def test_weight_compat_with_v1(self):
+        # identical pytrees: keras2 Dense params load into v1 Dense
+        from analytics_zoo_tpu.nn.layers import Dense as V1Dense
+
+        x = np.random.RandomState(0).randn(3, 5).astype(np.float32)
+        k2 = keras2.Dense(units=4)
+        out2, params, _ = _run(k2, x)
+        v1 = V1Dense(4)
+        _, state = v1.init(jax.random.PRNGKey(0), x.shape)
+        out1, _ = v1.call(params, state, jnp.asarray(x))
+        np.testing.assert_allclose(out2, np.asarray(out1), rtol=1e-6)
