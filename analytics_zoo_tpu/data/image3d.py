@@ -120,6 +120,7 @@ class Warp3D(ImagePreprocessing):
     def apply(self, feat, rng):
         vol = np.asarray(feat.image, np.float32)
         d, h, w = vol.shape[:3]
+        extra = vol.ndim - 3                  # trailing channel dims
         zz, yy, xx = np.meshgrid(np.arange(d), np.arange(h), np.arange(w),
                                  indexing="ij")
         src = np.stack([zz, yy, xx], axis=-1).astype(np.float32) + self.field
@@ -127,38 +128,35 @@ class Warp3D(ImagePreprocessing):
             src[..., 0] = np.clip(src[..., 0], 0, d - 1)
             src[..., 1] = np.clip(src[..., 1], 0, h - 1)
             src[..., 2] = np.clip(src[..., 2], 0, w - 1)
-        else:
-            # zero-pad outside the volume: out-of-range sources contribute 0
-            inside = ((src[..., 0] >= 0) & (src[..., 0] <= d - 1)
-                      & (src[..., 1] >= 0) & (src[..., 1] <= h - 1)
-                      & (src[..., 2] >= 0) & (src[..., 2] <= w - 1))
-        z0 = np.floor(src[..., 0]).astype(np.int64)
-        y0 = np.floor(src[..., 1]).astype(np.int64)
-        x0 = np.floor(src[..., 2]).astype(np.int64)
-        wz = src[..., 0] - z0
-        wy = src[..., 1] - y0
-        wx = src[..., 2] - x0
-        # clip all eight corner indices into range (weights still use the
-        # unclipped fractional offsets; outside contributions are masked)
-        z0 = np.clip(z0, 0, d - 1)
-        y0 = np.clip(y0, 0, h - 1)
-        x0 = np.clip(x0, 0, w - 1)
-        z1, y1, x1 = (np.minimum(z0 + 1, d - 1), np.minimum(y0 + 1, h - 1),
-                      np.minimum(x0 + 1, w - 1))
+        # unclipped corner indices: per-corner validity gives true
+        # zero-padding (a corner outside the volume contributes 0, the
+        # in-range corners keep their trilinear weights)
+        z0u = np.floor(src[..., 0]).astype(np.int64)
+        y0u = np.floor(src[..., 1]).astype(np.int64)
+        x0u = np.floor(src[..., 2]).astype(np.int64)
+        wz = src[..., 0] - z0u
+        wy = src[..., 1] - y0u
+        wx = src[..., 2] - x0u
 
-        def g(zi, yi, xi):
-            return vol[zi, yi, xi]
+        def expand(a):
+            return a.reshape(a.shape + (1,) * extra)
 
-        out = ((1 - wz) * (1 - wy) * (1 - wx) * g(z0, y0, x0)
-               + (1 - wz) * (1 - wy) * wx * g(z0, y0, x1)
-               + (1 - wz) * wy * (1 - wx) * g(z0, y1, x0)
-               + (1 - wz) * wy * wx * g(z0, y1, x1)
-               + wz * (1 - wy) * (1 - wx) * g(z1, y0, x0)
-               + wz * (1 - wy) * wx * g(z1, y0, x1)
-               + wz * wy * (1 - wx) * g(z1, y1, x0)
-               + wz * wy * wx * g(z1, y1, x1))
-        if not self.clamp:
-            shape = inside.shape + (1,) * (out.ndim - inside.ndim)
-            out = out * inside.reshape(shape)
+        wz, wy, wx = expand(wz), expand(wy), expand(wx)
+
+        def corner(zi, yi, xi):
+            valid = ((zi >= 0) & (zi < d) & (yi >= 0) & (yi < h)
+                     & (xi >= 0) & (xi < w))
+            v = vol[np.clip(zi, 0, d - 1), np.clip(yi, 0, h - 1),
+                    np.clip(xi, 0, w - 1)]
+            return v * expand(valid.astype(np.float32))
+
+        out = ((1 - wz) * (1 - wy) * (1 - wx) * corner(z0u, y0u, x0u)
+               + (1 - wz) * (1 - wy) * wx * corner(z0u, y0u, x0u + 1)
+               + (1 - wz) * wy * (1 - wx) * corner(z0u, y0u + 1, x0u)
+               + (1 - wz) * wy * wx * corner(z0u, y0u + 1, x0u + 1)
+               + wz * (1 - wy) * (1 - wx) * corner(z0u + 1, y0u, x0u)
+               + wz * (1 - wy) * wx * corner(z0u + 1, y0u, x0u + 1)
+               + wz * wy * (1 - wx) * corner(z0u + 1, y0u + 1, x0u)
+               + wz * wy * wx * corner(z0u + 1, y0u + 1, x0u + 1))
         feat.image = out.astype(vol.dtype)
         return feat

@@ -26,8 +26,11 @@ from analytics_zoo_tpu.nn.layers import pooling as _pl
 from analytics_zoo_tpu.nn.layers import recurrent as _rc
 
 
+from analytics_zoo_tpu.nn.layers.convolutional import _tuple
+
+
 def _pair(v):
-    return (v, v) if isinstance(v, int) else tuple(v)
+    return _tuple(v, 2)
 
 
 class Dense(_core.Dense):
@@ -95,7 +98,7 @@ class SeparableConv2D(_cv.SeparableConvolution2D):
 class MaxPooling1D(_pl.MaxPooling1D):
     def __init__(self, pool_size: int = 2, strides: Optional[int] = None,
                  padding: str = "valid", **kw):
-        super().__init__(pool_size, strides=strides,
+        super().__init__(pool_length=pool_size, stride=strides,
                          border_mode=padding, **kw)
 
 
@@ -110,7 +113,7 @@ class MaxPooling2D(_pl.MaxPooling2D):
 class AveragePooling1D(_pl.AveragePooling1D):
     def __init__(self, pool_size: int = 2, strides: Optional[int] = None,
                  padding: str = "valid", **kw):
-        super().__init__(pool_size, strides=strides,
+        super().__init__(pool_length=pool_size, stride=strides,
                          border_mode=padding, **kw)
 
 

@@ -1,0 +1,179 @@
+"""Net — model import/export + transfer-learning graph surgery.
+
+Reference capability:
+- ``Net.load/loadTorch/loadTF/loadCaffe`` loaders (api/Net.scala:136-189)
+- ``NetSaver`` exporters to TF / keras formats (api/Net.scala:277-445)
+- ``GraphNet``/``NetUtils`` surgery: freeze/unfreeze layers, ``newGraph``
+  from intermediate node names (pipeline/api/net/NetUtils.scala).
+
+TPU-native redesign: every loader lands in the SAME Layer-protocol world
+(pure fn + param pytree), so an imported model trains under the SPMD
+Estimator exactly like a native one.  Freezing is realised by zeroing
+optimizer updates for the frozen top-level param subtrees inside the
+jitted step — no graph mutation, no second code path.
+
+Legacy JVM binary formats (BigDL protobuf, Caffe) are intentionally not
+parsed: their live content reaches this framework via the ONNX / TF /
+torch ingestion paths instead.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Set, Union
+
+__all__ = ["Net", "GraphNet"]
+
+
+class Net:
+    """Unified loader facade (reference api/Net.scala:136-189)."""
+
+    @staticmethod
+    def load(path: str):
+        """Load a natively saved ZooModel directory (models/common.py)."""
+        from analytics_zoo_tpu.models.common import ZooModel
+
+        return ZooModel.load_model(path)
+
+    @staticmethod
+    def load_torch(module_or_path):
+        """torch.nn.Sequential (or a TorchScript file path) -> natively
+        trainable model (reference loadTorch, Net.scala:161)."""
+        import torch
+
+        if isinstance(module_or_path, str):
+            module_or_path = torch.jit.load(module_or_path)
+        from analytics_zoo_tpu.tfpark.model import TorchModel
+
+        return TorchModel(module_or_path)
+
+    @staticmethod
+    def load_tf(path_or_model, **kw):
+        """TF SavedModel path or tf.keras model (reference loadTF,
+        Net.scala:176)."""
+        from analytics_zoo_tpu.tfpark.model import KerasModel, TFNet
+
+        if not isinstance(path_or_model, str):
+            return KerasModel(path_or_model, **kw)
+        return TFNet(path_or_model, **kw)
+
+    @staticmethod
+    def load_onnx(path: str):
+        """.onnx file -> trainable KerasNet (onnx/loader.py)."""
+        from analytics_zoo_tpu.onnx import load_onnx, to_model
+
+        return to_model(load_onnx(path))
+
+    @staticmethod
+    def load_bigdl(path: str):
+        raise NotImplementedError(
+            "BigDL protobuf checkpoints are a JVM-era format; export the "
+            "model to ONNX or TF SavedModel and use Net.load_onnx / "
+            "Net.load_tf")
+
+    @staticmethod
+    def load_caffe(def_path: str, model_path: str):
+        raise NotImplementedError(
+            "Caffe models are a legacy format; convert to ONNX "
+            "(caffe2onnx) and use Net.load_onnx")
+
+    # -- exporters (reference NetSaver, Net.scala:277-445) -----------------
+    @staticmethod
+    def export_tf_saved_model(model, params, path: str,
+                              input_shapes: Sequence[Sequence[int]],
+                              state=None):
+        """Native model -> TF SavedModel via jax2tf (serving handoff)."""
+        import tensorflow as tf
+        from jax.experimental import jax2tf
+
+        def fwd(*xs):
+            out, _ = model.call(params, state or {}, *xs, training=False,
+                                rng=None)
+            return out
+
+        tf_fn = tf.function(
+            jax2tf.convert(fwd, with_gradient=False),
+            input_signature=[
+                tf.TensorSpec([None] + list(s[1:]), tf.float32)
+                for s in input_shapes],
+            autograph=False)
+        module = tf.Module()
+        module.__call__ = tf_fn
+        tf.saved_model.save(module, path)
+        return path
+
+
+class GraphNet:
+    """Transfer-learning surgery over a graph ``Model``
+    (reference GraphNet in NetUtils.scala: freeze/unfreeze/newGraph).
+
+    Wraps a native ``Model`` (nn/topology.py); mutating operations mark
+    layers frozen (their params stop receiving optimizer updates — the
+    Estimator zeroes their update subtrees inside the jitted step) or cut
+    a new sub-graph ending at named intermediate layers.
+    """
+
+    def __init__(self, model):
+        self.model = model
+
+    # -- freezing ---------------------------------------------------------
+    def freeze(self, names: Optional[Sequence[str]] = None) -> "GraphNet":
+        """Freeze the named layers (all layers when None) — reference
+        GraphNet.freeze."""
+        layer_names = {l.name for l in self.model.layers}
+        targets = set(names) if names is not None else layer_names
+        unknown = targets - layer_names
+        if unknown:
+            raise ValueError(f"unknown layers {sorted(unknown)}; "
+                             f"known: {sorted(layer_names)}")
+        frozen: Set[str] = set(getattr(self.model, "_frozen", set()))
+        frozen |= targets
+        self.model._frozen = frozen
+        return self
+
+    def unfreeze(self, names: Optional[Sequence[str]] = None) -> "GraphNet":
+        frozen: Set[str] = set(getattr(self.model, "_frozen", set()))
+        frozen -= set(names) if names is not None else set(frozen)
+        self.model._frozen = frozen
+        return self
+
+    def freeze_up_to(self, name: str) -> "GraphNet":
+        """Freeze every layer up to and including ``name`` in topological
+        order (the classic fine-tune-the-head recipe)."""
+        layers = self.model.layers
+        idx = [i for i, l in enumerate(layers) if l.name == name]
+        if not idx:
+            raise ValueError(f"unknown layer {name!r}")
+        return self.freeze([l.name for l in layers[:idx[-1] + 1]])
+
+    @property
+    def frozen(self) -> Set[str]:
+        return set(getattr(self.model, "_frozen", set()))
+
+    # -- sub-graphs -------------------------------------------------------
+    def new_graph(self, output_names: Union[str, Sequence[str]]):
+        """Cut a sub-model ending at the named layers' outputs
+        (reference newGraph, NetUtils.scala) — e.g. chop the classifier
+        off an imported backbone and reuse the feature extractor."""
+        from analytics_zoo_tpu.nn.topology import Model
+
+        single = isinstance(output_names, str)
+        names = [output_names] if single else list(output_names)
+        by_name = {}
+        for v in self.model.order:
+            if v.kind in ("layer", "param"):
+                by_name[v.layer.name] = v     # last node of a shared layer
+        missing = [n for n in names if n not in by_name]
+        if missing:
+            raise ValueError(f"unknown layers {missing}; known: "
+                             f"{sorted(by_name)}")
+        outs = [by_name[n] for n in names]
+        sub = Model(self.model.inputs, outs[0] if single else outs)
+        return GraphNet(sub)
+
+    # -- passthrough ------------------------------------------------------
+    def compile(self, *a, **kw):
+        self.model.compile(*a, **kw)
+        return self
+
+    def __getattr__(self, item):
+        return getattr(self.model, item)

@@ -1,0 +1,10 @@
+"""ONNX import (reference pyzoo/zoo/pipeline/api/onnx/) — a pure-python
+wire-format decoder (proto.py) + op lowering to jax/lax (loader.py), no
+``onnx`` package dependency."""
+
+from analytics_zoo_tpu.onnx.loader import (OnnxProgram, UnsupportedOnnxOp,
+                                           load_onnx, load_onnx_bytes,
+                                           to_model)
+
+__all__ = ["load_onnx", "load_onnx_bytes", "to_model", "OnnxProgram",
+           "UnsupportedOnnxOp"]

@@ -42,8 +42,18 @@ class FunctionModel(KerasNet):
         return []
 
     def build(self, rng, *input_shapes):
-        # weights come from the foreign model — rng is unused by design
-        return self.program.params, self.program.state
+        # weights come from the foreign model — rng is unused by design.
+        # Fresh copies: the estimator DONATES its param buffers into the
+        # jitted step, and donating the program's own arrays would leave
+        # program.params deleted (breaking re-builds / introspection).
+        import jax
+        import jax.numpy as jnp
+
+        def copy(t):
+            return jax.tree_util.tree_map(
+                lambda a: jnp.array(a, copy=True), t)
+
+        return copy(self.program.params), copy(self.program.state)
 
     def call(self, params, state, *inputs, training=False, rng=None):
         return self.program.call(params, state, *inputs, training=training,

@@ -213,6 +213,10 @@ class Estimator:
         data_shard = self.ctx.data_sharding()
         rep = self.ctx.replicated_sharding()
         cdtype = self.compute_dtype
+        # transfer-learning freeze (nn/net.py GraphNet.freeze): frozen
+        # top-level param subtrees get zero updates inside the jitted step
+        frozen = frozenset(getattr(model, "_frozen", ()))
+        self._frozen_built = frozen
 
         def step(params, state, opt_state, rng, xs, y):
             # rng is carried ON DEVICE and split inside the step — passing
@@ -242,6 +246,11 @@ class Estimator:
             (loss, new_state), grads = jax.value_and_grad(
                 lossf, has_aux=True)(params)
             updates, new_opt = tx.update(grads, opt_state, params)
+            if frozen:
+                updates = {
+                    k: (jax.tree_util.tree_map(jnp.zeros_like, u)
+                        if k in frozen else u)
+                    for k, u in updates.items()}
             new_params = optax.apply_updates(params, updates)
             return new_params, new_state, new_opt, rng, loss
 
@@ -434,6 +443,13 @@ class Estimator:
 
         self._val_trigger = validation_trigger
         self._val_batch = validation_batch_size
+        # freeze()/unfreeze() after a previous fit must take effect: the
+        # compiled step captured the old frozen set, so rebuild it
+        cur_frozen = frozenset(getattr(self.model, "_frozen", ()))
+        if (self._train_step is not None
+                and cur_frozen != getattr(self, "_frozen_built", cur_frozen)):
+            self._train_step = None
+            self._multi_step = None
         if isinstance(x, FeatureSet):
             return self._fit_featureset(x, batch_size, epochs,
                                         validation_data, end_trigger, verbose)

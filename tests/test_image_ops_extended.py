@@ -183,6 +183,23 @@ class TestWarp3D:
         assert np.isclose(out.image[1, 1, 0], 5.0)
         assert np.isclose(out.image[1, 1, 1], 5.0)
 
+    def test_multichannel_volume(self):
+        vol = np.random.RandomState(0).rand(3, 4, 5, 2).astype(np.float32)
+        field = np.zeros((3, 4, 5, 3), np.float32)
+        out = Warp3D(field).apply(ImageFeature(image=vol), _rng())
+        np.testing.assert_allclose(out.image, vol, rtol=1e-6)
+
+    def test_boundary_fraction_interpolates_with_zero(self):
+        # src 0.25 beyond the top edge: true zero-padding blends
+        # 0.75*vol[d-1] + 0.25*0
+        vol = np.full((4, 3, 3), 8.0, np.float32)
+        field = np.zeros((4, 3, 3, 3), np.float32)
+        field[..., 0] = 0.25
+        out = Warp3D(field, clamp=False).apply(ImageFeature(image=vol),
+                                               _rng())
+        np.testing.assert_allclose(out.image[3], 6.0, rtol=1e-6)
+        np.testing.assert_allclose(out.image[0], 8.0, rtol=1e-6)
+
     def test_unclamped_outside_is_zero_not_wrapped(self):
         # sources outside the volume contribute zeros — never wrap to the
         # opposite edge

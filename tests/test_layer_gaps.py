@@ -208,6 +208,11 @@ class TestKeras2Surface:
         x = np.random.RandomState(0).randn(2, 8, 8, 3).astype(np.float32)
         out, _, _ = _run(keras2.MaxPooling2D(pool_size=2), x)
         assert out.shape == (2, 4, 4, 3)
+        x1 = np.random.RandomState(0).randn(2, 8, 3).astype(np.float32)
+        out, _, _ = _run(keras2.MaxPooling1D(pool_size=2), x1)
+        assert out.shape == (2, 4, 3)
+        out, _, _ = _run(keras2.AveragePooling1D(pool_size=2, strides=3), x1)
+        assert out.shape == (2, 3, 3)
         seq = np.random.RandomState(0).randn(2, 5, 4).astype(np.float32)
         out, _, _ = _run(keras2.LSTM(units=6), seq)
         assert out.shape == (2, 6)

@@ -1,0 +1,294 @@
+"""ONNX importer + Net loaders + GraphNet surgery tests
+(reference pyzoo/zoo/pipeline/api/onnx tests + NetUtils specs)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.nn.net import GraphNet, Net
+from analytics_zoo_tpu.onnx import (UnsupportedOnnxOp, load_onnx,
+                                    load_onnx_bytes, to_model)
+from analytics_zoo_tpu.onnx import proto
+
+
+# -- model builders (via our own encoder — real .onnx bytes) ---------------
+
+def _vi(name, shape):
+    return proto.ValueInfo(name=name, elem_type=1, shape=shape)
+
+
+def _mlp_onnx(seed=0):
+    """input(4) -> Gemm(8) -> Relu -> Gemm(2) -> Softmax"""
+    rs = np.random.RandomState(seed)
+    w1 = (rs.randn(4, 8) * 0.4).astype(np.float32)
+    b1 = np.zeros(8, np.float32)
+    w2 = (rs.randn(8, 2) * 0.4).astype(np.float32)
+    b2 = np.zeros(2, np.float32)
+    g = proto.Graph(
+        name="mlp",
+        nodes=[
+            proto.Node("Gemm", "g1", ["x", "w1", "b1"], ["h1"]),
+            proto.Node("Relu", "r1", ["h1"], ["h2"]),
+            proto.Node("Gemm", "g2", ["h2", "w2", "b2"], ["h3"]),
+            proto.Node("Softmax", "s", ["h3"], ["y"],
+                       {"axis": -1}),
+        ],
+        initializers=[proto.tensor_from_array("w1", w1),
+                      proto.tensor_from_array("b1", b1),
+                      proto.tensor_from_array("w2", w2),
+                      proto.tensor_from_array("b2", b2)],
+        inputs=[_vi("x", (None, 4))],
+        outputs=[_vi("y", (None, 2))])
+    return proto.Model(graph=g), (w1, b1, w2, b2)
+
+
+def _conv_onnx(seed=1):
+    """NCHW conv net: Conv -> Relu -> MaxPool -> Flatten -> Gemm"""
+    rs = np.random.RandomState(seed)
+    k = rs.randn(4, 3, 3, 3).astype(np.float32) * 0.1     # OIHW
+    kb = rs.randn(4).astype(np.float32) * 0.1
+    w = rs.randn(4 * 3 * 3, 5).astype(np.float32) * 0.1
+    g = proto.Graph(
+        name="cnn",
+        nodes=[
+            proto.Node("Conv", "c", ["x", "k", "kb"], ["h1"],
+                       {"kernel_shape": [3, 3], "strides": [1, 1],
+                        "pads": [0, 0, 0, 0]}),
+            proto.Node("Relu", "r", ["h1"], ["h2"]),
+            proto.Node("MaxPool", "p", ["h2"], ["h3"],
+                       {"kernel_shape": [2, 2], "strides": [2, 2]}),
+            proto.Node("Flatten", "f", ["h3"], ["h4"], {"axis": 1}),
+            proto.Node("Gemm", "g", ["h4", "w"], ["y"]),
+        ],
+        initializers=[proto.tensor_from_array("k", k),
+                      proto.tensor_from_array("kb", kb),
+                      proto.tensor_from_array("w", w)],
+        inputs=[_vi("x", (None, 3, 8, 8))],
+        outputs=[_vi("y", (None, 5))])
+    return proto.Model(graph=g)
+
+
+class TestProtoCodec:
+    def test_roundtrip(self):
+        m, _ = _mlp_onnx()
+        buf = proto.encode_model(m)
+        m2 = proto.decode_model(buf)
+        assert m2.graph.name == "mlp"
+        assert [n.op_type for n in m2.graph.nodes] == [
+            "Gemm", "Relu", "Gemm", "Softmax"]
+        assert m2.graph.nodes[3].attrs["axis"] == -1
+        w1 = [t for t in m2.graph.initializers if t.name == "w1"][0]
+        np.testing.assert_array_equal(
+            w1.array, m.graph.initializers[0].array)
+        assert m2.graph.inputs[0].shape == (None, 4)
+
+    def test_attr_types(self):
+        n = proto.Node("X", "n", ["a"], ["b"],
+                       {"f": 1.5, "i": 7, "s": b"hi",
+                        "fl": [1.0, 2.0], "il": [3, 4]})
+        buf = proto._encode_node(n)
+        n2 = proto._decode_node(buf)
+        assert n2.attrs["f"] == pytest.approx(1.5)
+        assert n2.attrs["i"] == 7
+        assert n2.attrs["s"] == b"hi"
+        assert n2.attrs["fl"] == pytest.approx([1.0, 2.0])
+        assert n2.attrs["il"] == [3, 4]
+
+
+class TestOnnxLoader:
+    def test_mlp_numerics(self):
+        m, (w1, b1, w2, b2) = _mlp_onnx()
+        prog = load_onnx_bytes(proto.encode_model(m))
+        x = np.random.RandomState(2).randn(5, 4).astype(np.float32)
+        out, _ = prog.call(prog.params, prog.state, jnp.asarray(x))
+        h = np.maximum(x @ w1 + b1, 0.0) @ w2 + b2
+        e = np.exp(h - h.max(-1, keepdims=True))
+        expect = e / e.sum(-1, keepdims=True)
+        np.testing.assert_allclose(np.asarray(out), expect, rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_file_roundtrip_and_predict(self, tmp_path, zoo_ctx):
+        m, _ = _mlp_onnx()
+        p = str(tmp_path / "mlp.onnx")
+        with open(p, "wb") as f:
+            f.write(proto.encode_model(m))
+        model = Net.load_onnx(p)
+        model.compile(optimizer="adam",
+                      loss="sparse_categorical_crossentropy")
+        x = np.random.RandomState(0).randn(16, 4).astype(np.float32)
+        preds = model.predict(x, batch_size=16)
+        assert preds.shape == (16, 2)
+        np.testing.assert_allclose(preds.sum(-1), 1.0, rtol=1e-4)
+
+    def test_conv_net_shapes(self):
+        prog = load_onnx_bytes(proto.encode_model(_conv_onnx()))
+        x = np.random.RandomState(0).randn(2, 3, 8, 8).astype(np.float32)
+        out, _ = prog.call(prog.params, prog.state, jnp.asarray(x))
+        assert np.asarray(out).shape == (2, 5)
+
+    def test_conv_matches_torch(self):
+        torch = pytest.importorskip("torch")
+        prog = load_onnx_bytes(proto.encode_model(_conv_onnx()))
+        x = np.random.RandomState(3).randn(2, 3, 8, 8).astype(np.float32)
+        out, _ = prog.call(prog.params, prog.state, jnp.asarray(x))
+        # torch oracle with the same weights
+        conv = torch.nn.Conv2d(3, 4, 3)
+        conv.weight.data = torch.from_numpy(
+            np.asarray(prog.params["k"]).copy())
+        conv.bias.data = torch.from_numpy(
+            np.asarray(prog.params["kb"]).copy())
+        with torch.no_grad():
+            h = torch.relu(conv(torch.from_numpy(x)))
+            h = torch.nn.functional.max_pool2d(h, 2)
+            ref = h.flatten(1).numpy() @ np.asarray(prog.params["w"])
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_imported_model_trains(self, zoo_ctx):
+        m, _ = _mlp_onnx()
+        from analytics_zoo_tpu.train.optimizers import Adam
+
+        model = to_model(load_onnx_bytes(proto.encode_model(m)))
+        model.compile(optimizer=Adam(lr=1e-2),
+                      loss="sparse_categorical_crossentropy",
+                      metrics=["accuracy"])
+        rs = np.random.RandomState(0)
+        x = rs.randn(128, 4).astype(np.float32)
+        y = (x[:, 0] > 0).astype(np.int32)
+        model.fit(x, y, batch_size=32, nb_epoch=10, verbose=False)
+        acc = model.evaluate(x, y, batch_size=32)["accuracy"]
+        assert acc > 0.8, acc
+
+    def test_unsupported_op_raises(self):
+        g = proto.Graph(nodes=[proto.Node("NonMaxSuppression", "n",
+                                          ["x"], ["y"])],
+                        inputs=[_vi("x", (None, 4))],
+                        outputs=[_vi("y", (None, 4))])
+        with pytest.raises(UnsupportedOnnxOp, match="NonMaxSuppression"):
+            load_onnx_bytes(proto.encode_model(proto.Model(graph=g)))
+
+    def test_elementwise_and_reduce_ops(self):
+        g = proto.Graph(
+            nodes=[
+                proto.Node("Mul", "m", ["x", "x"], ["sq"]),
+                proto.Node("ReduceMean", "rm", ["sq"], ["mu"],
+                           {"axes": [1], "keepdims": 1}),
+                proto.Node("Sqrt", "s", ["mu"], ["y"]),
+            ],
+            inputs=[_vi("x", (None, 6))], outputs=[_vi("y", (None, 1))])
+        prog = load_onnx_bytes(proto.encode_model(proto.Model(graph=g)))
+        x = np.random.RandomState(0).randn(3, 6).astype(np.float32)
+        out, _ = prog.call({}, {}, jnp.asarray(x))
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.sqrt((x ** 2).mean(1, keepdims=True)),
+                                   rtol=1e-5)
+
+
+class TestNetLoaders:
+    def test_load_native_roundtrip(self, zoo_ctx, tmp_path):
+        from analytics_zoo_tpu.models import NeuralCF
+
+        ncf = NeuralCF(10, 8, class_num=2)
+        ncf.compile(optimizer="adam",
+                    loss="sparse_categorical_crossentropy")
+        p = str(tmp_path / "m.zoo")
+        ncf.save_model(p)
+        loaded = Net.load(p)
+        assert type(loaded).__name__ == "NeuralCF"
+
+    def test_load_torch(self):
+        torch = pytest.importorskip("torch")
+        net = torch.nn.Sequential(torch.nn.Linear(4, 3))
+        tm = Net.load_torch(net)
+        out = tm.predict(np.zeros((4, 4), np.float32), batch_size=4)
+        assert out.shape == (4, 3)
+
+    def test_legacy_formats_guide_users(self):
+        with pytest.raises(NotImplementedError, match="ONNX"):
+            Net.load_bigdl("x")
+        with pytest.raises(NotImplementedError, match="ONNX"):
+            Net.load_caffe("x", "y")
+
+
+class TestGraphNet:
+    def _model(self):
+        from analytics_zoo_tpu.nn import reset_name_scope
+        from analytics_zoo_tpu.nn.autograd import Input
+        from analytics_zoo_tpu.nn.layers import Dense
+        from analytics_zoo_tpu.nn.topology import Model
+
+        reset_name_scope()
+        inp = Input(shape=(6,))
+        h1 = Dense(8, activation="relu", name="backbone1")(inp)
+        h2 = Dense(4, activation="relu", name="backbone2")(h1)
+        out = Dense(2, activation="softmax", name="head")(h2)
+        return Model(inp, out)
+
+    def test_freeze_stops_updates(self, zoo_ctx):
+        model = self._model()
+        gn = GraphNet(model)
+        gn.freeze(["backbone1", "backbone2"])
+        model.compile(optimizer="adam",
+                      loss="sparse_categorical_crossentropy")
+        rs = np.random.RandomState(0)
+        x = rs.randn(64, 6).astype(np.float32)
+        y = (x[:, 0] > 0).astype(np.int32)
+        model.fit(x, y, batch_size=32, nb_epoch=2, verbose=False)
+        est = model.estimator
+        before = jax.tree_util.tree_map(np.asarray, est.params)
+        model.fit(x, y, batch_size=32, nb_epoch=5, verbose=False)
+        after = jax.tree_util.tree_map(np.asarray, est.params)
+        # frozen layers byte-identical across training; head moved
+        for name in ("backbone1", "backbone2"):
+            for k in before[name]:
+                np.testing.assert_array_equal(before[name][k],
+                                              after[name][k])
+        assert not np.allclose(before["head"]["kernel"],
+                               after["head"]["kernel"])
+
+    def test_unfreeze_after_fit_takes_effect(self, zoo_ctx):
+        # freeze -> fit -> unfreeze -> fit: second fit must update the
+        # previously frozen layers (the jitted step is rebuilt)
+        model = self._model()
+        gn = GraphNet(model)
+        gn.freeze(["backbone1"])
+        model.compile(optimizer="adam",
+                      loss="sparse_categorical_crossentropy")
+        rs = np.random.RandomState(0)
+        x = rs.randn(64, 6).astype(np.float32)
+        y = (x[:, 0] > 0).astype(np.int32)
+        model.fit(x, y, batch_size=32, nb_epoch=2, verbose=False)
+        est = model.estimator
+        frozen_w = np.asarray(est.params["backbone1"]["kernel"])
+        gn.unfreeze()
+        model.fit(x, y, batch_size=32, nb_epoch=4, verbose=False)
+        after = np.asarray(est.params["backbone1"]["kernel"])
+        assert not np.allclose(frozen_w, after)
+
+    def test_freeze_up_to_and_unfreeze(self):
+        gn = GraphNet(self._model())
+        gn.freeze_up_to("backbone2")
+        assert gn.frozen == {"backbone1", "backbone2"}
+        gn.unfreeze(["backbone1"])
+        assert gn.frozen == {"backbone2"}
+        gn.unfreeze()
+        assert gn.frozen == set()
+
+    def test_freeze_unknown_layer_raises(self):
+        with pytest.raises(ValueError, match="unknown"):
+            GraphNet(self._model()).freeze(["nope"])
+
+    def test_new_graph_feature_extractor(self, zoo_ctx):
+        model = self._model()
+        gn = GraphNet(model).new_graph("backbone2")
+        feats = gn.model
+        feats.compile(optimizer="adam", loss="mse")
+        x = np.random.RandomState(0).randn(8, 6).astype(np.float32)
+        out = feats.predict(x, batch_size=8)
+        assert out.shape == (8, 4)   # backbone2 output, head removed
+
+    def test_new_graph_unknown_raises(self):
+        with pytest.raises(ValueError, match="unknown"):
+            GraphNet(self._model()).new_graph("nope")
