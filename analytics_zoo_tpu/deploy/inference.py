@@ -154,6 +154,36 @@ class InferenceModel:
         return m
 
     @classmethod
+    def load_onnx(cls, path: str, int8: bool = False,
+                  calibration_inputs=None, **kw) -> "InferenceModel":
+        """Serve an .onnx file (onnx/loader.py).  ``int8=True`` runs
+        post-training quantization: Gemm/MatMul nodes execute as int8
+        MXU matmuls (ops/quantization.py) — with ``calibration_inputs``
+        the activation scales are static (calibrated), otherwise dynamic.
+        Replaces the reference's OpenVINO int8 path
+        (InferenceModel.scala:443)."""
+        from analytics_zoo_tpu.onnx import load_onnx
+
+        program = load_onnx(path)
+        if int8:
+            from analytics_zoo_tpu.ops.quantization import quantize_program
+
+            program = quantize_program(program, calibration_inputs)
+
+        @jax.jit
+        def fwd(*xs):
+            out, _ = program.call(program.params, program.state, *xs,
+                                  training=False)
+            return out
+
+        def forward(inputs: List[np.ndarray]):
+            return fwd(*[jnp.asarray(x) for x in inputs])
+
+        m = cls(forward, **kw)
+        m._program, m._int8 = program, int8
+        return m
+
+    @classmethod
     def from_function(cls, fn: Callable, jit: bool = True,
                       **kw) -> "InferenceModel":
         """Serve an arbitrary jax function of the inputs."""

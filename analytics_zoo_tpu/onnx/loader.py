@@ -45,6 +45,22 @@ def _conv_dn(spatial: int):
     return ("NCDHW", "OIDHW", "NCDHW")
 
 
+def _auto_pad_or_pads(attrs, spatial: int):
+    """Resolve ONNX auto_pad/pads to a lax padding spec.  SAME_LOWER
+    (extra pad at the START) has no lax string equivalent — fail loudly
+    rather than shift every activation by one."""
+    auto_pad = attrs.get("auto_pad", b"NOTSET")
+    if isinstance(auto_pad, bytes):
+        auto_pad = auto_pad.decode()
+    if auto_pad == "SAME_UPPER":
+        return "SAME"
+    if auto_pad == "SAME_LOWER":
+        raise UnsupportedOnnxOp(
+            "auto_pad=SAME_LOWER (lax SAME pads at the end; re-export "
+            "with explicit pads)")
+    return _pads_to_lax(attrs.get("pads", []), spatial)
+
+
 # each mapper: (node) -> fn(xs, training, rng) -> array
 # xs are the resolved input arrays in node-input order.
 
@@ -57,21 +73,14 @@ def _mk_conv(node):
         strides = tuple(attrs.get("strides", [1] * spatial))
         dil = tuple(attrs.get("dilations", [1] * spatial))
         groups = int(attrs.get("group", 1))
-        auto_pad = attrs.get("auto_pad", b"NOTSET")
-        if isinstance(auto_pad, bytes):
-            auto_pad = auto_pad.decode()
-        if auto_pad in ("SAME_UPPER", "SAME_LOWER"):
-            padding = "SAME"
-        else:
-            padding = _pads_to_lax(attrs.get("pads", []), spatial)
+        padding = _auto_pad_or_pads(attrs, spatial)
         dn = jax.lax.conv_dimension_numbers(x.shape, w.shape,
                                             _conv_dn(spatial))
         y = jax.lax.conv_general_dilated(
             x, w, strides, padding, rhs_dilation=dil,
             dimension_numbers=dn, feature_group_count=groups)
-        if len(xs) > 2:
-            b = xs[2]
-            y = y + b.reshape((1, -1) + (1,) * spatial)
+        if len(xs) > 2 and xs[2] is not None:
+            y = y + xs[2].reshape((1, -1) + (1,) * spatial)
         return y
 
     return fn
@@ -79,6 +88,8 @@ def _mk_conv(node):
 
 def _mk_pool(node, mode):
     attrs = node.attrs
+    if int(attrs.get("ceil_mode", 0)):
+        raise UnsupportedOnnxOp("pooling with ceil_mode=1")
 
     def fn(xs, training, rng):
         x = xs[0]
@@ -89,7 +100,18 @@ def _mk_pool(node, mode):
             return red(x, axis=axes, keepdims=True)
         ks = tuple(attrs["kernel_shape"])
         strides = tuple(attrs.get("strides", [1] * spatial))
-        pads = _pads_to_lax(attrs.get("pads", []), spatial)
+        resolved = _auto_pad_or_pads(attrs, spatial)
+        if resolved == "SAME":
+            # lax string padding applies to ALL dims; compute explicit
+            # SAME_UPPER pads for the spatial dims only
+            pads = []
+            for i in range(spatial):
+                out = -(-x.shape[2 + i] // strides[i])
+                total = max(0, (out - 1) * strides[i] + ks[i]
+                            - x.shape[2 + i])
+                pads.append((total // 2, total - total // 2))
+        else:
+            pads = resolved
         window = (1, 1) + ks
         strd = (1, 1) + strides
         padding = [(0, 0), (0, 0)] + pads
@@ -152,6 +174,9 @@ def _mk_reduce(red):
 
         def fn(xs, training, rng):
             ax = tuple(axes) if axes else None
+            if ax is None and len(xs) > 1 and xs[1] is not None:
+                # opset>=13 passes axes as a (constant) second input
+                ax = tuple(int(a) for a in np.asarray(xs[1]))
             return red(xs[0], axis=ax, keepdims=keep)
 
         return fn
@@ -240,8 +265,10 @@ def _register_structured():
         hi = node.attrs.get("max")
 
         def fn(xs, t, r):
-            low = xs[1] if len(xs) > 1 else lo
-            high = xs[2] if len(xs) > 2 else hi
+            # omitted optional inputs arrive as None placeholders, so
+            # min/max keep their positions
+            low = xs[1] if len(xs) > 1 and xs[1] is not None else lo
+            high = xs[2] if len(xs) > 2 and xs[2] is not None else hi
             return jnp.clip(xs[0], low, high)
 
         return fn
@@ -275,7 +302,7 @@ def _register_structured():
         def fn(xs, t, r):
             ax = axes if axes is not None else (
                 tuple(int(a) for a in np.asarray(xs[1]))
-                if len(xs) > 1 else None)
+                if len(xs) > 1 and xs[1] is not None else None)
             return jnp.squeeze(xs[0], axis=tuple(ax) if ax else None)
 
         return fn
@@ -314,7 +341,8 @@ def _register_structured():
                 [int(p) for p in np.asarray(xs[1])]
             n = xs[0].ndim
             widths = [(int(pads[i]), int(pads[i + n])) for i in range(n)]
-            value = float(np.asarray(xs[2])) if len(xs) > 2 else 0.0
+            value = (float(np.asarray(xs[2]))
+                     if len(xs) > 2 and xs[2] is not None else 0.0)
             if mode == "constant":
                 return jnp.pad(xs[0], widths, constant_values=value)
             return jnp.pad(xs[0], widths,
@@ -366,6 +394,16 @@ def _register_structured():
 _register_structured()
 
 
+def _resolve_inputs(env: Dict[str, Any], names: Sequence[str]) -> List:
+    """Resolve a node's inputs: trailing omitted optionals ("") are
+    dropped, interior ones become None PLACEHOLDERS so later inputs keep
+    their spec positions (e.g. Clip with min omitted but max given)."""
+    names = list(names)
+    while names and not names[-1]:
+        names.pop()
+    return [env[i] if i else None for i in names]
+
+
 class OnnxProgram:
     """Topologically ordered op list over a name-keyed tensor env.
 
@@ -407,7 +445,7 @@ class OnnxProgram:
         rngs = (jax.random.split(rng, max(1, len(self.nodes)))
                 if rng is not None else [None] * len(self.nodes))
         for (n, fn), r in zip(self.nodes, rngs):
-            xs = [env[i] for i in n.inputs if i]
+            xs = _resolve_inputs(env, n.inputs)
             out = fn(xs, training, r)
             env[n.outputs[0]] = out
             for extra in n.outputs[1:]:

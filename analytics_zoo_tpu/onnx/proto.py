@@ -320,8 +320,8 @@ def _encode_tensor(t: Tensor) -> bytes:
 
 def _encode_attr(name: str, value: Any) -> bytes:
     out = _ld(1, name.encode())
-    if isinstance(value, float):
-        out += _key(2, 5) + struct.pack("<f", value)
+    if isinstance(value, (float, np.floating)):
+        out += _key(2, 5) + struct.pack("<f", float(value))
         out += _key(20, 0) + _write_varint(1)
     elif isinstance(value, (bool, int, np.integer)):
         out += _key(3, 0) + _write_varint(int(value))
@@ -332,12 +332,12 @@ def _encode_attr(name: str, value: Any) -> bytes:
     elif isinstance(value, Tensor):
         out += _ld(5, _encode_tensor(value))
         out += _key(20, 0) + _write_varint(4)
-    elif isinstance(value, (list, tuple)) and value \
-            and isinstance(value[0], float):
+    elif isinstance(value, (list, tuple, np.ndarray)) and len(value) \
+            and any(isinstance(v, (float, np.floating)) for v in value):
         for v in value:
-            out += _key(7, 5) + struct.pack("<f", v)
+            out += _key(7, 5) + struct.pack("<f", float(v))
         out += _key(20, 0) + _write_varint(6)
-    elif isinstance(value, (list, tuple)):
+    elif isinstance(value, (list, tuple, np.ndarray)):
         for v in value:
             out += _key(8, 0) + _write_varint(int(v))
         out += _key(20, 0) + _write_varint(7)

@@ -169,6 +169,35 @@ class TestOnnxLoader:
         with pytest.raises(UnsupportedOnnxOp, match="NonMaxSuppression"):
             load_onnx_bytes(proto.encode_model(proto.Model(graph=g)))
 
+    def test_clip_omitted_min_keeps_max_position(self):
+        # ONNX marks omitted optionals with "": Clip(x, '', max) must
+        # clamp ABOVE only, never treat max as the min bound
+        g = proto.Graph(
+            nodes=[proto.Node("Constant", "c", [], ["mx"],
+                              {"value": proto.tensor_from_array(
+                                  "mxv", np.asarray(0.5, np.float32))}),
+                   proto.Node("Clip", "cl", ["x", "", "mx"], ["y"])],
+            inputs=[_vi("x", (None, 4))], outputs=[_vi("y", (None, 4))])
+        prog = load_onnx_bytes(proto.encode_model(proto.Model(graph=g)))
+        x = np.asarray([[-2.0, -0.1, 0.3, 2.0]], np.float32)
+        out, _ = prog.call({}, {}, jnp.asarray(x))
+        np.testing.assert_allclose(np.asarray(out),
+                                   [[-2.0, -0.1, 0.3, 0.5]], rtol=1e-6)
+
+    def test_reduce_axes_as_input(self):
+        # opset>=13 passes axes as a constant input tensor
+        g = proto.Graph(
+            nodes=[proto.Node("Constant", "c", [], ["ax"],
+                              {"value": proto.tensor_from_array(
+                                  "axv", np.asarray([1], np.int64))}),
+                   proto.Node("ReduceSum", "rs", ["x", "ax"], ["y"],
+                              {"keepdims": 0})],
+            inputs=[_vi("x", (None, 3))], outputs=[_vi("y", (None,))])
+        prog = load_onnx_bytes(proto.encode_model(proto.Model(graph=g)))
+        x = np.arange(6, dtype=np.float32).reshape(2, 3)
+        out, _ = prog.call({}, {}, jnp.asarray(x))
+        np.testing.assert_allclose(np.asarray(out), x.sum(1), rtol=1e-6)
+
     def test_elementwise_and_reduce_ops(self):
         g = proto.Graph(
             nodes=[

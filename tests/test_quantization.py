@@ -1,0 +1,169 @@
+"""INT8 compute-path tests (reference int8 calibration ~2x claim,
+wp-bigdl.md:192): int8 matmul numerics, calibration, program-level PTQ,
+and the InferenceModel.load_onnx(int8=True) path."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.deploy.inference import InferenceModel
+from analytics_zoo_tpu.onnx import load_onnx_bytes, proto
+from analytics_zoo_tpu.ops.quantization import (Calibrator, int8_dot,
+                                                quantize_program,
+                                                quantize_tensor)
+
+
+def _mlp_bytes(seed=0, hidden=64):
+    rs = np.random.RandomState(seed)
+    w1 = (rs.randn(16, hidden) * 0.2).astype(np.float32)
+    b1 = (rs.randn(hidden) * 0.05).astype(np.float32)
+    w2 = (rs.randn(hidden, 4) * 0.2).astype(np.float32)
+    b2 = np.zeros(4, np.float32)
+    g = proto.Graph(
+        name="mlp",
+        nodes=[proto.Node("Gemm", "g1", ["x", "w1", "b1"], ["h1"]),
+               proto.Node("Relu", "r", ["h1"], ["h2"]),
+               proto.Node("Gemm", "g2", ["h2", "w2", "b2"], ["y"])],
+        initializers=[proto.tensor_from_array("w1", w1),
+                      proto.tensor_from_array("b1", b1),
+                      proto.tensor_from_array("w2", w2),
+                      proto.tensor_from_array("b2", b2)],
+        inputs=[proto.ValueInfo("x", 1, (None, 16))],
+        outputs=[proto.ValueInfo("y", 1, (None, 4))])
+    return proto.encode_model(proto.Model(graph=g))
+
+
+class TestQuantizeTensor:
+    def test_roundtrip_error_bounded(self):
+        w = np.random.RandomState(0).randn(32, 16).astype(np.float32)
+        q, scale = quantize_tensor(w)
+        deq = np.asarray(q, np.float32) * np.asarray(scale)
+        # max error <= half an int8 step per channel
+        step = np.asarray(scale)
+        assert np.all(np.abs(deq - w) <= step / 2 + 1e-7)
+        assert q.dtype == jnp.int8
+        assert scale.shape == (1, 16)
+
+    def test_per_channel_scales(self):
+        w = np.ones((4, 2), np.float32)
+        w[:, 1] = 100.0
+        q, scale = quantize_tensor(w)
+        assert np.asarray(scale)[0, 1] > np.asarray(scale)[0, 0]
+        assert np.all(np.asarray(q)[:, 1] == 127)
+
+    def test_zero_channel_safe(self):
+        w = np.zeros((4, 2), np.float32)
+        q, scale = quantize_tensor(w)
+        assert np.all(np.asarray(q) == 0)
+        assert np.all(np.isfinite(np.asarray(scale)))
+
+
+class TestInt8Dot:
+    def test_close_to_f32(self):
+        rs = np.random.RandomState(1)
+        x = rs.randn(8, 64).astype(np.float32)
+        w = (rs.randn(64, 32) * 0.1).astype(np.float32)
+        q, scale = quantize_tensor(w)
+        y = np.asarray(int8_dot(jnp.asarray(x), q, scale.reshape(-1)))
+        ref = x @ w
+        rel = np.abs(y - ref).max() / (np.abs(ref).max() + 1e-9)
+        assert rel < 0.02, rel
+
+    def test_static_scale_matches_dynamic_at_max(self):
+        rs = np.random.RandomState(2)
+        x = rs.randn(4, 16).astype(np.float32)
+        w = rs.randn(16, 8).astype(np.float32)
+        q, scale = quantize_tensor(w)
+        dyn = int8_dot(jnp.asarray(x), q, scale.reshape(-1))
+        stat = int8_dot(jnp.asarray(x), q, scale.reshape(-1),
+                        x_scale=float(np.abs(x).max() / 127.0))
+        np.testing.assert_allclose(np.asarray(dyn), np.asarray(stat),
+                                   rtol=1e-5)
+
+    def test_int32_accumulation(self):
+        # large reduction dim would overflow int8/int16 accumulation
+        x = np.full((1, 4096), 1.0, np.float32)
+        w = np.full((4096, 1), 1.0, np.float32)
+        q, scale = quantize_tensor(w)
+        y = float(np.asarray(int8_dot(jnp.asarray(x), q,
+                                      scale.reshape(-1)))[0, 0])
+        assert abs(y - 4096.0) / 4096.0 < 0.02
+
+
+class TestCalibrator:
+    def test_records_and_scales(self):
+        cal = Calibrator(percentile=None)
+        cal.observe("a", np.asarray([1.0, -3.0]))
+        cal.observe("a", np.asarray([2.0]))
+        assert cal.scale("a") == pytest.approx(3.0 / 127.0)
+        with pytest.raises(KeyError, match="no calibration"):
+            cal.scale("missing")
+
+    def test_percentile_sheds_outliers(self):
+        rs = np.random.RandomState(0)
+        x = rs.randn(10000).astype(np.float32)
+        x[0] = 1000.0
+        cal = Calibrator(percentile=99.0)
+        cal.observe("a", x)
+        assert cal.scale("a") < 10.0 / 127.0 * 127  # far below the outlier
+
+
+class TestQuantizeProgram:
+    def test_dynamic_ptq_accuracy(self):
+        prog = load_onnx_bytes(_mlp_bytes())
+        qprog = quantize_program(prog, min_size=1)
+        rs = np.random.RandomState(3)
+        x = rs.randn(32, 16).astype(np.float32)
+        ref, _ = prog.call(prog.params, prog.state, jnp.asarray(x))
+        got, _ = qprog.call(qprog.params, qprog.state, jnp.asarray(x))
+        rel = (np.abs(np.asarray(got) - np.asarray(ref)).max()
+               / (np.abs(np.asarray(ref)).max() + 1e-9))
+        assert rel < 0.05, rel
+        assert len(qprog.quantized_nodes) == 2
+        # quantized weights actually live as int8
+        for wq, _ in qprog.qweights.values():
+            assert wq.dtype == jnp.int8
+
+    def test_calibrated_ptq(self):
+        prog = load_onnx_bytes(_mlp_bytes())
+        rs = np.random.RandomState(4)
+        cal_batches = [rs.randn(16, 16).astype(np.float32)
+                       for _ in range(4)]
+        qprog = quantize_program(prog, cal_batches, min_size=1)
+        assert set(qprog.act_scales) == {"g1", "g2"}
+        x = rs.randn(32, 16).astype(np.float32)
+        ref, _ = prog.call(prog.params, prog.state, jnp.asarray(x))
+        got, _ = qprog.call(qprog.params, qprog.state, jnp.asarray(x))
+        rel = (np.abs(np.asarray(got) - np.asarray(ref)).max()
+               / (np.abs(np.asarray(ref)).max() + 1e-9))
+        assert rel < 0.08, rel
+
+    def test_small_weights_not_quantized(self):
+        prog = load_onnx_bytes(_mlp_bytes())
+        qprog = quantize_program(prog, min_size=10 ** 9)
+        assert qprog.quantized_nodes == []
+        x = np.zeros((2, 16), np.float32)
+        ref, _ = prog.call(prog.params, prog.state, jnp.asarray(x))
+        got, _ = qprog.call(qprog.params, qprog.state, jnp.asarray(x))
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=1e-6)
+
+
+class TestInferenceModelInt8:
+    def test_load_onnx_int8_serving(self, tmp_path, zoo_ctx):
+        p = str(tmp_path / "m.onnx")
+        with open(p, "wb") as f:
+            f.write(_mlp_bytes())
+        rs = np.random.RandomState(5)
+        cal = [rs.randn(8, 16).astype(np.float32) for _ in range(2)]
+        m32 = InferenceModel.load_onnx(p)
+        m8 = InferenceModel.load_onnx(p, int8=True, calibration_inputs=cal)
+        x = rs.randn(20, 16).astype(np.float32)
+        y32 = m32.predict(x)
+        y8 = m8.predict(x)
+        assert y8.shape == y32.shape == (20, 4)
+        rel = np.abs(y8 - y32).max() / (np.abs(y32).max() + 1e-9)
+        assert rel < 0.08, rel
+        assert m8._int8 and m8._program.quantized_nodes
