@@ -56,17 +56,24 @@ def quantize_pytree(params, min_size: int = 1024):
     ``{"q": int8 array, "scale": f32 per-last-axis-channel}``; small or
     non-float leaves pass through unchanged.
     """
+    from analytics_zoo_tpu.ops.quantization import quantize_tensor
+
     def one(leaf):
         a = np.asarray(leaf)
         if a.dtype.kind != "f" or a.size < min_size or a.ndim == 0:
             return leaf
-        # per-channel (last axis) for >=2-D; per-tensor for 1-D (a
-        # per-element scale would be larger than the original weights)
-        axes = tuple(range(a.ndim - 1)) if a.ndim >= 2 else (0,)
-        amax = np.max(np.abs(a), axis=axes, keepdims=True)
-        scale = np.where(amax > 0, amax / 127.0, 1.0).astype(np.float32)
-        q = np.clip(np.round(a / scale), -127, 127).astype(np.int8)
-        return {"q": q, "scale": scale.astype(np.float32)}
+        # per-channel (last axis) for >=2-D; 1-D uses the same machinery
+        # with its single axis (ONE shared int8 scheme — see
+        # ops/quantization.quantize_tensor)
+        if a.ndim >= 2:
+            q, scale = quantize_tensor(a, axis=-1)
+        else:
+            amax = np.max(np.abs(a))
+            scale = jnp.asarray([amax / 127.0 if amax > 0 else 1.0],
+                                jnp.float32)
+            q = jnp.clip(jnp.round(jnp.asarray(a) / scale), -127,
+                         127).astype(jnp.int8)
+        return {"q": np.asarray(q), "scale": np.asarray(scale, np.float32)}
 
     return jax.tree_util.tree_map(one, params)
 

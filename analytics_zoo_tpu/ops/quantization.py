@@ -112,8 +112,18 @@ class QuantizedProgram:
         self.act_scales = dict(act_scales or {})
         self.qweights: Dict[str, Tuple[jnp.ndarray, jnp.ndarray]] = {}
         self.quantized_nodes: List[str] = []
-        params = dict(program.params)
+        # dispatch by NODE (index), not weight name: a weight shared with
+        # a non-quantizable consumer must stay in params, and a skipped
+        # (e.g. transposed) Gemm on a quantized weight must not silently
+        # take the int8 path
+        self._qnode_idx: set = set()
+        consumers: Dict[str, int] = {}
         for n, _ in program.nodes:
+            for i in n.inputs:
+                if i:
+                    consumers[i] = consumers.get(i, 0) + 1
+        params = dict(program.params)
+        for idx, (n, _) in enumerate(program.nodes):
             if n.op_type not in self._QUANT_OPS or len(n.inputs) < 2:
                 continue
             wname = n.inputs[1]
@@ -121,11 +131,14 @@ class QuantizedProgram:
                 continue
             if int(n.attrs.get("transA", 0)) or int(n.attrs.get("transB", 0)):
                 continue                       # transposed Gemm: skip
+            if consumers.get(wname, 0) != 1:
+                continue                       # shared initializer: skip
             w = params[wname]
             if w.size < min_size:
                 continue
             self.qweights[wname] = quantize_tensor(w, axis=-1)
             self.quantized_nodes.append(n.name or wname)
+            self._qnode_idx.add(idx)
             del params[wname]
         self.params = params
         self.consts = program.consts
@@ -139,9 +152,9 @@ class QuantizedProgram:
         env: Dict[str, Any] = dict(self.consts)
         env.update(params)
         env.update(zip(self.input_names, inputs))
-        for n, fn in self.base.nodes:
-            wname = n.inputs[1] if len(n.inputs) > 1 else None
-            if n.op_type in self._QUANT_OPS and wname in self.qweights:
+        for idx, (n, fn) in enumerate(self.base.nodes):
+            if idx in self._qnode_idx:
+                wname = n.inputs[1]
                 x = env[n.inputs[0]]
                 w_q, w_scale = self.qweights[wname]
                 key = n.name or wname
@@ -149,7 +162,7 @@ class QuantizedProgram:
                              x_scale=self.act_scales.get(key))
                 if n.op_type == "Gemm":
                     y = float(n.attrs.get("alpha", 1.0)) * y
-                    if len(n.inputs) > 2:
+                    if len(n.inputs) > 2 and n.inputs[2]:
                         y = y + float(n.attrs.get("beta", 1.0)) \
                             * env[n.inputs[2]]
                 out = y

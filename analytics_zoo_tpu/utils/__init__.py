@@ -1,0 +1,16 @@
+"""Utility surface (reference pyzoo/zoo/util/: nest.py structure
+flatten/pack, tf.py graph export helpers, common file utils).
+
+``nest`` flatten/pack mirrors the reference's nest.py (itself the
+tf.nest contract); graph export collapses into
+``nn.net.Net.export_tf_saved_model`` (jax2tf) — the reference's
+freeze-graph machinery (util/tf.py:50-199) has no meaning without a TF
+session in the loop.
+"""
+
+from analytics_zoo_tpu.utils.common import get_file_list, to_list
+from analytics_zoo_tpu.utils.nest import (flatten, map_structure,
+                                          pack_sequence_as)
+
+__all__ = ["flatten", "pack_sequence_as", "map_structure",
+           "get_file_list", "to_list"]

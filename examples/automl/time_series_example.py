@@ -1,0 +1,45 @@
+"""AutoML time-series forecasting (reference automl notebook flow:
+TimeSequencePredictor.fit -> pipeline.predict/evaluate/save)."""
+
+import argparse
+import tempfile
+
+import numpy as np
+import pandas as pd
+
+from analytics_zoo_tpu import init_zoo_context
+from analytics_zoo_tpu.automl import (SmokeRecipe, TimeSequencePredictor,
+                                      load_ts_pipeline)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=300)
+    args = ap.parse_args()
+
+    init_zoo_context()
+    dt = pd.date_range("2019-01-01", periods=args.n, freq="h")
+    value = (np.sin(2 * np.pi * np.arange(args.n) / 24) + 2).astype(
+        np.float32)
+    df = pd.DataFrame({"datetime": dt, "value": value})
+    train, test = df.iloc[:int(args.n * 0.8)], df.iloc[int(args.n * 0.8):]
+
+    class Recipe(SmokeRecipe):
+        def search_space(self, feats):
+            s = super().search_space(feats)
+            s.update(past_seq_len=12, epochs=8)
+            return s
+
+    tsp = TimeSequencePredictor(future_seq_len=1)
+    pipeline = tsp.fit(train, metric="mse", recipe=Recipe())
+    print("test rmse:", pipeline.evaluate(test, metric="rmse"))
+    pred = pipeline.predict(test)
+    print(pred.tail(3))
+
+    d = tempfile.mkdtemp()
+    pipeline.save(d)
+    print("reloaded rmse:", load_ts_pipeline(d).evaluate(test, "rmse"))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,46 @@
+"""Image classification predict pipeline (reference
+imageclassification/Predict.scala): ImageSet -> preprocess -> top-k."""
+
+import argparse
+import os
+import tempfile
+
+import numpy as np
+
+from analytics_zoo_tpu import init_zoo_context
+from analytics_zoo_tpu.data.image import ImageSet
+from analytics_zoo_tpu.models.image.imageclassification import (
+    ImageClassifier)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--image-dir", default=None,
+                    help="directory of images (default: generated)")
+    ap.add_argument("--model", default="mobilenet",
+                    choices=["resnet-50", "inception-v1", "mobilenet",
+                             "vgg-16"])
+    ap.add_argument("--classes", type=int, default=10)
+    args = ap.parse_args()
+
+    init_zoo_context()
+    if args.image_dir is None:
+        import cv2
+
+        args.image_dir = tempfile.mkdtemp()
+        rs = np.random.RandomState(0)
+        for i in range(4):
+            cv2.imwrite(os.path.join(args.image_dir, f"im{i}.jpg"),
+                        rs.randint(0, 255, (96, 96, 3)).astype(np.uint8))
+
+    clf = ImageClassifier(model_name=args.model, class_num=args.classes)
+    clf.compile(optimizer="adam",
+                loss="sparse_categorical_crossentropy_with_logits")
+    images = ImageSet.read(args.image_dir)
+    topk = clf.predict_image_set(images, batch_size=4, top_k=3)
+    for i, classes in enumerate(topk):
+        print(f"image {i}: top-3 classes {classes.tolist()}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,41 @@
+"""Serving with InferenceModel: native load, dynamic batching, int8
+(reference inference examples + vnni int8 examples)."""
+
+import argparse
+import tempfile
+
+import numpy as np
+
+from analytics_zoo_tpu import init_zoo_context
+from analytics_zoo_tpu.deploy.inference import DynamicBatcher, InferenceModel
+from analytics_zoo_tpu.models import NeuralCF
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--int8", action="store_true")
+    args = ap.parse_args()
+
+    init_zoo_context()
+    ncf = NeuralCF(user_count=100, item_count=80, class_num=5)
+    ncf.compile(optimizer="adam", loss="sparse_categorical_crossentropy")
+    u = np.random.randint(1, 100, (256, 1)).astype(np.int32)
+    it = np.random.randint(1, 80, (256, 1)).astype(np.int32)
+    y = np.random.randint(0, 5, 256).astype(np.int32)
+    ncf.fit([u, it], y, batch_size=64, nb_epoch=1, verbose=False)
+    path = tempfile.mkdtemp() + "/model.zoo"
+    ncf.save_model(path)
+
+    m = InferenceModel.load(path, int8=args.int8)
+    preds = m.predict([u[:10], it[:10]])
+    print(f"int8={args.int8} predictions:", np.argmax(preds, -1))
+
+    batcher = DynamicBatcher(m, max_batch=64, max_latency_ms=5)
+    outs = [batcher.predict([u[i:i + 1], it[i:i + 1]]) for i in range(8)]
+    batcher.close()
+    print("dynamic-batched single-row requests:",
+          [int(np.argmax(o)) for o in outs])
+
+
+if __name__ == "__main__":
+    main()

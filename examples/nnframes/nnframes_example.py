@@ -1,0 +1,40 @@
+"""NNFrames DataFrame pipeline (reference nnframes examples):
+NNClassifier.fit(DataFrame) -> NNClassifierModel.transform."""
+
+import argparse
+
+import numpy as np
+import pandas as pd
+
+from analytics_zoo_tpu import init_zoo_context
+from analytics_zoo_tpu.nn.layers.core import Dense
+from analytics_zoo_tpu.nn.topology import Sequential
+from analytics_zoo_tpu.nnframes import NNClassifier
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=8)
+    args = ap.parse_args()
+
+    init_zoo_context()
+    rs = np.random.RandomState(0)
+    x = rs.randn(512, 6).astype(np.float32)
+    y = (x[:, :3].sum(1) > 0).astype(np.int64)
+    df = pd.DataFrame({"features": list(x), "label": y})
+
+    net = Sequential()
+    net.add(Dense(16, activation="relu", input_shape=(6,)))
+    net.add(Dense(2, activation="softmax"))
+
+    clf = (NNClassifier(net).setBatchSize(64).setMaxEpoch(args.epochs)
+           .setLearningRate(1e-2))
+    model = clf.fit(df)
+    out = model.transform(df)
+    acc = float((out["prediction"].to_numpy() == y).mean())
+    print(f"pipeline accuracy: {acc:.3f}")
+    print(out.head(3))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,63 @@
+"""SSD object detection: train on synthetic boxes, run detection
+(reference examples/objectdetection/Predict.scala + fine-tune flow).
+Use --voc-annotations to read a real Pascal VOC annotation dir."""
+
+import argparse
+
+import numpy as np
+
+from analytics_zoo_tpu import init_zoo_context
+from analytics_zoo_tpu.data.datasets import read_pascal_voc
+from analytics_zoo_tpu.models.objectdetection import ObjectDetector
+
+SMALL_CONFIG = {
+    "image_size": 64,
+    "feature_sizes": (8, 4, 2, 1, 1, 1),
+    "min_sizes": (6, 13, 26, 38, 51, 58),
+    "max_sizes": (13, 26, 38, 51, 58, 70),
+    "aspect_ratios": ((2,), (2, 3), (2, 3), (2, 3), (2,), (2,)),
+}
+
+
+def synthetic_detection_data(n=32, size=64, seed=0):
+    """Bright rectangles on noise; boxes normalized x1y1x2y2."""
+    rs = np.random.RandomState(seed)
+    imgs = rs.rand(n, size, size, 3).astype(np.float32) * 0.2
+    boxes = np.zeros((n, 1, 4), np.float32)
+    labels = np.ones((n, 1), np.int64)
+    for i in range(n):
+        w, h = rs.randint(16, 40, 2)
+        x, y = rs.randint(0, size - w), rs.randint(0, size - h)
+        imgs[i, y:y + h, x:x + w] = 1.0
+        boxes[i, 0] = (x / size, y / size, (x + w) / size, (y + h) / size)
+    return imgs, boxes, labels
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--voc-annotations", default=None,
+                    help="Pascal VOC Annotations/ dir (stats only)")
+    ap.add_argument("--epochs", type=int, default=3)
+    ap.add_argument("--n", type=int, default=32)
+    args = ap.parse_args()
+
+    init_zoo_context()
+    if args.voc_annotations:
+        recs = read_pascal_voc(args.voc_annotations)
+        print(f"VOC: {len(recs)} annotated images, "
+              f"{sum(len(r['labels']) for r in recs)} boxes")
+
+    imgs, boxes, labels = synthetic_detection_data(args.n)
+    det = ObjectDetector(class_num=2, config=SMALL_CONFIG)
+    det.compile(optimizer="adam", loss=det.loss())
+    det.fit_detection(imgs, boxes, labels, batch_size=8,
+                      nb_epoch=args.epochs, verbose=False)
+    results = det.detect(imgs[:4], score_threshold=0.2)
+    for i, (b, s, l) in enumerate(results):
+        keep = s > 0.2
+        print(f"image {i}: {int(keep.sum())} detections, "
+              f"best score {float(s.max()):.3f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,61 @@
+"""NeuralCF on MovieLens(-shaped) data with negative sampling
+(reference examples/recommendation/NeuralCFexample.scala:44-120).
+
+    python ncf_example.py                       # synthetic ml-1m shape
+    python ncf_example.py --data ml-1m/         # real ratings.dat
+"""
+
+import argparse
+
+import numpy as np
+
+from analytics_zoo_tpu import init_zoo_context
+from analytics_zoo_tpu.data.datasets import (generate_movielens_like,
+                                             read_movielens_1m)
+from analytics_zoo_tpu.models import NeuralCF
+from analytics_zoo_tpu.models.recommendation import negative_sample
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--data", default=None,
+                    help="ml-1m dir or ratings.dat (default: synthetic)")
+    ap.add_argument("--batch-size", type=int, default=2048)
+    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--users", type=int, default=2000)
+    ap.add_argument("--items", type=int, default=1500)
+    args = ap.parse_args()
+
+    init_zoo_context(steps_per_execution=8)
+    if args.data:
+        users, items, ratings = read_movielens_1m(args.data)
+        n_users, n_items = int(users.max()), int(items.max())
+    else:
+        users, items, ratings = generate_movielens_like(
+            n_users=args.users, n_items=args.items)
+        n_users, n_items = args.users, args.items
+
+    # implicit feedback: 4 sampled negatives per positive
+    tr_u, tr_i, tr_y = negative_sample(users, items, n_items,
+                                       neg_per_pos=4)
+    ncf = NeuralCF(user_count=n_users, item_count=n_items, class_num=2,
+                   user_embed=20, item_embed=20,
+                   hidden_layers=(40, 20, 10), mf_embed=20)
+    ncf.compile(optimizer="adam", loss="sparse_categorical_crossentropy",
+                metrics=["accuracy"])
+    ncf.fit([tr_u[:, None].astype(np.int32),
+             tr_i[:, None].astype(np.int32)],
+            tr_y.astype(np.int32), batch_size=args.batch_size,
+            nb_epoch=args.epochs)
+    res = ncf.evaluate([tr_u[:, None].astype(np.int32),
+                        tr_i[:, None].astype(np.int32)],
+                       tr_y.astype(np.int32), batch_size=args.batch_size)
+    print("train-set eval:", res)
+
+    recs = ncf.recommend_for_user(1, np.arange(1, n_items + 1),
+                                  max_items=5)
+    print("top-5 recommendations for user 1:", recs)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,51 @@
+"""Wide & Deep recommendation (reference WideAndDeepExample.scala):
+wide cross features + deep embeddings + continuous columns."""
+
+import argparse
+
+import numpy as np
+
+from analytics_zoo_tpu import init_zoo_context
+from analytics_zoo_tpu.models import WideAndDeep
+
+
+def synthetic_census(n=2048, seed=0):
+    rs = np.random.RandomState(seed)
+    wide_base = rs.randint(0, 100, (n, 2))       # e.g. occupation, edu
+    wide_cross = rs.randint(0, 1000, (n, 1))     # crossed buckets
+    # wide ids index ONE shared linear table: offset each column by the
+    # cumulative dims before it (100, 100, 1000)
+    wide = np.concatenate(
+        [wide_base[:, :1], wide_base[:, 1:] + 100, wide_cross + 200],
+        axis=1)
+    indicator = np.zeros((n, 10), np.float32)    # multi-hot width 10
+    indicator[np.arange(n), rs.randint(0, 10, n)] = 1.0
+    embed = rs.randint(0, 100, (n, 2))
+    continuous = rs.randn(n, 3).astype(np.float32)
+    logits = (wide_base[:, 0] % 3) + continuous[:, 0] * 2
+    label = (logits > 1).astype(np.int32)
+    return [wide.astype(np.int32), indicator, embed.astype(np.int32),
+            continuous], label
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=4)
+    ap.add_argument("--model-type", default="wide_n_deep",
+                    choices=["wide", "deep", "wide_n_deep"])
+    args = ap.parse_args()
+
+    init_zoo_context()
+    xs, y = synthetic_census()
+    wnd = WideAndDeep(class_num=2, model_type=args.model_type,
+                      wide_base_dims=(100, 100), wide_cross_dims=(1000,),
+                      indicator_dims=(10,), embed_in_dims=(100, 100),
+                      embed_out_dims=(8, 8), continuous_cols=3)
+    wnd.compile(optimizer="adam", loss="sparse_categorical_crossentropy",
+                metrics=["accuracy"])
+    wnd.fit(xs, y, batch_size=128, nb_epoch=args.epochs)
+    print("eval:", wnd.evaluate(xs, y, batch_size=256))
+
+
+if __name__ == "__main__":
+    main()

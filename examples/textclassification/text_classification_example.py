@@ -1,0 +1,51 @@
+"""Text classification through the TextSet pipeline
+(reference examples/textclassification/TextClassification.scala:
+tokenize -> word2idx -> shape -> CNN classifier)."""
+
+import argparse
+
+import numpy as np
+
+from analytics_zoo_tpu import init_zoo_context
+from analytics_zoo_tpu.data.datasets import (generate_text_classification,
+                                             read_text_folder)
+from analytics_zoo_tpu.data.text import TextSet
+from analytics_zoo_tpu.models.text import TextClassifier
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--data", default=None,
+                    help="folder-per-class corpus (default: synthetic)")
+    ap.add_argument("--classes", type=int, default=4)
+    ap.add_argument("--seq-len", type=int, default=64)
+    ap.add_argument("--epochs", type=int, default=4)
+    ap.add_argument("--encoder", default="cnn",
+                    choices=["cnn", "lstm", "gru"])
+    args = ap.parse_args()
+
+    init_zoo_context()
+    if args.data:
+        texts, labels, class_map = read_text_folder(args.data)
+        n_classes = len(class_map)
+    else:
+        texts, labels = generate_text_classification(args.classes)
+        n_classes = args.classes
+
+    ts = (TextSet.from_texts(texts, labels)
+          .tokenize().normalize().word2idx(max_words_num=5000)
+          .shape_sequence(args.seq_len))
+    x, y = ts.to_arrays()
+
+    clf = TextClassifier(class_num=n_classes, token_length=32,
+                         sequence_length=args.seq_len,
+                         encoder=args.encoder, encoder_output_dim=64,
+                         max_words_num=5000)
+    clf.compile(optimizer="adam", loss="sparse_categorical_crossentropy",
+                metrics=["accuracy"])
+    clf.fit(x, y.astype(np.int32), batch_size=32, nb_epoch=args.epochs)
+    print("eval:", clf.evaluate(x, y.astype(np.int32), batch_size=32))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,45 @@
+"""TFPark: train a tf.keras model natively on the TPU engine
+(reference pyzoo/zoo/examples/tfpark/keras/keras_dataset.py)."""
+
+import argparse
+
+import numpy as np
+
+from analytics_zoo_tpu import init_zoo_context
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=3)
+    args = ap.parse_args()
+
+    try:
+        import tensorflow as tf
+    except ImportError:
+        print("tensorflow not installed; this example needs tf.keras")
+        return
+
+    from analytics_zoo_tpu.tfpark import KerasModel, TFDataset
+
+    init_zoo_context()
+    kmodel = tf.keras.Sequential([
+        tf.keras.layers.Input(shape=(20,)),
+        tf.keras.layers.Dense(32, activation="relu"),
+        tf.keras.layers.Dense(2, activation="softmax")])
+    kmodel.compile(optimizer="adam",
+                   loss="sparse_categorical_crossentropy")
+
+    rs = np.random.RandomState(0)
+    x = rs.randn(1024, 20).astype(np.float32)
+    y = (x[:, 0] + x[:, 1] > 0).astype(np.int32)
+    ds = TFDataset.from_ndarrays((x, y), batch_size=64)
+
+    model = KerasModel(kmodel)          # converted to pure JAX
+    model.fit(ds, epochs=args.epochs)
+    print("eval:", model.evaluate(x, y, batch_size=64))
+    kmodel = model.to_keras()           # weights written back to tf.keras
+    print("round-trip to tf.keras done:", type(kmodel).__name__)
+
+
+if __name__ == "__main__":
+    main()

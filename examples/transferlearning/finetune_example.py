@@ -1,0 +1,50 @@
+"""Transfer learning with GraphNet surgery (reference transferlearning
+examples + NetUtils.scala): freeze a pretrained backbone, replace the
+head via new_graph, fine-tune only the new layers."""
+
+import argparse
+
+import numpy as np
+
+from analytics_zoo_tpu import init_zoo_context
+from analytics_zoo_tpu.nn import reset_name_scope
+from analytics_zoo_tpu.nn.autograd import Input
+from analytics_zoo_tpu.nn.layers import Dense
+from analytics_zoo_tpu.nn.net import GraphNet
+from analytics_zoo_tpu.nn.topology import Model
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=6)
+    args = ap.parse_args()
+
+    init_zoo_context()
+    reset_name_scope()
+    # "pretrained" source model: backbone + old 10-class head
+    inp = Input(shape=(16,))
+    f = Dense(32, activation="relu", name="feat1")(inp)
+    f = Dense(16, activation="relu", name="feat2")(f)
+    old_head = Dense(10, activation="softmax", name="old_head")(f)
+    source = Model(inp, old_head)
+
+    # surgery: cut at feat2, attach a fresh 3-class head, freeze backbone
+    feats = GraphNet(source).new_graph("feat2").model
+    new_out = Dense(3, activation="softmax", name="new_head")(
+        feats.outputs[0])
+    target = Model(feats.inputs, new_out)
+    GraphNet(target).freeze(["feat1", "feat2"])
+
+    target.compile(optimizer="adam",
+                   loss="sparse_categorical_crossentropy",
+                   metrics=["accuracy"])
+    rs = np.random.RandomState(0)
+    x = rs.randn(256, 16).astype(np.float32)
+    y = (x[:, :5].sum(1) > 0).astype(np.int32) + (x[:, 0] > 1)
+    target.fit(x, y, batch_size=32, nb_epoch=args.epochs)
+    print("fine-tuned eval:", target.evaluate(x, y, batch_size=64))
+    print("frozen:", sorted(GraphNet(target).frozen))
+
+
+if __name__ == "__main__":
+    main()

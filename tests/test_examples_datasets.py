@@ -1,0 +1,147 @@
+"""Dataset readers + runnable-example smoke tests (the reference ships
+39+64 examples and dedicated dataset readers; these verify ours parse
+real file formats and that the example scripts actually run)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.data.datasets import (generate_movielens_like,
+                                             generate_text_classification,
+                                             read_coco, read_movielens_1m,
+                                             read_pascal_voc,
+                                             read_text_folder)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class TestMovieLens:
+    def test_read_ratings_dat(self, tmp_path):
+        f = tmp_path / "ratings.dat"
+        f.write_text("1::31::4.0::978300760\n2::1029::3.5::978302109\n"
+                     "bad line\n1::1293::2.0::978300055\n")
+        u, i, r = read_movielens_1m(str(tmp_path))
+        np.testing.assert_array_equal(u, [1, 2, 1])
+        np.testing.assert_array_equal(i, [31, 1029, 1293])
+        np.testing.assert_allclose(r, [4.0, 3.5, 2.0])
+
+    def test_generated_shape_and_structure(self):
+        u, i, r = generate_movielens_like(n_users=50, n_items=40,
+                                          ratings_per_user=5)
+        assert len(u) == 250
+        assert u.min() >= 1 and u.max() <= 50
+        assert i.min() >= 1 and i.max() <= 40
+        assert set(np.unique(r)) <= {1., 2., 3., 4., 5.}
+
+
+class TestVocCoco:
+    def test_read_pascal_voc(self, tmp_path):
+        xml = """<annotation>
+  <filename>000001.jpg</filename>
+  <size><width>353</width><height>500</height><depth>3</depth></size>
+  <object><name>dog</name><difficult>0</difficult>
+    <bndbox><xmin>48</xmin><ymin>240</ymin><xmax>195</xmax>
+    <ymax>371</ymax></bndbox></object>
+  <object><name>person</name><difficult>1</difficult>
+    <bndbox><xmin>8</xmin><ymin>12</ymin><xmax>352</xmax>
+    <ymax>498</ymax></bndbox></object>
+</annotation>"""
+        (tmp_path / "000001.xml").write_text(xml)
+        recs = read_pascal_voc(str(tmp_path))
+        assert len(recs) == 1
+        r = recs[0]
+        assert r["file"] == "000001.jpg"
+        assert (r["width"], r["height"]) == (353, 500)
+        assert len(r["labels"]) == 1           # difficult dropped
+        np.testing.assert_allclose(r["bboxes"][0], [48, 240, 195, 371])
+        recs = read_pascal_voc(str(tmp_path), keep_difficult=True)
+        assert len(recs[0]["labels"]) == 2
+
+    def test_read_coco(self, tmp_path):
+        blob = {
+            "images": [{"id": 7, "file_name": "a.jpg", "width": 100,
+                        "height": 80}],
+            "annotations": [
+                {"image_id": 7, "bbox": [10, 20, 30, 40],
+                 "category_id": 3},
+                {"image_id": 7, "bbox": [0, 0, 5, 5], "category_id": 1}],
+        }
+        f = tmp_path / "instances.json"
+        f.write_text(json.dumps(blob))
+        recs = read_coco(str(f))
+        assert len(recs) == 1
+        np.testing.assert_allclose(recs[0]["bboxes"][0], [10, 20, 40, 60])
+        np.testing.assert_array_equal(recs[0]["labels"], [3, 1])
+
+
+class TestTextCorpora:
+    def test_read_text_folder(self, tmp_path):
+        for cls, txt in [("pos", "great movie"), ("neg", "terrible")]:
+            d = tmp_path / cls
+            d.mkdir()
+            (d / "a.txt").write_text(txt)
+            (d / "b.txt").write_text(txt + " again")
+        texts, labels, cmap = read_text_folder(str(tmp_path))
+        assert len(texts) == 4
+        assert cmap == {"neg": 0, "pos": 1}
+        assert labels.tolist() == [0, 0, 1, 1]
+
+    def test_generated_is_learnable_shape(self):
+        texts, labels = generate_text_classification(n_classes=3,
+                                                     per_class=10)
+        assert len(texts) == 30
+        assert set(labels) == {0, 1, 2}
+        # class keyword separation exists
+        assert any("w0_" in t for t in texts[:10])
+
+
+def _run_example(rel, *args, timeout=420):
+    # force-pure-CPU subprocess: drop any accelerator-plugin sitecustomize
+    # dirs from PYTHONPATH (they re-force their platform and would hang
+    # the example on an unreachable device)
+    extra = [p for p in os.environ.get("PYTHONPATH", "").split(os.pathsep)
+             if p and "axon" not in p]
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=os.pathsep.join([REPO] + extra))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", rel), *args],
+        capture_output=True, text=True, timeout=timeout, env=env)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    return proc.stdout
+
+
+@pytest.mark.examples
+class TestExamplesRun:
+    def test_ncf_example(self):
+        out = _run_example("recommendation/ncf_example.py",
+                           "--users", "120", "--items", "90",
+                           "--batch-size", "256", "--epochs", "1")
+        assert "top-5 recommendations" in out
+
+    def test_anomaly_example(self):
+        out = _run_example(
+            "anomalydetection/anomaly_detection_example.py",
+            "--n", "400", "--epochs", "1")
+        assert "flagged" in out
+
+    def test_transfer_learning_example(self):
+        out = _run_example("transferlearning/finetune_example.py",
+                           "--epochs", "2")
+        assert "frozen: ['feat1', 'feat2']" in out
+
+    def test_inference_example(self):
+        out = _run_example("inference/inference_model_example.py")
+        assert "dynamic-batched" in out
+
+    def test_automl_example(self):
+        out = _run_example("automl/time_series_example.py", "--n", "200")
+        assert "reloaded rmse" in out
+
+    def test_nnframes_example(self):
+        out = _run_example("nnframes/nnframes_example.py",
+                           "--epochs", "4")
+        assert "pipeline accuracy" in out
