@@ -70,8 +70,10 @@ def _mk_conv(node):
     def fn(xs, training, rng):
         x, w = xs[0], xs[1]
         spatial = x.ndim - 2
-        strides = tuple(attrs.get("strides", [1] * spatial))
-        dil = tuple(attrs.get("dilations", [1] * spatial))
+        strides = tuple(int(v) for v in attrs.get("strides",
+                                                  [1] * spatial))
+        dil = tuple(int(v) for v in attrs.get("dilations",
+                                              [1] * spatial))
         groups = int(attrs.get("group", 1))
         padding = _auto_pad_or_pads(attrs, spatial)
         dn = jax.lax.conv_dimension_numbers(x.shape, w.shape,
@@ -98,8 +100,9 @@ def _mk_pool(node, mode):
             axes = tuple(range(2, x.ndim))
             red = jnp.max if mode == "gmax" else jnp.mean
             return red(x, axis=axes, keepdims=True)
-        ks = tuple(attrs["kernel_shape"])
-        strides = tuple(attrs.get("strides", [1] * spatial))
+        ks = tuple(int(v) for v in attrs["kernel_shape"])
+        strides = tuple(int(v) for v in attrs.get("strides",
+                                                  [1] * spatial))
         resolved = _auto_pad_or_pads(attrs, spatial)
         if resolved == "SAME":
             # lax string padding applies to ALL dims; compute explicit

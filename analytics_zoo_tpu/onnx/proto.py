@@ -333,7 +333,11 @@ def _encode_attr(name: str, value: Any) -> bytes:
         out += _ld(5, _encode_tensor(value))
         out += _key(20, 0) + _write_varint(4)
     elif isinstance(value, (list, tuple, np.ndarray)) and len(value) \
-            and all(isinstance(v, (float, np.floating)) for v in value):
+            and any(isinstance(v, (float, np.floating)) for v in value) \
+            and all(isinstance(v, (int, float, np.integer, np.floating))
+                    for v in value):
+        # any float promotes the whole list to FLOATS (lossless); pure
+        # ints stay INTS below
         for v in value:
             out += _key(7, 5) + struct.pack("<f", float(v))
         out += _key(20, 0) + _write_varint(6)

@@ -119,6 +119,27 @@ class TFDataset:
         return cls(xs, ys, batch_size=batch_size)
 
     @classmethod
+    def from_tfrecord_file(cls, path: str, feature_cols: Sequence[str],
+                           label_col: Optional[str] = None,
+                           batch_size: int = 32) -> "TFDataset":
+        """Parse tf.Example TFRecords WITHOUT TensorFlow (reference
+        from_tfrecord_file:458 ran a TF graph per partition; here the
+        record framing + Example protos are decoded natively —
+        data/tfrecord.py, crc32c in C++ when built)."""
+        from analytics_zoo_tpu.data.tfrecord import read_example_file
+
+        examples = read_example_file(path)
+        if not examples:
+            raise ValueError(f"no records in {path}")
+        xs = [np.stack([np.asarray(ex[c]) for ex in examples])
+              for c in feature_cols]
+        y = (np.stack([np.asarray(ex[label_col]) for ex in examples])
+             if label_col else None)
+        if y is not None and y.ndim == 2 and y.shape[1] == 1:
+            y = y[:, 0]
+        return cls(xs, y, batch_size=batch_size)
+
+    @classmethod
     def from_tf_data_dataset(cls, dataset, batch_size: int = 32,
                              max_examples: Optional[int] = None
                              ) -> "TFDataset":

@@ -54,8 +54,10 @@ def main():
                       nb_epoch=args.epochs, verbose=False)
     results = det.detect(imgs[:4], score_threshold=0.2)
     for i, (b, s, l) in enumerate(results):
-        keep = s > 0.2
-        print(f"image {i}: {int(keep.sum())} detections, "
+        if len(s) == 0:
+            print(f"image {i}: no detections above threshold")
+            continue
+        print(f"image {i}: {len(s)} detections, "
               f"best score {float(s.max()):.3f}")
 
 

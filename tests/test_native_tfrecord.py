@@ -1,0 +1,142 @@
+"""Native C++ data-plane primitives + TFRecord IO tests.
+
+The native crc32c must agree bit-for-bit with the python table (which is
+also TF's spec), gather_rows with numpy fancy indexing, and the TFRecord
+framing must round-trip through real tf.io readers when TF is present."""
+
+import os
+import struct
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu import native
+from analytics_zoo_tpu.core.summary import crc32c as py_crc32c
+from analytics_zoo_tpu.data.tfrecord import (make_example, parse_example,
+                                             read_example_file,
+                                             read_tfrecords,
+                                             write_tfrecords)
+
+
+class TestNativeCrc:
+    def test_builds_and_loads(self):
+        assert native.available(), "g++ toolchain is baked in; the native "\
+            "library must build"
+
+    def test_matches_python_reference(self):
+        rs = np.random.RandomState(0)
+        for n in (0, 1, 7, 8, 9, 63, 64, 1000, 65537):
+            data = rs.bytes(n)
+            assert native.crc32c(data) == py_crc32c(data), n
+
+    def test_known_vector(self):
+        # rfc3720 crc32c test vector: 32 zero bytes -> 0x8A9136AA
+        assert native.crc32c(b"\x00" * 32) == 0x8A9136AA
+        assert native.crc32c(b"123456789") == 0xE3069283
+
+    def test_masked(self):
+        data = b"hello tfrecord"
+        crc = py_crc32c(data)
+        expect = ((crc >> 15) | (crc << 17)) + 0xA282EAD8 & 0xFFFFFFFF
+        assert native.masked_crc32c(data) == expect
+
+
+class TestGatherRows:
+    def test_matches_fancy_indexing(self):
+        rs = np.random.RandomState(1)
+        for shape in ((100, 17), (50, 4, 3), (64,)):
+            src = rs.randn(*shape).astype(np.float32)
+            idx = rs.randint(0, shape[0], 40)
+            np.testing.assert_array_equal(native.gather_rows(src, idx),
+                                          src[idx])
+
+    def test_int_dtypes_and_large(self):
+        rs = np.random.RandomState(2)
+        src = rs.randint(0, 1000, (5000, 64)).astype(np.int64)
+        idx = rs.randint(0, 5000, 4096)
+        np.testing.assert_array_equal(native.gather_rows(src, idx),
+                                      src[idx])
+
+    def test_featureset_uses_gather(self):
+        from analytics_zoo_tpu.data.featureset import FeatureSet
+
+        rs = np.random.RandomState(3)
+        x = rs.randn(4096, 128).astype(np.float32)   # 2MB -> native path
+        y = rs.randn(4096).astype(np.float32)
+        fs = FeatureSet.from_ndarrays(x, y)
+        seen = 0
+        for bx, by in fs.batches(2048, shuffle=True):
+            seen += len(by)
+            assert bx.shape[1:] == (128,)
+        assert seen == 4096
+
+
+class TestTFRecord:
+    def test_roundtrip(self, tmp_path):
+        p = str(tmp_path / "data.tfrecord")
+        recs = [b"alpha", b"", b"x" * 1000]
+        write_tfrecords(p, recs)
+        assert list(read_tfrecords(p)) == recs
+
+    def test_corruption_detected(self, tmp_path):
+        p = str(tmp_path / "data.tfrecord")
+        write_tfrecords(p, [b"payload-here"])
+        blob = bytearray(open(p, "rb").read())
+        blob[14] ^= 0xFF                       # flip a payload byte
+        open(p, "wb").write(bytes(blob))
+        with pytest.raises(ValueError, match="corrupt"):
+            list(read_tfrecords(p))
+
+    def test_example_roundtrip(self, tmp_path):
+        ex = make_example({
+            "feat": np.asarray([1.5, -2.0, 3.25], np.float32),
+            "label": np.asarray([7], np.int64),
+            "name": [b"row-one"],
+        })
+        parsed = parse_example(ex)
+        np.testing.assert_allclose(parsed["feat"], [1.5, -2.0, 3.25])
+        np.testing.assert_array_equal(parsed["label"], [7])
+        assert parsed["name"] == [b"row-one"]
+
+    def test_read_example_file_and_tfdataset(self, tmp_path):
+        p = str(tmp_path / "ex.tfrecord")
+        recs = [make_example({"x": np.asarray([i, i + 1], np.float32),
+                              "y": np.asarray([i % 2], np.int64)})
+                for i in range(10)]
+        write_tfrecords(p, recs)
+        exs = read_example_file(p)
+        assert len(exs) == 10
+
+        from analytics_zoo_tpu.tfpark import TFDataset
+
+        ds = TFDataset.from_tfrecord_file(p, ["x"], "y", batch_size=4)
+        assert ds.features[0].shape == (10, 2)
+        np.testing.assert_array_equal(
+            np.asarray(ds.labels).reshape(-1) % 2,
+            np.arange(10) % 2)
+
+    def test_tf_can_read_our_records(self, tmp_path):
+        tf = pytest.importorskip("tensorflow")
+        p = str(tmp_path / "interop.tfrecord")
+        write_tfrecords(p, [b"from-zoo-1", b"from-zoo-2"])
+        got = [r.numpy() for r in tf.data.TFRecordDataset(p)]
+        assert got == [b"from-zoo-1", b"from-zoo-2"]
+
+    def test_we_can_read_tf_records(self, tmp_path):
+        tf = pytest.importorskip("tensorflow")
+        p = str(tmp_path / "interop2.tfrecord")
+        with tf.io.TFRecordWriter(p) as w:
+            w.write(b"written-by-tf")
+        assert list(read_tfrecords(p)) == [b"written-by-tf"]
+
+    def test_tf_example_interop(self, tmp_path):
+        tf = pytest.importorskip("tensorflow")
+        ex = tf.train.Example(features=tf.train.Features(feature={
+            "v": tf.train.Feature(
+                float_list=tf.train.FloatList(value=[1.0, 2.5])),
+            "i": tf.train.Feature(
+                int64_list=tf.train.Int64List(value=[42, -3])),
+        }))
+        parsed = parse_example(ex.SerializeToString())
+        np.testing.assert_allclose(parsed["v"], [1.0, 2.5])
+        np.testing.assert_array_equal(parsed["i"], [42, -3])
