@@ -44,27 +44,9 @@ def crc32c(data: bytes) -> int:
     return crc ^ 0xFFFFFFFF
 
 
-def _py_masked_crc(data: bytes) -> int:
-    crc = crc32c(data)
-    return ((crc >> 15) | (crc << 17)) + 0xA282EAD8 & 0xFFFFFFFF
-
-
-_MASKED_IMPL = None
-
-
-def _masked_crc(data: bytes) -> int:
-    # prefer the native slice-by-8 crc32c (native/zoo_native.cpp) —
-    # records checksum at memory bandwidth; resolved ONCE, python table
-    # above stays as the fallback and the native build's golden reference
-    global _MASKED_IMPL
-    if _MASKED_IMPL is None:
-        try:
-            from analytics_zoo_tpu.native import masked_crc32c
-
-            _MASKED_IMPL = masked_crc32c
-        except Exception:
-            _MASKED_IMPL = _py_masked_crc
-    return _MASKED_IMPL(data)
+# native.masked_crc32c resolves native-vs-python internally (the python
+# crc32c table above remains its fallback and golden reference)
+from analytics_zoo_tpu.native import masked_crc32c as _masked_crc  # noqa: E402
 
 
 # ---------------------------------------------------------------------------

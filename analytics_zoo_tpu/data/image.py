@@ -713,3 +713,9 @@ class RowToImageFeature(ImagePreprocessing):
     def from_row(row) -> ImageFeature:
         return ImageFeature(image=np.asarray(row["data"]),
                             path=row.get("origin", ""))
+
+
+class BufferedImageResize(ImageResize):
+    """Parity alias for the reference's BufferedImageResize.scala (the
+    JVM BufferedImage path vs OpenCV path distinction does not exist
+    here — one cv2 resize serves both)."""

@@ -42,16 +42,20 @@ def read_tfrecords(path: str, verify: bool = True) -> Iterator[bytes]:
     with open(path, "rb") as f:
         while True:
             header = f.read(8)
-            if len(header) < 8:
+            if not header:
                 return
+            if len(header) < 8:
+                raise ValueError("truncated TFRecord: partial length header")
             (length,) = struct.unpack("<Q", header)
-            (hcrc,) = struct.unpack("<I", f.read(4))
+            hbuf = f.read(4)
             data = f.read(length)
-            (dcrc,) = struct.unpack("<I", f.read(4))
+            dbuf = f.read(4)
+            if len(hbuf) < 4 or len(data) < length or len(dbuf) < 4:
+                raise ValueError("truncated TFRecord: partial record")
             if verify:
-                if masked_crc32c(header) != hcrc:
+                if masked_crc32c(header) != struct.unpack("<I", hbuf)[0]:
                     raise ValueError("corrupt TFRecord length header")
-                if masked_crc32c(data) != dcrc:
+                if masked_crc32c(data) != struct.unpack("<I", dbuf)[0]:
                     raise ValueError("corrupt TFRecord payload")
             yield data
 

@@ -111,6 +111,15 @@ def gather_rows(src: np.ndarray, idx: np.ndarray,
     lib = _load()
     if lib is None or src.ndim == 0:
         return src[idx]
+    # numpy semantics: negatives wrap, out-of-range raises — the C++
+    # memcpy path must never read outside the buffer
+    n = src.shape[0]
+    if idx.size:
+        idx = np.where(idx < 0, idx + n, idx)
+        lo, hi = int(idx.min()), int(idx.max())
+        if lo < 0 or hi >= n:
+            raise IndexError(
+                f"index out of range for axis 0 with size {n}")
     row_bytes = int(src.dtype.itemsize * np.prod(src.shape[1:], dtype=int))
     out = np.empty((len(idx),) + src.shape[1:], src.dtype)
     if n_threads <= 0:

@@ -18,7 +18,6 @@
 #include <vector>
 
 static uint32_t TBL[8][256];
-static bool table_ready = false;
 
 static void build_tables() {
   const uint32_t poly = 0x82F63B78u;
@@ -35,13 +34,16 @@ static void build_tables() {
       TBL[s][i] = crc;
     }
   }
-  table_ready = true;
 }
+
+// built once at library load — no lazy-init data race across caller
+// threads (prefetch, async checkpoint, TB writer)
+struct TableInit { TableInit() { build_tables(); } };
+static TableInit table_init;
 
 extern "C" {
 
 uint32_t zoo_crc32c(const uint8_t* data, uint64_t n) {
-  if (!table_ready) build_tables();
   uint32_t crc = 0xFFFFFFFFu;
   // slice-by-8 over the aligned middle
   while (n >= 8) {

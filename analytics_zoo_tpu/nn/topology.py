@@ -66,6 +66,12 @@ class KerasNet(Layer):
     def predict(self, x, batch_size: int = 32, distributed: bool = True):
         return self.estimator.predict(x, batch_size=batch_size)
 
+    def predict_classes(self, x, batch_size: int = 32,
+                        zero_based_label: bool = True):
+        """Reference Predictable.predictClasses convenience."""
+        return self.estimator.predict_classes(
+            x, batch_size=batch_size, zero_based_label=zero_based_label)
+
     def set_tensorboard(self, log_dir: str, app_name: str = "zoo"):
         """Reference Topology.scala:205-212."""
         from analytics_zoo_tpu.train.estimator import Estimator

@@ -9,6 +9,10 @@ converter.py) so the training hot loop is one XLA program.
 
 from analytics_zoo_tpu.tfpark.converter import (  # noqa: F401
     GraphProgram, UnsupportedLayerError, convert_keras_model)
+from analytics_zoo_tpu.tfpark.gan import GANEstimator  # noqa: F401
 from analytics_zoo_tpu.tfpark.model import (  # noqa: F401
-    FunctionModel, KerasModel, TFNet, TFOptimizer, TorchModel)
+    FunctionModel, KerasModel, TFNet, TFOptimizer, TorchCriterion,
+    TorchModel)
+from analytics_zoo_tpu.tfpark.text_estimators import (  # noqa: F401
+    BERTNER, BERTSQuAD, BERTClassifier)
 from analytics_zoo_tpu.tfpark.tf_dataset import TFDataset  # noqa: F401

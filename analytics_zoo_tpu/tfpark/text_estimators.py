@@ -1,0 +1,103 @@
+"""BERT task estimators (reference pyzoo/zoo/tfpark/text/estimator/
+bert_classifier.py / bert_ner.py / bert_squad.py — tf.estimator wrappers
+over a TF BERT graph).
+
+TPU-native redesign: the native BERT encoder (nn/layers/attention.py)
+plus a task head is one Layer-protocol model trained by the standard
+SPMD Estimator — same fit/evaluate/predict surface, no tf.estimator.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from analytics_zoo_tpu.nn import initializers
+from analytics_zoo_tpu.nn.layers.attention import BERT
+from analytics_zoo_tpu.nn.topology import KerasNet
+
+__all__ = ["BERTClassifier", "BERTNER", "BERTSQuAD"]
+
+
+class _BERTTask(KerasNet):
+    """BERT encoder + task head over (ids, segments, mask) inputs."""
+
+    head_on = "pooled"          # "pooled" | "sequence" | "qa"
+
+    def __init__(self, num_classes: int, bert_config: Optional[Dict] = None,
+                 **kw):
+        super().__init__(**kw)
+        cfg = dict(vocab=30522, hidden_size=128, n_block=2, nhead=2,
+                   intermediate_size=512, max_position_len=512)
+        cfg.update(bert_config or {})
+        self.bert = BERT(name=f"{self.name}_bert", **cfg)
+        self.num_classes = num_classes
+        self.hidden_size = cfg["hidden_size"]
+        self.initializer = initializers.get("glorot_uniform")
+
+    @property
+    def layers(self):
+        return [self.bert]
+
+    def build(self, rng, ids_shape, *rest):
+        kb, kh = jax.random.split(rng)
+        bert_params, bert_state = self.bert.init(kb, ids_shape, *rest)
+        out = 2 if self.head_on == "qa" else self.num_classes
+        params = {
+            self.bert.name: bert_params,
+            "head": {"kernel": self.initializer(
+                kh, (self.hidden_size, out), jnp.float32),
+                "bias": jnp.zeros((out,), jnp.float32)},
+        }
+        return params, {self.bert.name: bert_state}
+
+    def call(self, params, state, ids, segments=None, mask=None, *,
+             training=False, rng=None):
+        inputs = [ids]
+        if segments is not None:
+            inputs.append(segments)
+        if mask is not None:
+            # BERT layer input order: ids, segments, [positions], [mask]
+            if segments is None:
+                inputs.append(jnp.zeros_like(ids))
+            inputs.append(mask)
+        (seq, pooled), _ = self.bert.call(
+            params[self.bert.name], state.get(self.bert.name, {}), *inputs,
+            training=training, rng=rng)
+        h = params["head"]
+        if self.head_on == "pooled":
+            logits = pooled @ h["kernel"] + h["bias"]
+        else:                               # per-token heads (ner / qa)
+            logits = seq @ h["kernel"] + h["bias"]
+            if self.head_on == "qa":
+                # (B, L, 2) -> start/end logit pair
+                logits = (logits[..., 0], logits[..., 1])
+                return logits, state
+        return logits, state
+
+
+class BERTClassifier(_BERTTask):
+    """Sequence classification on the pooled output (reference
+    bert_classifier.py)."""
+
+    head_on = "pooled"
+
+
+class BERTNER(_BERTTask):
+    """Token-level tagging on the sequence output (reference
+    bert_ner.py)."""
+
+    head_on = "sequence"
+
+
+class BERTSQuAD(_BERTTask):
+    """Extractive QA: start/end logits per token (reference
+    bert_squad.py)."""
+
+    head_on = "qa"
+
+    def __init__(self, bert_config: Optional[Dict] = None, **kw):
+        super().__init__(num_classes=2, bert_config=bert_config, **kw)

@@ -749,6 +749,19 @@ class Estimator:
         out = self.predict_raw(x, batch_size=batch_size)
         return out[0]
 
+    def predict_classes(self, x, batch_size: int = 32,
+                        zero_based_label: bool = True) -> np.ndarray:
+        """Class indices from the model's scores (reference
+        Predictable.predictClasses, Predictor.scala:226-416); 1-based
+        when ``zero_based_label=False`` (BigDL convention)."""
+        scores = self.predict(x, batch_size=batch_size)
+        scores = np.asarray(scores)
+        if scores.ndim == 1 or scores.shape[-1] == 1:
+            cls = (scores.reshape(len(scores)) > 0.5).astype(np.int64)
+        else:
+            cls = np.argmax(scores, axis=-1).astype(np.int64)
+        return cls if zero_based_label else cls + 1
+
     def predict_raw(self, x, batch_size: int = 32) -> List[np.ndarray]:
         """Like predict but preserves multi-output models: returns one
         array per model output (single-output models → a 1-list)."""

@@ -1,0 +1,41 @@
+"""LocalEstimator — single-device training facade.
+
+Reference capability: ``LocalEstimator`` (pipeline/estimator/
+LocalEstimator.scala:39-250) clones the model per CPU thread and runs a
+hand-rolled parallel fwd/bwd with gradient averaging.  On TPU that whole
+mechanism is the degenerate case of the SPMD Estimator (XLA owns the
+chip's parallelism), so this class IS the Estimator pinned to a
+one-device mesh — same fit/evaluate/predict, zero second code path.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from analytics_zoo_tpu.core.context import ZooContext
+from analytics_zoo_tpu.train.estimator import Estimator
+
+__all__ = ["LocalEstimator"]
+
+
+class LocalEstimator(Estimator):
+    """Estimator on a 1-device mesh (reference LocalEstimator.scala:39)."""
+
+    def __init__(self, model, optimizer="adam", loss="mse", metrics=None,
+                 ctx: Optional[ZooContext] = None, **kw):
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh
+
+        from analytics_zoo_tpu.core.context import get_zoo_context
+
+        base = ctx or get_zoo_context()
+        # pin to the first device only — a true local run regardless of
+        # how many devices the global context spans
+        mesh = Mesh(np.asarray(jax.devices()[:1]), ("data",))
+        local_ctx = ZooContext(
+            config=base.config.replace(mesh_shape=(1,),
+                                       mesh_axis_names=("data",)),
+            mesh=mesh)
+        super().__init__(model, optimizer=optimizer, loss=loss,
+                         metrics=metrics, ctx=local_ctx, **kw)
