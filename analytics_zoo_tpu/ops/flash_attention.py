@@ -8,12 +8,16 @@ state sits in VMEM scratch across the KV grid dimension.  The MXU sees two
 matmuls per tile (Q·Kᵀ and P·V); everything else is VPU work fused in
 between.
 
-Autodiff: ``flash_attention`` carries a ``jax.custom_vjp`` whose backward
-recomputes attention gradients via the pure-JAX blockwise path
-(ops/attention.py) — i.e. the forward hot loop (serving, eval) gets the
-hand-written kernel while training gradients reuse XLA's derivation of the
-same math.  Off-TPU the kernel runs in interpreter mode only under tests;
-production dispatch falls back to blockwise (see dot_product_attention).
+Autodiff: ``flash_attention`` carries a ``jax.custom_vjp`` with
+HAND-WRITTEN Pallas backward kernels (the FlashAttention-2 recipe): the
+forward additionally emits the per-row logsumexp, the backward recomputes
+the probability tiles from (q, k, lse) in VMEM — no (Lq, Lk) matrix ever
+materialises — and two kernels accumulate dQ (grid over KV blocks) and
+dK/dV (grid over Q blocks) in f32 scratch.  When pallas/TPU is
+unavailable the backward falls back to the pure-JAX blockwise path
+(ops/attention.py).  Off-TPU the kernels run in interpreter mode under
+tests; production dispatch falls back to blockwise (see
+dot_product_attention).
 """
 
 from __future__ import annotations
@@ -88,44 +92,220 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
         o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
 
 
-def _flash_fwd(q, k, v, sm_scale: float, causal: bool,
-               block_q: int, block_k: int, interpret: bool):
+def _fwd_lse_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
+                    acc_scr, *, sm_scale, causal, block_q, block_k, lq, lk):
+    """Forward that also emits logsumexp rows (residual for the bwd)."""
+    _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
+                sm_scale=sm_scale, causal=causal, block_q=block_q,
+                block_k=block_k, lq=lq, lk=lk)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == nk - 1)
+    def _emit_lse():
+        l = jnp.maximum(l_scr[:, :1], 1e-20)
+        lse_ref[0] = (m_scr[:, 0] + jnp.log(l[:, 0])).astype(jnp.float32)
+
+
+def _blocks(q, k, block_q, block_k):
     b, h, lq, d = q.shape
     lk = k.shape[2]
     bq = min(block_q, lq)
     bk = min(block_k, lk)
     assert lq % bq == 0 and lk % bk == 0, (
         f"sequence lengths ({lq},{lk}) must divide blocks ({bq},{bk})")
-    qf = q.reshape(b * h, lq, d)
-    kf = k.reshape(b * h, lk, d)
-    vf = v.reshape(b * h, lk, d)
-    grid = (b * h, lq // bq, lk // bk)
-
     if _VMEM is None:
         raise ImportError(
             "jax.experimental.pallas.tpu unavailable — use "
             "ops.attention.blockwise_attention (dot_product_attention "
             "dispatches there automatically)")
-    kernel = functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
-                               block_q=bq, block_k=bk, lq=lq, lk=lk)
+    return b, h, lq, lk, d, bq, bk
+
+
+def _flash_fwd(q, k, v, sm_scale: float, causal: bool,
+               block_q: int, block_k: int, interpret: bool,
+               with_lse: bool = False):
+    b, h, lq, lk, d, bq, bk = _blocks(q, k, block_q, block_k)
+    qf = q.reshape(b * h, lq, d)
+    kf = k.reshape(b * h, lk, d)
+    vf = v.reshape(b * h, lk, d)
+    grid = (b * h, lq // bq, lk // bk)
+
+    common = dict(sm_scale=sm_scale, causal=causal, block_q=bq, block_k=bk,
+                  lq=lq, lk=lk)
+    in_specs = [
+        pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0)),
+        pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0)),
+        pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0)),
+    ]
+    scratch = [
+        _VMEM((bq, 128), jnp.float32),
+        _VMEM((bq, 128), jnp.float32),
+        _VMEM((bq, d), jnp.float32),
+    ]
+    o_spec = pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0))
+    if with_lse:
+        out, lse = pl.pallas_call(
+            functools.partial(_fwd_lse_kernel, **common),
+            grid=grid,
+            in_specs=in_specs,
+            out_specs=[o_spec,
+                       pl.BlockSpec((1, bq), lambda bh, qi, ki: (bh, qi))],
+            out_shape=[jax.ShapeDtypeStruct((b * h, lq, d), q.dtype),
+                       jax.ShapeDtypeStruct((b * h, lq), jnp.float32)],
+            scratch_shapes=scratch,
+            interpret=interpret,
+        )(qf, kf, vf)
+        return out.reshape(b, h, lq, d), lse.reshape(b, h, lq)
     out = pl.pallas_call(
-        kernel,
+        functools.partial(_fwd_kernel, **common),
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0)),
-            pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0)),
-            pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0)),
+        in_specs=in_specs,
+        out_specs=o_spec,
         out_shape=jax.ShapeDtypeStruct((b * h, lq, d), q.dtype),
-        scratch_shapes=[
-            _VMEM((bq, 128), jnp.float32),
-            _VMEM((bq, 128), jnp.float32),
-            _VMEM((bq, d), jnp.float32),
-        ],
+        scratch_shapes=scratch,
         interpret=interpret,
     )(qf, kf, vf)
     return out.reshape(b, h, lq, d)
+
+
+# ---------------------------------------------------------------------------
+# backward kernels (FlashAttention-2): probabilities recomputed from
+# (q, k, lse); dQ accumulates over KV blocks, dK/dV over Q blocks.
+# ---------------------------------------------------------------------------
+
+def _recompute_p(q, k, lse_rows, qi, ki, *, sm_scale, causal, block_q,
+                 block_k, lq, lk):
+    """(bq, bk) probability tile from streamed q/k and the saved lse."""
+    s = jax.lax.dot_general(
+        q.astype(jnp.float32) * sm_scale, k.astype(jnp.float32),
+        (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+    if causal:
+        qpos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0) + (lk - lq)
+        kpos = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        s = jnp.where(qpos >= kpos, s, NEG_INF)
+    p = jnp.exp(s - lse_rows[:, None])
+    return jnp.where(s <= NEG_INF / 2, 0.0, p)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   dq_scr, *, sm_scale, causal, block_q, block_k, lq, lk):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    q_end = qi * block_q + block_q - 1 + (lk - lq)
+    live = (ki * block_k <= q_end) if causal else (ki >= 0)
+
+    @pl.when(live)
+    def _body():
+        p = _recompute_p(q_ref[0], k_ref[0], lse_ref[0], qi, ki,
+                         sm_scale=sm_scale, causal=causal, block_q=block_q,
+                         block_k=block_k, lq=lq, lk=lk)
+        do = do_ref[0].astype(jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v_ref[0].astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)          # (bq, bk)
+        ds = p * (dp - delta_ref[0][:, None])
+        dq_scr[:] = dq_scr[:] + sm_scale * jax.lax.dot_general(
+            ds, k_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_scr, dv_scr, *, sm_scale, causal,
+                    block_q, block_k, lq, lk):
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    # causal: this k block only sees q rows at/after the diagonal
+    q_end = qi * block_q + block_q - 1 + (lk - lq)
+    live = (ki * block_k <= q_end) if causal else (qi >= 0)
+
+    @pl.when(live)
+    def _body():
+        p = _recompute_p(q_ref[0], k_ref[0], lse_ref[0], qi, ki,
+                         sm_scale=sm_scale, causal=causal, block_q=block_q,
+                         block_k=block_k, lq=lq, lk=lk)
+        do = do_ref[0].astype(jnp.float32)
+        dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)          # (bk, d)
+        dp = jax.lax.dot_general(
+            do, v_ref[0].astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0][:, None])
+        dk_scr[:] = dk_scr[:] + sm_scale * jax.lax.dot_general(
+            ds, q_ref[0].astype(jnp.float32), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)          # (bk, d)
+
+    @pl.when(qi == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd(q, k, v, out, lse, g, sm_scale, causal, block_q, block_k,
+               interpret):
+    b, h, lq, lk, d, bq, bk = _blocks(q, k, block_q, block_k)
+    qf = q.reshape(b * h, lq, d)
+    kf = k.reshape(b * h, lk, d)
+    vf = v.reshape(b * h, lk, d)
+    dof = g.reshape(b * h, lq, d)
+    lsef = lse.reshape(b * h, lq)
+    # delta_i = rowsum(dO_i * O_i) — cheap elementwise, fused by XLA
+    delta = jnp.sum(dof.astype(jnp.float32)
+                    * out.reshape(b * h, lq, d).astype(jnp.float32),
+                    axis=-1)
+
+    common = dict(sm_scale=sm_scale, causal=causal, block_q=bq, block_k=bk,
+                  lq=lq, lk=lk)
+    q_spec3 = pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0))
+    k_spec3 = pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0))
+    row_spec3 = pl.BlockSpec((1, bq), lambda bh, qi, ki: (bh, qi))
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, **common),
+        grid=(b * h, lq // bq, lk // bk),
+        in_specs=[q_spec3, k_spec3, k_spec3, q_spec3, row_spec3, row_spec3],
+        out_specs=q_spec3,
+        out_shape=jax.ShapeDtypeStruct((b * h, lq, d), q.dtype),
+        scratch_shapes=[_VMEM((bq, d), jnp.float32)],
+        interpret=interpret,
+    )(qf, kf, vf, dof, lsef, delta)
+
+    q_specK = pl.BlockSpec((1, bq, d), lambda bh, ki, qi: (bh, qi, 0))
+    k_specK = pl.BlockSpec((1, bk, d), lambda bh, ki, qi: (bh, ki, 0))
+    row_specK = pl.BlockSpec((1, bq), lambda bh, ki, qi: (bh, qi))
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, **common),
+        grid=(b * h, lk // bk, lq // bq),
+        in_specs=[q_specK, k_specK, k_specK, q_specK, row_specK, row_specK],
+        out_specs=[k_specK, k_specK],
+        out_shape=[jax.ShapeDtypeStruct((b * h, lk, d), k.dtype),
+                   jax.ShapeDtypeStruct((b * h, lk, d), v.dtype)],
+        scratch_shapes=[_VMEM((bk, d), jnp.float32),
+                        _VMEM((bk, d), jnp.float32)],
+        interpret=interpret,
+    )(qf, kf, vf, dof, lsef, delta)
+    return (dq.reshape(b, h, lq, d), dk.reshape(b, h, lk, d),
+            dv.reshape(b, h, lk, d))
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
@@ -142,20 +322,28 @@ def flash_attention(q, k, v, causal: bool = False,
 
 
 def _fwd_rule(q, k, v, causal, sm_scale, block_q, block_k, interpret):
-    out = flash_attention(q, k, v, causal, sm_scale, block_q, block_k,
-                          interpret)
-    return out, (q, k, v)
+    scale = sm_scale if sm_scale is not None else 1.0 / (q.shape[-1] ** 0.5)
+    out, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k,
+                          interpret, with_lse=True)
+    return out, (q, k, v, out, lse)
 
 
 def _bwd_rule(causal, sm_scale, block_q, block_k, interpret, res, g):
-    from analytics_zoo_tpu.ops.attention import blockwise_attention
+    q, k, v, out, lse = res
+    scale = sm_scale if sm_scale is not None else 1.0 / (q.shape[-1] ** 0.5)
+    try:
+        return _flash_bwd(q, k, v, out, lse, g, scale, causal, block_q,
+                          block_k, interpret)
+    except ImportError:
+        # pallas/TPU unavailable: differentiate the pure-JAX blockwise
+        # implementation of the same math
+        from analytics_zoo_tpu.ops.attention import blockwise_attention
 
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: blockwise_attention(
-            q_, k_, v_, causal=causal, sm_scale=sm_scale,
-            block_size=block_k), q, k, v)
-    return vjp(g)
+        _, vjp = jax.vjp(
+            lambda q_, k_, v_: blockwise_attention(
+                q_, k_, v_, causal=causal, sm_scale=sm_scale,
+                block_size=block_k), q, k, v)
+        return vjp(g)
 
 
 flash_attention.defvjp(_fwd_rule, _bwd_rule)

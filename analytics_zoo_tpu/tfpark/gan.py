@@ -25,8 +25,12 @@ __all__ = ["GANEstimator"]
 
 
 def _bce_logits(logits, target: float):
-    return jnp.mean(jnp.maximum(logits, 0) - logits * target
-                    + jnp.log1p(jnp.exp(-jnp.abs(logits))))
+    # the canonical stable implementation — one source of truth
+    from analytics_zoo_tpu.nn.objectives import (
+        binary_crossentropy_with_logits)
+
+    return binary_crossentropy_with_logits(
+        jnp.full(logits.shape, target, logits.dtype), logits)
 
 
 class GANEstimator:
@@ -47,6 +51,9 @@ class GANEstimator:
         self.g = generator
         self.d = discriminator
         self.noise_dim = noise_dim
+        if generator_steps < 1 or discriminator_steps < 1:
+            raise ValueError("generator_steps and discriminator_steps must "
+                             "be >= 1 (alternation needs both players)")
         self.g_tx = optim_lib.get(generator_optimizer)
         self.d_tx = optim_lib.get(discriminator_optimizer)
         self.g_steps = generator_steps

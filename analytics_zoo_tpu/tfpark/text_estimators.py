@@ -56,14 +56,18 @@ class _BERTTask(KerasNet):
 
     def call(self, params, state, ids, segments=None, mask=None, *,
              training=False, rng=None):
+        # BERT layer positional order: ids, segments, positions, mask —
+        # when a mask is given, positions must be filled with the
+        # default 0..L-1 iota so the mask never lands in the pos slot
         inputs = [ids]
-        if segments is not None:
-            inputs.append(segments)
+        if segments is not None or mask is not None:
+            inputs.append(segments if segments is not None
+                          else jnp.zeros_like(ids))
         if mask is not None:
-            # BERT layer input order: ids, segments, [positions], [mask]
-            if segments is None:
-                inputs.append(jnp.zeros_like(ids))
-            inputs.append(mask)
+            L = ids.shape[1]
+            positions = jnp.broadcast_to(jnp.arange(L, dtype=jnp.int32),
+                                         ids.shape)
+            inputs.extend([positions, mask])
         (seq, pooled), _ = self.bert.call(
             params[self.bert.name], state.get(self.bert.name, {}), *inputs,
             training=training, rng=rng)

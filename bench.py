@@ -299,10 +299,24 @@ def bench_attention(device, B=4, H=8, L=2048, D=64, iters=10):
             jax.block_until_ready(r)
             out[f"{name}_ms"] = round(
                 (time.perf_counter() - t0) / iters * 1e3, 3)
+            # fwd+bwd: exercises the hand-written Pallas dQ/dKV kernels
+            fb = jax.jit(jax.grad(
+                lambda a, b, c: jnp.sum(fn(a, b, c)), argnums=(0, 1, 2)))
+            r = fb(q, k, v)
+            jax.block_until_ready(r)
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                r = fb(q, k, v)
+            jax.block_until_ready(r)
+            out[f"{name}_fwdbwd_ms"] = round(
+                (time.perf_counter() - t0) / iters * 1e3, 3)
         except Exception as e:          # pallas unavailable on this backend
             out[f"{name}_error"] = type(e).__name__
     if "flash_ms" in out and "blockwise_ms" in out:
         out["flash_speedup"] = round(out["blockwise_ms"] / out["flash_ms"], 2)
+    if "flash_fwdbwd_ms" in out and "blockwise_fwdbwd_ms" in out:
+        out["flash_bwd_speedup"] = round(
+            out["blockwise_fwdbwd_ms"] / out["flash_fwdbwd_ms"], 2)
     return out
 
 

@@ -80,6 +80,29 @@ class TestBERTEstimators:
         preds = ner.predict([ids, seg], batch_size=16)
         assert preds.shape == (48, 8, 5)
 
+    def test_mask_is_honored(self, zoo_ctx):
+        # with a padding mask, garbage in the padded region must not
+        # change the (unpadded-token-derived) logits
+        import jax
+
+        ids, seg = self._data(4)
+        mask = np.ones_like(ids, np.float32)
+        mask[:, 5:] = 0.0
+        clf = BERTClassifier(num_classes=2, bert_config=self.CFG)
+        params, state = clf.init(jax.random.PRNGKey(0), ids.shape,
+                                 seg.shape, ids.shape, mask.shape)
+        out1, _ = clf.call(params, state, ids, seg, mask)
+        ids2 = ids.copy()
+        ids2[:, 5:] = 99                        # scramble padded tokens
+        out2, _ = clf.call(params, state, ids2, seg, mask)
+        np.testing.assert_allclose(np.asarray(out1), np.asarray(out2),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_gan_zero_steps_rejected(self, zoo_ctx):
+        with pytest.raises(ValueError, match=">= 1"):
+            GANEstimator(generator=_mlp(2, 4), discriminator=_mlp(1, 2),
+                         noise_dim=4, discriminator_steps=0)
+
     def test_squad_outputs_start_end(self, zoo_ctx):
         import jax
 
