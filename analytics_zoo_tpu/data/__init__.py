@@ -1,8 +1,17 @@
-from analytics_zoo_tpu.data.featureset import FeatureSet  # noqa: F401
+from analytics_zoo_tpu.data.featureset import (  # noqa: F401
+    FeatureSet,
+    SlicedFeatureSet,
+)
 from analytics_zoo_tpu.data.image import (  # noqa: F401
     ImageFeature,
     ImagePreprocessing,
     ImageSet,
+)
+from analytics_zoo_tpu.data.preprocessing import (  # noqa: F401
+    ChainedPreprocessing,
+    FeatureLabelPreprocessing,
+    Preprocessing,
+    SeqToTensor,
 )
 from analytics_zoo_tpu.data.text import (  # noqa: F401
     TextFeature,

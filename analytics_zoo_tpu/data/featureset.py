@@ -170,3 +170,87 @@ class FeatureSet:
         os.close(fd)
         np.save(path, a)
         return np.load(path, mmap_mode="r")
+
+    # -- slice-wise disk epochs ------------------------------------------
+    @staticmethod
+    def from_npy_slices(slices: Sequence[Sequence[str]],
+                        seed: int = 0) -> "SlicedFeatureSet":
+        """Slice-wise disk training (reference DiskFeatureSet numSlice,
+        feature/FeatureSet.scala:585): ``slices`` is a list of aligned
+        .npy path tuples; one slice is resident in DRAM at a time and
+        epochs stream slice-by-slice (slice order + rows-within-slice
+        shuffled), bounding host memory to the largest slice."""
+        return SlicedFeatureSet(slices, seed=seed)
+
+
+class SlicedFeatureSet(FeatureSet):
+    """A FeatureSet whose rows live in per-slice .npy files on disk;
+    only one slice is materialised in DRAM at a time."""
+
+    def __init__(self, slices: Sequence[Sequence[str]], seed: int = 0):
+        if not slices:
+            raise ValueError("need at least one slice")
+        self.slice_paths = [tuple(s) for s in slices]
+        width = len(self.slice_paths[0])
+        if any(len(s) != width for s in self.slice_paths):
+            raise ValueError("every slice must have the same array count")
+        self.memory_type = "DISK_AND_DRAM"
+        self.transforms = []
+        self.seed = seed
+        self._rng = np.random.RandomState(seed)
+        # row counts from headers only (no data load)
+        self._slice_rows = []
+        for s in self.slice_paths:
+            counts = {len(np.load(p, mmap_mode="r")) for p in s}
+            if len(counts) != 1:
+                raise ValueError(f"slice {s} arrays are not aligned")
+            self._slice_rows.append(counts.pop())
+
+    def transform(self, fn) -> "SlicedFeatureSet":
+        fs = SlicedFeatureSet.__new__(SlicedFeatureSet)
+        fs.__dict__.update(self.__dict__)
+        fs.transforms = self.transforms + [fn]
+        return fs
+
+    def __len__(self) -> int:
+        return int(sum(self._slice_rows))
+
+    def batches(self, batch_size: int, shuffle: bool = False,
+                drop_remainder: bool = False, pad_to: int = 1,
+                shuffle_buffer: Optional[int] = None
+                ) -> Iterator[Tuple[np.ndarray, ...]]:
+        """Stream batches slice-by-slice.  Rows left over when a slice
+        doesn't divide the batch are CARRIED into the next slice (total
+        loss per epoch is < one batch, same as the base class), so small
+        slices still contribute every row.  ``shuffle_buffer`` is
+        accepted but moot here: the resident slice IS the shuffle window
+        by construction."""
+        bs = int(math.ceil(batch_size / pad_to)) * pad_to
+        order = (self._rng.permutation(len(self.slice_paths)) if shuffle
+                 else np.arange(len(self.slice_paths)))
+        carry: Optional[List[np.ndarray]] = None
+
+        def emit(batch):
+            for fn in self.transforms:
+                batch = fn(*batch)
+                if not isinstance(batch, tuple):
+                    batch = (batch,)
+            return batch
+
+        for si in order:
+            arrays = [np.load(p) for p in self.slice_paths[si]]  # DRAM now
+            if carry is not None:
+                arrays = [np.concatenate([c, a])
+                          for c, a in zip(carry, arrays)]
+                carry = None
+            n = len(arrays[0])
+            rows = self._rng.permutation(n) if shuffle else np.arange(n)
+            for s in range(n // bs):
+                idx = rows[s * bs:(s + 1) * bs]
+                yield emit(tuple(a[idx] for a in arrays))
+            rem = rows[(n // bs) * bs:]
+            if len(rem):
+                carry = [a[rem] for a in arrays]
+            del arrays          # release the slice before loading the next
+        if carry is not None and not drop_remainder:
+            yield emit(tuple(carry))

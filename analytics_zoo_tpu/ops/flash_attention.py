@@ -13,11 +13,10 @@ HAND-WRITTEN Pallas backward kernels (the FlashAttention-2 recipe): the
 forward additionally emits the per-row logsumexp, the backward recomputes
 the probability tiles from (q, k, lse) in VMEM — no (Lq, Lk) matrix ever
 materialises — and two kernels accumulate dQ (grid over KV blocks) and
-dK/dV (grid over Q blocks) in f32 scratch.  When pallas/TPU is
-unavailable the backward falls back to the pure-JAX blockwise path
-(ops/attention.py).  Off-TPU the kernels run in interpreter mode under
-tests; production dispatch falls back to blockwise (see
-dot_product_attention).
+dK/dV (grid over Q blocks) in f32 scratch.  flash_attention requires
+pallas end-to-end (fwd and bwd); backends without it are routed to the
+pure-JAX blockwise path by ``dot_product_attention``'s dispatch.
+Off-TPU the kernels run in interpreter mode under tests.
 """
 
 from __future__ import annotations
@@ -329,21 +328,13 @@ def _fwd_rule(q, k, v, causal, sm_scale, block_q, block_k, interpret):
 
 
 def _bwd_rule(causal, sm_scale, block_q, block_k, interpret, res, g):
+    # (no blockwise fallback here: if pallas were unavailable the
+    # FORWARD would already have raised — non-pallas backends are routed
+    # to blockwise_attention by dot_product_attention's dispatch)
     q, k, v, out, lse = res
     scale = sm_scale if sm_scale is not None else 1.0 / (q.shape[-1] ** 0.5)
-    try:
-        return _flash_bwd(q, k, v, out, lse, g, scale, causal, block_q,
-                          block_k, interpret)
-    except ImportError:
-        # pallas/TPU unavailable: differentiate the pure-JAX blockwise
-        # implementation of the same math
-        from analytics_zoo_tpu.ops.attention import blockwise_attention
-
-        _, vjp = jax.vjp(
-            lambda q_, k_, v_: blockwise_attention(
-                q_, k_, v_, causal=causal, sm_scale=sm_scale,
-                block_size=block_k), q, k, v)
-        return vjp(g)
+    return _flash_bwd(q, k, v, out, lse, g, scale, causal, block_q,
+                      block_k, interpret)
 
 
 flash_attention.defvjp(_fwd_rule, _bwd_rule)

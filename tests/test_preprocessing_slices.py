@@ -1,0 +1,147 @@
+"""Typed Preprocessing combinators + slice-wise disk epochs
+(reference feature/common/Preprocessing.scala and DiskFeatureSet
+numSlice spilling, feature/FeatureSet.scala:585)."""
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.data.featureset import FeatureSet, SlicedFeatureSet
+from analytics_zoo_tpu.data.preprocessing import (ArrayToTensor,
+                                                  ChainedPreprocessing,
+                                                  FeatureLabelPreprocessing,
+                                                  Preprocessing,
+                                                  ScalarToTensor,
+                                                  SeqToTensor, TensorToSample,
+                                                  ToFloat32)
+
+
+class TestPreprocessing:
+    def test_seq_to_tensor(self):
+        out = SeqToTensor(size=(2, 2))([1, 2, 3, 4])
+        assert out.shape == (2, 2) and out.dtype == np.float32
+
+    def test_scalar_to_tensor(self):
+        out = ScalarToTensor()(3)
+        np.testing.assert_array_equal(out, [3.0])
+
+    def test_chain_operator(self):
+        class PlusOne(Preprocessing):
+            def apply(self, v):
+                return v + 1
+
+        chain = SeqToTensor() >> PlusOne() >> PlusOne()
+        assert isinstance(chain, ChainedPreprocessing)
+        np.testing.assert_array_equal(chain([1.0, 2.0]), [3.0, 4.0])
+        # nested chains flatten
+        chain2 = chain >> PlusOne()
+        assert len(chain2.stages) == 4
+
+    def test_feature_label_preprocessing(self):
+        flp = FeatureLabelPreprocessing(
+            feature=SeqToTensor(), label=ScalarToTensor())
+        f, l = flp(([1, 2], 5))
+        np.testing.assert_array_equal(f, [1.0, 2.0])
+        np.testing.assert_array_equal(l, [5.0])
+        # bare value = feature only
+        np.testing.assert_array_equal(flp([3, 4]), [3.0, 4.0])
+
+    def test_tensor_to_sample(self):
+        s = TensorToSample()((np.zeros(2), 1))
+        assert set(s) == {"feature", "label"}
+
+    def test_works_as_nnframes_preprocessing(self, zoo_ctx):
+        import pandas as pd
+
+        from analytics_zoo_tpu.nn.layers.core import Dense
+        from analytics_zoo_tpu.nn.topology import Sequential
+        from analytics_zoo_tpu.nnframes import NNEstimator
+
+        rs = np.random.RandomState(0)
+        x = rs.randn(64, 4).astype(np.float64)      # float64 on purpose
+        df = pd.DataFrame({"features": list(x),
+                           "label": x.sum(1).astype(np.float32)})
+        m = Sequential()
+        m.add(Dense(8, activation="relu", input_shape=(4,)))
+        m.add(Dense(1))
+        est = NNEstimator(m, criterion="mse",
+                          feature_preprocessing=ToFloat32())
+        est.set_batch_size(32).set_max_epoch(1).fit(df)
+
+
+class TestSlicedFeatureSet:
+    def _make_slices(self, tmp_path, n_slices=3, rows=50):
+        paths = []
+        rs = np.random.RandomState(0)
+        for i in range(n_slices):
+            x = rs.randn(rows, 4).astype(np.float32)
+            y = np.full(rows, i, np.float32)        # slice id as label
+            px = str(tmp_path / f"x{i}.npy")
+            py = str(tmp_path / f"y{i}.npy")
+            np.save(px, x)
+            np.save(py, y)
+            paths.append((px, py))
+        return paths
+
+    def test_all_rows_seen_once(self, tmp_path):
+        fs = FeatureSet.from_npy_slices(self._make_slices(tmp_path))
+        assert len(fs) == 150
+        labels = []
+        for bx, by in fs.batches(16, shuffle=True):
+            assert bx.shape[1:] == (4,)
+            labels.extend(by.tolist())
+        assert len(labels) == 150
+        assert sorted(set(labels)) == [0.0, 1.0, 2.0]
+
+    def test_slice_locality(self, tmp_path):
+        # rows stream slice-by-slice: labels form 3 contiguous runs
+        fs = FeatureSet.from_npy_slices(self._make_slices(tmp_path))
+        labels = []
+        for _, by in fs.batches(10, shuffle=True):
+            labels.extend(by.tolist())
+        runs = 1 + sum(1 for a, b in zip(labels, labels[1:]) if a != b)
+        assert runs == 3, runs
+
+    def test_drop_remainder_and_transform(self, tmp_path):
+        fs = FeatureSet.from_npy_slices(self._make_slices(tmp_path))
+        fs2 = fs.transform(lambda x, y: (x * 2, y))
+        count = 0
+        for bx, by in fs2.batches(16, drop_remainder=True):
+            assert bx.shape[0] == 16
+            count += 1
+        assert count == 9      # 3 slices x floor(50/16)
+
+    def test_trains_under_estimator(self, tmp_path, zoo_ctx):
+        from analytics_zoo_tpu.nn.layers.core import Dense
+        from analytics_zoo_tpu.nn.topology import Sequential
+        from analytics_zoo_tpu.train.estimator import Estimator
+
+        fs = FeatureSet.from_npy_slices(self._make_slices(tmp_path))
+        m = Sequential()
+        m.add(Dense(8, activation="relu", input_shape=(4,)))
+        m.add(Dense(1))
+        est = Estimator(m, loss="mse")
+        hist = est.fit(fs, batch_size=16, epochs=2, verbose=False)
+        assert len(hist) == 2
+
+    def test_small_slices_carry_into_batches(self, tmp_path):
+        # slices smaller than the batch still contribute: remainders
+        # carry across slices, total loss < one batch per epoch
+        paths = []
+        for i, rows in enumerate([10, 6, 9]):
+            x = np.arange(rows, dtype=np.float32)[:, None]
+            px = str(tmp_path / f"s{i}.npy")
+            np.save(px, x)
+            paths.append((px,))
+        fs = FeatureSet.from_npy_slices(paths)
+        got = sum(b[0].shape[0]
+                  for b in fs.batches(8, drop_remainder=True))
+        assert got == 24      # 25 rows -> 3 full batches of 8
+        got = sum(b[0].shape[0] for b in fs.batches(8))
+        assert got == 25      # no drop: final partial emitted
+
+    def test_misaligned_slice_raises(self, tmp_path):
+        np.save(str(tmp_path / "a.npy"), np.zeros((5, 2)))
+        np.save(str(tmp_path / "b.npy"), np.zeros(6))
+        with pytest.raises(ValueError, match="aligned"):
+            FeatureSet.from_npy_slices([(str(tmp_path / "a.npy"),
+                                         str(tmp_path / "b.npy"))])
