@@ -363,8 +363,34 @@ def bench_int8(device, n=4096, iters=20):
     return out
 
 
+def _device_preflight(timeout_s: int = 180) -> bool:
+    """Probe the accelerator in a SUBPROCESS: a wedged device transport
+    (e.g. a dead tunnel) would hang any in-process op forever, and the
+    driver must still receive a JSON line."""
+    import subprocess
+    import sys
+
+    code = ("import jax, jax.numpy as jnp;"
+            "x = (jnp.ones((64, 64)) @ jnp.ones((64, 64)));"
+            "x.block_until_ready(); print('ok')")
+    try:
+        proc = subprocess.run([sys.executable, "-c", code],
+                              capture_output=True, timeout=timeout_s)
+        return proc.returncode == 0 and b"ok" in proc.stdout
+    except Exception:
+        return False
+
+
 def main():
     import jax
+
+    if not _device_preflight():
+        print(json.dumps({
+            "metric": "ncf_movielens1m_train_samples_per_sec_per_chip",
+            "value": 0.0, "unit": "samples/sec/chip", "vs_baseline": None,
+            "extra": {"error": "device preflight failed: accelerator "
+                               "unreachable (transport hang?)"}}))
+        return
 
     accel = jax.devices()[0]
     on_tpu = accel.platform != "cpu"
