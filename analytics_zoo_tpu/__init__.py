@@ -19,8 +19,21 @@ JAX/XLA/Pallas framework:
 - ``models``   — built-in model zoo (NCF, WideAndDeep, AnomalyDetector,
                  TextClassifier, Seq2seq, KNRM, SSD, BERT ...).
 - ``deploy``   — InferenceModel multi-backend serving + cluster serving.
+- ``tfpark``   — foreign-model ingestion: tf.keras/torch converted to
+                 native JAX, TFDataset facades, GAN + BERT estimators.
+- ``onnx``     — ONNX import without the onnx package (wire codec +
+                 jax/lax op lowering); imported graphs train and serve.
+- ``nnframes`` — Spark-ML-style NNEstimator/NNClassifier over DataFrames.
+- ``automl``   — TimeSequencePredictor + in-process search engine.
+- ``native``   — C++ host data-plane (crc32c, parallel gather) via ctypes.
+- ``utils``    — nest flatten/pack + file helpers.
 """
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
 
-from analytics_zoo_tpu.core.context import init_zoo_context, ZooContext  # noqa: F401
+from analytics_zoo_tpu.core.config import ZooConfig  # noqa: F401
+from analytics_zoo_tpu.core.context import (  # noqa: F401
+    ZooContext,
+    get_zoo_context,
+    init_zoo_context,
+)

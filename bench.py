@@ -374,9 +374,22 @@ def _device_preflight(timeout_s: int = 180) -> bool:
             "x = (jnp.ones((64, 64)) @ jnp.ones((64, 64)));"
             "x.block_until_ready(); print('ok')")
     try:
-        proc = subprocess.run([sys.executable, "-c", code],
-                              capture_output=True, timeout=timeout_s)
-        return proc.returncode == 0 and b"ok" in proc.stdout
+        # Popen + poll (NOT subprocess.run): a child wedged in
+        # uninterruptible device I/O ignores SIGKILL, and run()'s
+        # pipe-drain after the timeout would block forever — poll and
+        # abandon the orphan instead so the deadline is always honored.
+        proc = subprocess.Popen([sys.executable, "-c", code],
+                                stdout=subprocess.PIPE,
+                                stderr=subprocess.DEVNULL)
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            rc = proc.poll()
+            if rc is not None:
+                out = proc.stdout.read() if proc.stdout else b""
+                return rc == 0 and b"ok" in out
+            time.sleep(0.5)
+        proc.kill()
+        return False
     except Exception:
         return False
 

@@ -1,0 +1,90 @@
+"""Cluster serving: a worker loop + client queues
+(reference serving/ClusterServing.scala + pyzoo/zoo/serving/client.py —
+Redis-stream serving with backpressure; here the queue backend is
+pluggable: memory / file / redis).
+
+Run the whole flow in one process:
+    python cluster_serving_example.py
+
+Or split worker and client across processes with a shared file queue:
+    python cluster_serving_example.py --queue-dir /tmp/zooq --role worker
+    python cluster_serving_example.py --queue-dir /tmp/zooq --role client
+"""
+
+import argparse
+import time
+
+import numpy as np
+
+from analytics_zoo_tpu import init_zoo_context
+from analytics_zoo_tpu.deploy.inference import InferenceModel
+from analytics_zoo_tpu.deploy.serving import (ClusterServing, FileQueue,
+                                              InputQueue, MemoryQueue,
+                                              OutputQueue, ServingConfig)
+from analytics_zoo_tpu.nn.layers.core import Dense
+from analytics_zoo_tpu.nn.topology import Sequential
+
+
+def build_model():
+    net = Sequential()
+    net.add(Dense(16, activation="relu", input_shape=(8,)))
+    net.add(Dense(3, activation="softmax"))
+    net.compile(optimizer="adam", loss="sparse_categorical_crossentropy")
+    rs = np.random.RandomState(0)
+    x = rs.randn(256, 8).astype(np.float32)
+    y = rs.randint(0, 3, 256).astype(np.int32)
+    net.fit(x, y, batch_size=64, nb_epoch=2, verbose=False)
+    est = net.estimator
+    return InferenceModel.from_keras_net(net, est.params, est.state)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--queue-dir", default=None,
+                    help="file-queue dir (enables multi-process mode)")
+    ap.add_argument("--role", default="both",
+                    choices=["both", "worker", "client"])
+    ap.add_argument("--requests", type=int, default=16)
+    args = ap.parse_args()
+
+    init_zoo_context()
+    queue = (FileQueue(args.queue_dir) if args.queue_dir
+             else MemoryQueue())
+
+    serving = None
+    if args.role in ("both", "worker"):
+        serving = ClusterServing(build_model(), queue,
+                                 ServingConfig(batch_size=8)).start()
+        print("serving worker started")
+
+    if args.role in ("both", "client"):
+        inp, outp = InputQueue(queue), OutputQueue(queue)
+        rs = np.random.RandomState(1)
+        for i in range(args.requests):
+            inp.enqueue(uri=f"req{i}",
+                        x=rs.randn(8).astype(np.float32))
+        results = {}
+        deadline = time.time() + 30
+        while len(results) < args.requests and time.time() < deadline:
+            results.update(outp.dequeue(timeout=5.0))
+        print(f"received {len(results)}/{args.requests} predictions")
+        if "req0" in results:
+            print("req0 class scores:",
+                  np.round(np.asarray(results["req0"]), 3))
+        elif not results:
+            raise SystemExit("no predictions arrived — is a worker "
+                             "running on this queue?")
+
+    if args.role == "worker":
+        print("worker running; ctrl-c to stop")
+        try:
+            while True:
+                time.sleep(1)
+        except KeyboardInterrupt:
+            pass
+    if serving is not None:
+        serving.stop()
+
+
+if __name__ == "__main__":
+    main()

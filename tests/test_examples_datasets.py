@@ -145,3 +145,8 @@ class TestExamplesRun:
         out = _run_example("nnframes/nnframes_example.py",
                            "--epochs", "4")
         assert "pipeline accuracy" in out
+
+    def test_cluster_serving_example(self):
+        out = _run_example("inference/cluster_serving_example.py",
+                           "--requests", "6")
+        assert "received 6/6 predictions" in out
