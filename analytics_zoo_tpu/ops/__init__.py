@@ -3,3 +3,10 @@ from analytics_zoo_tpu.ops.attention import (  # noqa: F401
     dot_product_attention,
     reference_attention,
 )
+from analytics_zoo_tpu.ops.flash_attention import flash_attention  # noqa: F401
+from analytics_zoo_tpu.ops.quantization import (  # noqa: F401
+    Calibrator,
+    int8_dot,
+    quantize_program,
+    quantize_tensor,
+)
