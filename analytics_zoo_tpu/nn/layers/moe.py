@@ -1,0 +1,158 @@
+"""Sparse Mixture-of-Experts with expert parallelism.
+
+Reference capability: **absent** (SURVEY.md §2.4 — expert parallelism is
+an explicit gap in the reference).  TPU-native design: dense one-hot
+dispatch/combine einsums (the Switch/GShard recipe) so routing lowers to
+MXU matmuls with static shapes — no scatter, no dynamic shapes, nothing
+XLA can't tile.  The expert dimension of both weights and the dispatched
+activations is sharded over an ``expert`` mesh axis; GSPMD inserts the
+all-to-alls over ICI.
+
+Routing = top-k gating with capacity: each expert processes at most
+``C = ceil(top_k * N * capacity_factor / E)`` tokens per batch; overflow
+tokens are dropped from that expert (their combine weight is zero), the
+standard capacity discipline that keeps shapes static.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from analytics_zoo_tpu.nn import activations, initializers
+from analytics_zoo_tpu.nn.module import Layer
+
+
+class SparseMoE(Layer):
+    """Mixture-of-experts FFN: ``y[t] = Σ_k gate_k(t) · FFN_{e_k(t)}(x[t])``.
+
+    Params: gate kernel (D, E) + per-expert FFN weights stacked on a
+    leading E dim — ``w1 (E, D, H)``, ``w2 (E, H, D_out)`` — so an
+    ``ExpertParallel`` strategy (or ``expert_axis=``) shards dim 0.
+
+    ``state`` carries the Switch-style load-balance auxiliary loss under
+    ``"aux_loss"`` (refreshed every call); add
+    ``aux_loss_weight * state["aux_loss"]`` to the objective when
+    training routers.
+    """
+
+    def __init__(self, n_experts: int, hidden_dim: int,
+                 output_dim: Optional[int] = None, top_k: int = 2,
+                 capacity_factor: float = 1.25, activation="relu",
+                 expert_axis: Optional[str] = None,
+                 init="glorot_uniform", dtype=jnp.float32, **kw):
+        super().__init__(**kw)
+        if top_k < 1 or top_k > n_experts:
+            raise ValueError(f"top_k {top_k} out of range for "
+                             f"{n_experts} experts")
+        self.n_experts = n_experts
+        self.hidden_dim = hidden_dim
+        self.output_dim = output_dim
+        self.top_k = top_k
+        self.capacity_factor = capacity_factor
+        self.activation = activations.get(activation)
+        self.expert_axis = expert_axis
+        self.initializer = initializers.get(init)
+        self.dtype = dtype
+
+    def build(self, rng, input_shape):
+        d = input_shape[-1]
+        out = self.output_dim or d
+        kg, k1, k2 = jax.random.split(rng, 3)
+        e, h = self.n_experts, self.hidden_dim
+        params = {
+            "gate": self.initializer(kg, (d, e), self.dtype),
+            "w1": self.initializer(k1, (e, d, h), self.dtype),
+            "b1": jnp.zeros((e, h), self.dtype),
+            "w2": self.initializer(k2, (e, h, out), self.dtype),
+            "b2": jnp.zeros((e, out), self.dtype),
+        }
+        return params, {"aux_loss": jnp.zeros((), jnp.float32)}
+
+    # -- routing ----------------------------------------------------------
+    def _route(self, gates, n_tokens):
+        """gates (N, E) softmax probs -> dispatch/combine (N, E, C)."""
+        e = self.n_experts
+        cap = int(np.ceil(self.top_k * n_tokens * self.capacity_factor / e))
+        cap = max(cap, 1)
+        topw, topi = lax.top_k(gates, self.top_k)          # (N, k)
+        topw = topw / jnp.maximum(topw.sum(-1, keepdims=True), 1e-9)
+
+        counts = jnp.zeros((e,), jnp.float32)
+        dispatch = jnp.zeros((gates.shape[0], e, cap), gates.dtype)
+        combine = jnp.zeros_like(dispatch)
+        for j in range(self.top_k):
+            oh = jax.nn.one_hot(topi[:, j], e, dtype=jnp.float32)   # (N, E)
+            pos = jnp.cumsum(oh, axis=0) - 1.0 + counts[None, :]    # (N, E)
+            counts = counts + oh.sum(0)
+            keep = oh * (pos < cap)                                  # (N, E)
+            pos_oh = jax.nn.one_hot(
+                jnp.clip(pos, 0, cap - 1).astype(jnp.int32), cap,
+                dtype=gates.dtype)                                   # (N,E,C)
+            d_j = keep.astype(gates.dtype)[:, :, None] * pos_oh
+            dispatch = dispatch + d_j
+            combine = combine + d_j * topw[:, j][:, None, None]
+        return dispatch, combine, cap
+
+    def _constrain(self, x, spec):
+        if self.expert_axis is None:
+            return x
+        try:
+            from analytics_zoo_tpu.core.context import get_zoo_context
+            mesh = get_zoo_context().mesh
+        except (ImportError, RuntimeError, LookupError):
+            return x          # no context initialised — run unconstrained
+        if self.expert_axis not in mesh.axis_names:
+            return x
+        # a failing with_sharding_constraint is a real misconfiguration
+        # and must propagate, not silently drop the expert layout
+        return lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+    def call(self, params, state, x, training: bool = False, rng=None):
+        orig = x.shape
+        d = orig[-1]
+        tokens = x.reshape(-1, d)                           # (N, D)
+        n = tokens.shape[0]
+        ax = self.expert_axis
+
+        logits = jnp.dot(tokens, params["gate"]).astype(jnp.float32)
+        gates = jax.nn.softmax(logits, axis=-1)             # (N, E)
+        dispatch, combine, cap = self._route(
+            gates.astype(tokens.dtype), n)
+
+        # Switch load-balance loss: E · Σ_e  frac_tokens(e) · mean_prob(e)
+        me = gates.mean(0)                                  # (E,)
+        ce = jax.nn.one_hot(jnp.argmax(gates, -1),
+                            self.n_experts).mean(0)         # (E,)
+        aux = self.n_experts * jnp.sum(me * ce)
+
+        # dispatch -> (E, C, D), sharded on the expert axis (GSPMD turns
+        # the layout change into an all-to-all over ICI)
+        expert_in = jnp.einsum("nd,nec->ecd", tokens, dispatch)
+        expert_in = self._constrain(expert_in, P(ax, None, None))
+        h = jnp.einsum("ecd,edh->ech", expert_in, params["w1"])
+        h = self.activation(h + params["b1"][:, None, :])
+        h = self._constrain(h, P(ax, None, None))
+        out = jnp.einsum("ech,eho->eco", h, params["w2"])
+        out = out + params["b2"][:, None, :]
+        out = self._constrain(out, P(ax, None, None))
+        y = jnp.einsum("eco,nec->no", out, combine)         # back to tokens
+
+        new_state = dict(state)
+        new_state["aux_loss"] = aux.astype(jnp.float32)
+        return y.reshape(orig[:-1] + y.shape[-1:]), new_state
+
+
+def moe_aux_loss(state) -> jax.Array:
+    """Sum every ``aux_loss`` entry in a (possibly nested) state pytree —
+    the term to add to the objective, scaled by the aux weight."""
+    total = jnp.zeros((), jnp.float32)
+    for path, leaf in jax.tree_util.tree_leaves_with_path(state):
+        if any(getattr(k, "key", None) == "aux_loss" for k in path):
+            total = total + jnp.asarray(leaf, jnp.float32)
+    return total

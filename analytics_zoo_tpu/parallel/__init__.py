@@ -1,6 +1,7 @@
 from analytics_zoo_tpu.parallel.sharding import (  # noqa: F401
     AutoSharding,
     DataParallel,
+    ExpertParallel,
     ShardingStrategy,
     TensorParallel,
     make_strategy,
@@ -8,4 +9,11 @@ from analytics_zoo_tpu.parallel.sharding import (  # noqa: F401
 from analytics_zoo_tpu.parallel.sequence import (  # noqa: F401
     ring_attention,
     ring_self_attention,
+)
+from analytics_zoo_tpu.parallel.pipeline import (  # noqa: F401
+    PipelineParallel,
+    pipeline_apply,
+    pipeline_spmd,
+    stack_stage_params,
+    stage_shardings,
 )

@@ -35,6 +35,19 @@ except ImportError:  # pragma: no cover — older jax
 NEG_INF = -1e30
 
 
+def mark_varying(x, axis_name: str):
+    """Mark a freshly-created (replicated) array as device-varying along
+    ``axis_name`` so shard_map scan carry types match axis-dependent loop
+    outputs.  Shared by ring attention and the pipeline schedule."""
+    try:
+        return lax.pcast(x, (axis_name,), to="varying")
+    except (AttributeError, TypeError):  # pragma: no cover — older jax
+        try:
+            return lax.pvary(x, (axis_name,))
+        except AttributeError:
+            return x
+
+
 def ring_attention(q, k, v, axis_name: str, causal: bool = False,
                    sm_scale: Optional[float] = None):
     """Attention where K/V are sharded over ``axis_name`` (per-device
@@ -72,12 +85,7 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
         return (m_out, l_new, acc, kc, vc), None
 
     def _vary(x):
-        # mark freshly-created accumulators as device-varying so the scan
-        # carry type matches its (axis-dependent) outputs under shard_map
-        try:
-            return lax.pvary(x, (axis_name,))
-        except AttributeError:  # pragma: no cover — older jax
-            return x
+        return mark_varying(x, axis_name)
 
     # f32 carry across ring steps, matching blockwise_attention/the Pallas
     # kernel's f32 scratch, so bf16 inputs don't round the accumulator

@@ -129,6 +129,44 @@ class TensorParallel(ShardingStrategy):
         return P(*spec)
 
 
+class ExpertParallel(ShardingStrategy):
+    """Shard MoE expert weights (leading ``n_experts`` dim) over the
+    mesh's ``expert`` axis — pairs with ``nn.layers.moe.SparseMoE``,
+    whose per-expert weights are stacked on dim 0.  Non-expert params
+    stay replicated (combine with TensorParallel via explicit rules if
+    both regimes are wanted).
+    """
+
+    def __init__(self, axis: str = "expert",
+                 pattern: str = r"(^|/)(w1|b1|w2|b2)$"):
+        # matches SparseMoE's expert-stacked leaves both as a bare param
+        # tree ("w1") and nested under a layer name ("sparsemoe_1/w1");
+        # the gate kernel never matches and stays replicated
+        self.axis = axis
+        self.pattern = re.compile(pattern)
+
+    def param_shardings(self, mesh, params):
+        sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+        if self.axis not in sizes:
+            raise ValueError(
+                f"ExpertParallel axis {self.axis!r} not in mesh axes "
+                f"{tuple(mesh.axis_names)}; build the context with an "
+                "expert axis, e.g. init_zoo_context(mesh_shape=(d, e), "
+                "axis_names=('data', 'expert'))")
+        n = sizes[self.axis]
+
+        def one(path, leaf):
+            p = path_str(path)
+            shape = getattr(leaf, "shape", ())
+            if (self.pattern.search(p) and shape
+                    and shape[0] % n == 0):
+                return NamedSharding(
+                    mesh, P(self.axis, *([None] * (len(shape) - 1))))
+            return NamedSharding(mesh, P())
+
+        return jax.tree_util.tree_map_with_path(one, params)
+
+
 class AutoSharding(TensorParallel):
     """Mesh-adaptive: tensor-parallel over the mesh's last axis when it has
     a dedicated (non-data) axis, plain data parallelism otherwise."""
@@ -156,6 +194,15 @@ def make_strategy(name: str, mesh, **kw) -> ShardingStrategy:
         return DataParallel()
     if name in ("auto",):
         return AutoSharding(**kw)
+    if name in ("ep", "expert", "expert_parallel"):
+        axis = kw.pop("axis", "expert")
+        if axis not in mesh.axis_names:
+            raise ValueError(
+                f"sharding='ep' needs a mesh with an {axis!r} axis (got "
+                f"axes {tuple(mesh.axis_names)}); use "
+                "init_zoo_context(mesh_shape=(d, e), "
+                "axis_names=('data', 'expert'))")
+        return ExpertParallel(axis=axis, **kw)
     if name in ("tp", "tensor", "tensor_parallel"):
         axis = kw.pop("axis", None)
         if axis is None:
@@ -168,4 +215,4 @@ def make_strategy(name: str, mesh, **kw) -> ShardingStrategy:
             axis = mesh.axis_names[-1]
         return TensorParallel(axis=axis, **kw)
     raise ValueError(f"unknown sharding strategy {name!r}; "
-                     "known: dp, tp, auto")
+                     "known: dp, tp, ep, auto")

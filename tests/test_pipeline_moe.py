@@ -1,0 +1,256 @@
+"""Pipeline parallelism + mixture-of-experts (SURVEY §2.4 gap closures).
+
+The reference has neither PP nor EP (SURVEY.md §2.4 lists both as absent);
+these tests pin the TPU-native implementations against sequential oracles
+on the virtual 8-device CPU mesh from conftest.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from analytics_zoo_tpu.nn.layers import SparseMoE, moe_aux_loss
+from analytics_zoo_tpu.parallel import (
+    ExpertParallel,
+    PipelineParallel,
+    pipeline_apply,
+    stack_stage_params,
+    stage_shardings,
+)
+
+
+def _stage_fn(p, x):
+    return jnp.tanh(x @ p["w"] + p["b"])
+
+
+def _stages(rs, n, d):
+    return [{"w": jnp.asarray(rs.randn(d, d).astype(np.float32) * 0.3),
+             "b": jnp.asarray(rs.randn(d).astype(np.float32) * 0.1)}
+            for _ in range(n)]
+
+
+def _seq_apply(stacked, x, n):
+    y = x
+    for i in range(n):
+        y = _stage_fn(jax.tree_util.tree_map(lambda l: l[i], stacked), y)
+    return y
+
+
+def _pipe_mesh(n):
+    return Mesh(np.asarray(jax.devices()[:n]).reshape(n), ("pipe",))
+
+
+class TestPipeline:
+    def test_forward_matches_sequential(self):
+        rs = np.random.RandomState(0)
+        S, D, B = 4, 16, 32
+        stacked = stack_stage_params(_stages(rs, S, D))
+        x = jnp.asarray(rs.randn(B, D).astype(np.float32))
+        out = pipeline_apply(_stage_fn, stacked, x, _pipe_mesh(S),
+                             n_microbatches=8)
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(_seq_apply(stacked, x, S)),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_grad_matches_sequential(self):
+        rs = np.random.RandomState(1)
+        S, D, B = 4, 8, 16
+        stacked = stack_stage_params(_stages(rs, S, D))
+        x = jnp.asarray(rs.randn(B, D).astype(np.float32))
+        mesh = _pipe_mesh(S)
+
+        g_pp = jax.grad(lambda sp: jnp.sum(pipeline_apply(
+            _stage_fn, sp, x, mesh, n_microbatches=4) ** 2))(stacked)
+        g_seq = jax.grad(lambda sp: jnp.sum(
+            _seq_apply(sp, x, S) ** 2))(stacked)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5),
+            g_pp, g_seq)
+
+    def test_eight_stage_full_mesh_jit_remat(self):
+        rs = np.random.RandomState(2)
+        S, D, B = 8, 8, 16
+        stacked = stack_stage_params(_stages(rs, S, D))
+        x = jnp.asarray(rs.randn(B, D).astype(np.float32))
+        mesh = _pipe_mesh(S)
+        out = jax.jit(lambda sp, xx: pipeline_apply(
+            _stage_fn, sp, xx, mesh, n_microbatches=4, remat=True))(
+                stacked, x)
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(_seq_apply(stacked, x, S)),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_harness_training_step(self):
+        # one SGD step through the pipelined loss decreases it
+        rs = np.random.RandomState(3)
+        S, D, B = 4, 8, 32
+        stacked = stack_stage_params(_stages(rs, S, D))
+        x = jnp.asarray(rs.randn(B, D).astype(np.float32))
+        y = jnp.asarray(rs.randn(B, D).astype(np.float32))
+        pp = PipelineParallel(_pipe_mesh(S), n_microbatches=8)
+        stacked = pp.shard_params(stacked)
+
+        def loss(sp):
+            return jnp.mean((pp.apply(_stage_fn, sp, x) - y) ** 2)
+
+        l0, g = jax.value_and_grad(loss)(stacked)
+        stepped = jax.tree_util.tree_map(lambda p, gg: p - 0.1 * gg,
+                                         stacked, g)
+        assert float(loss(stepped)) < float(l0)
+
+    def test_stage_shardings_place_slices(self):
+        rs = np.random.RandomState(4)
+        S, D = 4, 8
+        stacked = stack_stage_params(_stages(rs, S, D))
+        sh = stage_shardings(_pipe_mesh(S), stacked)
+        spec = jax.tree_util.tree_leaves(sh)[0].spec
+        assert spec[0] == "pipe"
+
+    def test_validation_errors(self):
+        rs = np.random.RandomState(5)
+        stacked = stack_stage_params(_stages(rs, 4, 8))
+        x = jnp.zeros((10, 8))
+        mesh = _pipe_mesh(4)
+        with pytest.raises(ValueError, match="not divisible"):
+            pipeline_apply(_stage_fn, stacked, x, mesh, n_microbatches=3)
+        with pytest.raises(ValueError, match="not in mesh"):
+            pipeline_apply(_stage_fn, stacked, x, mesh, axis_name="nope",
+                           n_microbatches=2)
+        with pytest.raises(ValueError, match="leading"):
+            bad = jax.tree_util.tree_map(lambda p: p[:3], stacked)
+            pipeline_apply(_stage_fn, bad, x, mesh, n_microbatches=2)
+
+
+class TestSparseMoE:
+    def _data(self, n=32, d=8, seed=0):
+        rs = np.random.RandomState(seed)
+        return jnp.asarray(rs.randn(n, d).astype(np.float32))
+
+    def test_forward_shape_and_aux(self):
+        m = SparseMoE(n_experts=4, hidden_dim=16, top_k=2,
+                      capacity_factor=2.0)
+        params, state = m.init(jax.random.PRNGKey(0), (32, 8))
+        y, ns = m.call(params, state, self._data())
+        assert y.shape == (32, 8)
+        assert float(ns["aux_loss"]) >= 1.0 - 1e-5  # ≥1 by Cauchy-Schwarz
+        assert float(moe_aux_loss(ns)) == pytest.approx(
+            float(ns["aux_loss"]))
+
+    def test_output_dim_and_top1(self):
+        m = SparseMoE(n_experts=2, hidden_dim=8, output_dim=5, top_k=1,
+                      capacity_factor=4.0)
+        params, state = m.init(jax.random.PRNGKey(1), (16, 8))
+        y, _ = m.call(params, state, self._data(16, 8, 1))
+        assert y.shape == (16, 5)
+
+    def test_high_capacity_matches_dense_mixture(self):
+        """With capacity ≥ all tokens and top_k == n_experts the MoE
+        reduces to a dense softmax-weighted mixture — an exact oracle."""
+        e, d, h, n = 3, 6, 10, 12
+        m = SparseMoE(n_experts=e, hidden_dim=h, top_k=e,
+                      capacity_factor=float(e * n))
+        params, state = m.init(jax.random.PRNGKey(2), (n, d))
+        x = self._data(n, d, 2)
+        y, _ = m.call(params, state, x)
+
+        gates = jax.nn.softmax(x @ params["gate"], axis=-1)   # (N, E)
+        outs = []
+        for i in range(e):
+            hdn = jnp.maximum(x @ params["w1"][i] + params["b1"][i], 0)
+            outs.append(hdn @ params["w2"][i] + params["b2"][i])
+        ref = sum(gates[:, i:i + 1] * outs[i] for i in range(e))
+        np.testing.assert_allclose(np.asarray(y), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_capacity_drops_overflow(self):
+        # capacity 1 token/expert: most combine mass must be dropped
+        m = SparseMoE(n_experts=2, hidden_dim=4, top_k=1,
+                      capacity_factor=2.0 / 32.0)
+        params, state = m.init(jax.random.PRNGKey(3), (32, 8))
+        x = self._data(32, 8, 3)
+        dispatch, _, cap = m._route(
+            jax.nn.softmax(x @ params["gate"], -1), 32)
+        assert cap == 1
+        assert float(dispatch.sum()) <= 2.0 + 1e-6   # ≤ E * C tokens kept
+
+    def test_gradients_flow_to_gate_and_experts(self):
+        m = SparseMoE(n_experts=4, hidden_dim=8, top_k=2,
+                      capacity_factor=2.0)
+        params, state = m.init(jax.random.PRNGKey(4), (16, 8))
+        x = self._data(16, 8, 4)
+
+        def loss(p):
+            y, ns = m.call(p, state, x)
+            return jnp.sum(y ** 2) + 0.01 * ns["aux_loss"]
+
+        g = jax.grad(loss)(params)
+        for k in ("gate", "w1", "w2"):
+            assert float(jnp.abs(g[k]).max()) > 0, k
+
+    def test_expert_parallel_shardings(self):
+        mesh = Mesh(np.asarray(jax.devices()[:8]).reshape(2, 4),
+                    ("data", "expert"))
+        m = SparseMoE(n_experts=4, hidden_dim=8, name="sparsemoe_ep")
+        params, _ = m.init(jax.random.PRNGKey(5), (16, 8))
+        tree = {"sparsemoe_ep": params}
+        sh = ExpertParallel(axis="expert").param_shardings(mesh, tree)
+        assert sh["sparsemoe_ep"]["w1"].spec == P("expert", None, None)
+        assert sh["sparsemoe_ep"]["b2"].spec == P("expert", None)
+        assert sh["sparsemoe_ep"]["gate"].spec == P()
+
+    def test_expert_parallel_shards_flat_param_tree(self):
+        # regression: SparseMoE.init returns a FLAT dict ("w1", not
+        # "layer/w1") — the default pattern must shard it too
+        mesh = Mesh(np.asarray(jax.devices()[:8]).reshape(2, 4),
+                    ("data", "expert"))
+        m = SparseMoE(n_experts=4, hidden_dim=8)
+        params, _ = m.init(jax.random.PRNGKey(7), (16, 8))
+        sh = ExpertParallel(axis="expert").param_shardings(mesh, params)
+        assert sh["w1"].spec == P("expert", None, None)
+        assert sh["b1"].spec == P("expert", None)
+        assert sh["gate"].spec == P()
+
+    def test_make_strategy_ep(self):
+        from analytics_zoo_tpu.parallel import make_strategy
+
+        mesh = Mesh(np.asarray(jax.devices()[:8]).reshape(2, 4),
+                    ("data", "expert"))
+        s = make_strategy("ep", mesh)
+        assert isinstance(s, ExpertParallel)
+        dmesh = Mesh(np.asarray(jax.devices()[:8]).reshape(8), ("data",))
+        with pytest.raises(ValueError, match="expert"):
+            make_strategy("ep", dmesh)
+
+    def test_expert_parallel_requires_axis(self):
+        mesh = Mesh(np.asarray(jax.devices()[:8]).reshape(8), ("data",))
+        with pytest.raises(ValueError, match="expert"):
+            ExpertParallel().param_shardings(mesh, {"w1": jnp.zeros((4, 2))})
+
+    def test_sharded_execution_matches_single_device(self):
+        from analytics_zoo_tpu import init_zoo_context
+        from analytics_zoo_tpu.core.context import get_zoo_context
+
+        m = SparseMoE(n_experts=4, hidden_dim=16, top_k=2,
+                      capacity_factor=2.0, expert_axis="expert",
+                      name="sparsemoe_shard")
+        params, state = m.init(jax.random.PRNGKey(6), (32, 8))
+        x = self._data(32, 8, 6)
+        ref, _ = m.call(params, state, x)
+
+        prev = get_zoo_context()
+        try:
+            init_zoo_context(mesh_shape=(2, 4),
+                             axis_names=("data", "expert"))
+            ctx = get_zoo_context()
+            sh = ExpertParallel(axis="expert").param_shardings(
+                ctx.mesh, params)
+            p_sh = jax.device_put(params, sh)
+            y = jax.jit(lambda p, xx: m.call(p, state, xx)[0])(p_sh, x)
+            np.testing.assert_allclose(np.asarray(y), np.asarray(ref),
+                                       rtol=1e-4, atol=1e-5)
+        finally:
+            from analytics_zoo_tpu.core.context import set_zoo_context
+            set_zoo_context(prev)
