@@ -7,5 +7,6 @@ from analytics_zoo_tpu.nn import (  # noqa: F401
     initializers,
     metrics,
     objectives,
+    regularizers,
 )
 from analytics_zoo_tpu.nn.layers import *  # noqa: F401,F403

@@ -81,8 +81,9 @@ class ConvND(StatelessLayer):
         self.use_bias = bias
         self.dim_ordering = dim_ordering
         self.dtype = dtype
-        self.w_regularizer = w_regularizer
-        self.b_regularizer = b_regularizer
+        from analytics_zoo_tpu.nn import regularizers as _reg
+        self.w_regularizer = _reg.get(w_regularizer)
+        self.b_regularizer = _reg.get(b_regularizer)
 
     def _in_channels(self, input_shape) -> int:
         return (input_shape[1] if self.dim_ordering == "th"

@@ -36,8 +36,9 @@ class Dense(StatelessLayer):
         self.use_bias = use_bias
         self.initializer = initializers.get(init)
         self.dtype = dtype
-        self.w_regularizer = w_regularizer
-        self.b_regularizer = b_regularizer
+        from analytics_zoo_tpu.nn import regularizers as _reg
+        self.w_regularizer = _reg.get(w_regularizer)
+        self.b_regularizer = _reg.get(b_regularizer)
 
     def build_params(self, rng, input_shape):
         in_dim = input_shape[-1]

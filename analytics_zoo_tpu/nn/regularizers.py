@@ -1,0 +1,68 @@
+"""Weight regularizers — L1/L2 penalties for layer ``w_regularizer`` /
+``b_regularizer`` kwargs (reference: BigDL L1Regularizer/L2Regularizer
+wrapped by every Keras layer's ``wRegularizer`` params).
+
+A regularizer is just ``fn(weights) -> scalar``; these classes are the
+named, serializable spellings.  The penalty is summed over layers by
+``KerasNet.regularization_loss`` and added to the training objective
+inside the jitted step (on the f32 master params under mixed precision).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class Regularizer:
+    def __call__(self, w):
+        raise NotImplementedError
+
+
+class L1(Regularizer):
+    def __init__(self, l1: float = 0.01):
+        self.l1 = float(l1)
+
+    def __call__(self, w):
+        return self.l1 * jnp.sum(jnp.abs(w))
+
+    def __repr__(self):
+        return f"L1(l1={self.l1})"
+
+
+class L2(Regularizer):
+    def __init__(self, l2: float = 0.01):
+        self.l2 = float(l2)
+
+    def __call__(self, w):
+        return self.l2 * jnp.sum(jnp.square(w))
+
+    def __repr__(self):
+        return f"L2(l2={self.l2})"
+
+
+class L1L2(Regularizer):
+    def __init__(self, l1: float = 0.01, l2: float = 0.01):
+        self.l1 = float(l1)
+        self.l2 = float(l2)
+
+    def __call__(self, w):
+        return (self.l1 * jnp.sum(jnp.abs(w))
+                + self.l2 * jnp.sum(jnp.square(w)))
+
+    def __repr__(self):
+        return f"L1L2(l1={self.l1}, l2={self.l2})"
+
+
+def get(spec):
+    """Lower a spec to a regularizer: None | callable | "l1" | "l2" |
+    "l1l2" (Keras-style string lowering)."""
+    if spec is None or callable(spec):
+        return spec
+    name = str(spec).lower()
+    if name == "l1":
+        return L1()
+    if name == "l2":
+        return L2()
+    if name in ("l1l2", "l1_l2"):
+        return L1L2()
+    raise ValueError(f"unknown regularizer {spec!r}; known: l1, l2, l1l2")

@@ -29,18 +29,22 @@ class KerasNet(Layer):
         self._estimator = None  # created by compile()
 
     # -- training facade (delegates to train.Estimator) -------------------
-    def compile(self, optimizer, loss, metrics=None, sharding="dp"):
+    def compile(self, optimizer, loss, metrics=None, sharding="dp",
+                aux_loss_weight: float = 0.01):
         """Configure training (reference Topology.scala:136-204).
 
         ``optimizer``/``loss``/``metrics`` accept strings (Keras-style
         lowering, reference KerasUtils.scala:165-167) or objects.
         ``sharding``: "dp" (replicated params) | "tp" (model-axis splits)
-        | a parallel.ShardingStrategy.
+        | "ep" (expert-axis MoE splits) | a parallel.ShardingStrategy.
+        ``aux_loss_weight`` scales any layer-emitted auxiliary losses
+        (SparseMoE load balancing) added to the objective.
         """
         from analytics_zoo_tpu.train.estimator import Estimator
 
         self._estimator = Estimator(self, optimizer=optimizer, loss=loss,
-                                    metrics=metrics, sharding=sharding)
+                                    metrics=metrics, sharding=sharding,
+                                    aux_loss_weight=aux_loss_weight)
         # apply settings made before compile()
         if getattr(self, "_tb_dir", None):
             self._estimator.set_tensorboard(self._tb_dir)
@@ -117,6 +121,18 @@ class KerasNet(Layer):
     @property
     def layers(self) -> List[Layer]:
         raise NotImplementedError
+
+    def regularization_loss(self, params):
+        """Sum of every layer's weight-decay penalty (w/b_regularizer
+        kwargs) — added to the training objective by the Estimator.
+        Layers without regularizers contribute a literal 0.0, which
+        constant-folds away under jit."""
+        total = 0.0
+        for layer in self.layers:
+            fn = getattr(layer, "regularization_loss", None)
+            if fn is not None:
+                total = total + fn(params.get(layer.name, {}))
+        return total
 
 
 class Sequential(KerasNet):

@@ -158,8 +158,13 @@ class ExpertParallel(ShardingStrategy):
         def one(path, leaf):
             p = path_str(path)
             shape = getattr(leaf, "shape", ())
-            if (self.pattern.search(p) and shape
-                    and shape[0] % n == 0):
+            if self.pattern.search(p) and shape:
+                if shape[0] % n:
+                    raise ValueError(
+                        f"expert param {p!r} has {shape[0]} experts, not "
+                        f"divisible by the {self.axis!r} axis size {n} — "
+                        "silently replicating would discard the requested "
+                        "expert partitioning; adjust n_experts or the mesh")
                 return NamedSharding(
                     mesh, P(self.axis, *([None] * (len(shape) - 1))))
             return NamedSharding(mesh, P())

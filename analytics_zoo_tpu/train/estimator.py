@@ -87,8 +87,10 @@ class Estimator:
                  ctx: Optional[ZooContext] = None,
                  grad_clip_norm: Optional[float] = None,
                  grad_clip_value: Optional[float] = None,
-                 sharding="dp", compute_dtype: Optional[str] = None):
+                 sharding="dp", compute_dtype: Optional[str] = None,
+                 aux_loss_weight: float = 0.01):
         self.model = model
+        self.aux_loss_weight = aux_loss_weight
         self.tx = optim_lib.get(optimizer)
         self._sharding_strategy = sharding  # "dp" | "tp" | ShardingStrategy
         if grad_clip_norm is not None:
@@ -213,6 +215,7 @@ class Estimator:
         data_shard = self.ctx.data_sharding()
         rep = self.ctx.replicated_sharding()
         cdtype = self.compute_dtype
+        aux_w = self.aux_loss_weight
         # transfer-learning freeze (nn/net.py GraphNet.freeze): frozen
         # top-level param subtrees get zero updates inside the jitted step
         frozen = frozenset(getattr(model, "_frozen", ()))
@@ -241,6 +244,15 @@ class Estimator:
                     preds = _cast_floats(preds, jnp.float32)
                     new_state = _cast_like(new_state, state)
                 loss = loss_fn(y, preds)
+                # weight-decay regularizers on the f32 master params (a
+                # literal 0.0 when no layer has one) + layer auxiliary
+                # losses (SparseMoE load balancing, surfaced via state)
+                reg = getattr(model, "regularization_loss", None)
+                if reg is not None:
+                    loss = loss + reg(p)
+                if aux_w:
+                    from analytics_zoo_tpu.nn.layers.moe import moe_aux_loss
+                    loss = loss + aux_w * moe_aux_loss(new_state)
                 return loss, new_state
 
             (loss, new_state), grads = jax.value_and_grad(

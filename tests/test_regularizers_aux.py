@@ -1,0 +1,112 @@
+"""Regularizers + layer auxiliary losses feeding the training objective.
+
+Reference: every Keras layer carries wRegularizer/bRegularizer (BigDL
+L1/L2) whose penalty joins the criterion; here KerasNet.regularization_loss
+aggregates them and the Estimator adds them (plus SparseMoE aux losses)
+inside the jitted step.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu import init_zoo_context
+from analytics_zoo_tpu.nn import regularizers, reset_name_scope
+from analytics_zoo_tpu.nn.layers import Dense, SparseMoE
+from analytics_zoo_tpu.nn.regularizers import L1, L1L2, L2
+from analytics_zoo_tpu.nn.topology import Sequential
+
+
+class TestRegularizers:
+    def test_penalties(self):
+        w = jnp.asarray([[1.0, -2.0], [3.0, -4.0]])
+        assert float(L1(0.1)(w)) == pytest.approx(1.0)
+        assert float(L2(0.1)(w)) == pytest.approx(3.0)
+        assert float(L1L2(0.1, 0.1)(w)) == pytest.approx(4.0)
+
+    def test_get_lowering(self):
+        assert isinstance(regularizers.get("l2"), L2)
+        assert isinstance(regularizers.get("l1"), L1)
+        assert isinstance(regularizers.get("l1l2"), L1L2)
+        assert regularizers.get(None) is None
+        fn = lambda w: jnp.sum(w)
+        assert regularizers.get(fn) is fn
+        with pytest.raises(ValueError, match="unknown regularizer"):
+            regularizers.get("elastic")
+
+    def test_net_aggregates_layer_penalties(self):
+        reset_name_scope()
+        net = Sequential([
+            Dense(4, input_shape=(3,), w_regularizer=L2(1.0),
+                  use_bias=False),
+            Dense(2, w_regularizer=L2(1.0), use_bias=False),
+        ])
+        params, _ = net.init(jax.random.PRNGKey(0))
+        expect = sum(float(jnp.sum(jnp.square(p["kernel"])))
+                     for p in params.values())
+        assert float(net.regularization_loss(params)) == pytest.approx(
+            expect, rel=1e-6)
+
+    def test_l2_shrinks_weights_in_fit(self):
+        init_zoo_context()
+        rs = np.random.RandomState(0)
+        x = rs.randn(256, 8).astype(np.float32)
+        y = rs.randn(256, 4).astype(np.float32)
+
+        def norm_after_fit(reg):
+            reset_name_scope()
+            m = Sequential([Dense(4, input_shape=(8,), w_regularizer=reg)])
+            m.compile(optimizer="adam", loss="mse")
+            m.fit(x, y, batch_size=64, nb_epoch=8, verbose=False)
+            key = next(iter(m.estimator.params))
+            return float(jnp.linalg.norm(m.estimator.params[key]["kernel"]))
+
+        assert norm_after_fit(L2(0.5)) < norm_after_fit(None)
+
+
+class TestAuxLossTraining:
+    def test_moe_in_sequential_trains_via_fit(self):
+        init_zoo_context(mesh_shape=(4, 2), axis_names=("data", "expert"))
+        try:
+            reset_name_scope()
+            rs = np.random.RandomState(0)
+            x = rs.randn(256, 16).astype(np.float32)
+            y = rs.randint(0, 4, 256).astype(np.int32)
+            m = Sequential([
+                Dense(32, activation="relu", input_shape=(16,)),
+                SparseMoE(n_experts=4, hidden_dim=64, top_k=2,
+                          capacity_factor=2.0, expert_axis="expert"),
+                Dense(4),
+            ])
+            m.compile(optimizer="adam",
+                      loss="sparse_categorical_crossentropy_with_logits",
+                      metrics=["accuracy"], sharding="ep",
+                      aux_loss_weight=0.01)
+            hist = m.fit(x, y, batch_size=64, nb_epoch=3, verbose=False)
+            losses = [h["loss"] for h in hist]
+            assert losses[-1] < losses[0]
+        finally:
+            init_zoo_context()  # restore default mesh for other tests
+
+    def test_aux_weight_changes_objective(self):
+        init_zoo_context()
+        reset_name_scope()
+        rs = np.random.RandomState(1)
+        x = rs.randn(64, 8).astype(np.float32)
+        y = rs.randint(0, 2, 64).astype(np.int32)
+
+        def first_loss(w):
+            reset_name_scope()
+            m = Sequential([SparseMoE(n_experts=2, hidden_dim=8,
+                                      capacity_factor=4.0,
+                                      input_shape=(8,)),
+                            Dense(2)])
+            m.compile(optimizer="sgd",
+                      loss="sparse_categorical_crossentropy_with_logits",
+                      aux_loss_weight=w)
+            h = m.fit(x, y, batch_size=64, nb_epoch=1, verbose=False)
+            return h[0]["loss"]
+
+        # a large aux weight must raise the reported objective
+        assert first_loss(10.0) > first_loss(0.0) + 0.5
