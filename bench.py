@@ -10,10 +10,14 @@ carries the supporting evidence the north star asks for:
   trained to convergence and evaluated with the NCF paper's protocol
   (held-out positive vs 99 negatives, HR@10).  The true MovieLens file
   is not fetchable here (zero egress); the generator reproduces its
-  shape (6040x3706), sparsity, and a learnable latent-factor structure,
-  so the accuracy number is meaningful, not decorative.
+  shape (6040x3706), sparsity (50 interactions/user - ml-1m's true mean
+  is ~165), and a learnable latent-factor structure with a quoted
+  oracle ceiling: HR@10 0.86 vs oracle 0.975, i.e. the framework
+  recovers ~88%% of the recoverable signal.
 - ncf_f32 / ncf_bf16: the mixed-precision delta (compute_dtype knob).
-- resnet50_imgs_per_sec_per_chip: BASELINE config #2 (bf16 train step).
+- resnet50_imgs_per_sec_per_chip: BASELINE config #2 (bf16 train step;
+  batch 256 by on-chip sweep - 1559 imgs/s vs 305 at batch 32, the MXU
+  needs the batch to tile).
 - flash_attention_ms vs blockwise_ms: the Pallas kernel ON SILICON
   against the pure-XLA blockwise fallback at L=2048.
 
@@ -204,8 +208,9 @@ def _movielens_like(n_users=6040, n_items=3706, latent=8, pos_per_user=20,
             heldout, scores)
 
 
-def bench_ncf_convergence(epochs=8, batch=2048, n_users=6040, n_items=3706,
-                          n_eval=2000):
+def bench_ncf_convergence(epochs=6, batch=2048, n_users=6040, n_items=3706,
+                          n_eval=2000, embed=32, mf_embed=32,
+                          hidden=(64, 32, 16), lr=1e-3, pos_per_user=50):
     """Full framework path: negative sampling -> FeatureSet -> Estimator
     (prefetch, fused multi-step dispatch, donated buffers) -> HR@10
     (held-out positive vs 99 negatives, the NCF paper's protocol)."""
@@ -217,14 +222,18 @@ def bench_ncf_convergence(epochs=8, batch=2048, n_users=6040, n_items=3706,
 
     init_zoo_context(steps_per_execution=32)
     reset_name_scope()
-    users, items, heldout, true_scores = _movielens_like(n_users, n_items)
+    users, items, heldout, true_scores = _movielens_like(
+        n_users, n_items, pos_per_user=pos_per_user)
 
     tr_u, tr_i, tr_y = negative_sample(users, items, n_items,
                                        neg_per_pos=4, seed=1)
+    from analytics_zoo_tpu.train.optimizers import Adam
+
     ncf = NeuralCF(user_count=n_users, item_count=n_items, class_num=2,
-                   user_embed=20, item_embed=20, hidden_layers=(40, 20, 10),
-                   mf_embed=20)
-    ncf.compile(optimizer="adam", loss="sparse_categorical_crossentropy",
+                   user_embed=embed, item_embed=embed, hidden_layers=hidden,
+                   mf_embed=mf_embed)
+    ncf.compile(optimizer=Adam(lr=lr),
+                loss="sparse_categorical_crossentropy",
                 metrics=["accuracy"])
     fs = FeatureSet.from_ndarrays(
         [tr_u[:, None].astype(np.int32), tr_i[:, None].astype(np.int32)],
@@ -276,7 +285,7 @@ def bench_ncf_convergence(epochs=8, batch=2048, n_users=6040, n_items=3706,
 # ResNet-50 (BASELINE config #2)
 # ---------------------------------------------------------------------------
 
-def bench_resnet50(device, batch=32, warmup=1, iters=5):
+def bench_resnet50(device, batch=256, warmup=1, iters=4):
     import jax
     import jax.numpy as jnp
 
@@ -313,7 +322,28 @@ def bench_resnet50(device, batch=32, warmup=1, iters=5):
 # Attention: Pallas flash kernel on silicon vs XLA blockwise fallback
 # ---------------------------------------------------------------------------
 
-def bench_attention(device, B=4, H=8, L=2048, D=64, iters=10):
+def _timed_rounds(cases, rounds=3, iters_per_round=8):
+    """Time each compiled thunk as min-of-``rounds`` interleaved rounds.
+
+    The tunnel's dispatch latency drifts 2-3x over tens of seconds, so
+    back-to-back case timing biases whichever ran during a bad window;
+    interleaving rounds (A B C A B C ...) exposes every case to the same
+    drift and the per-case MIN estimates the least-contended time."""
+    best = {name: float("inf") for name in cases}
+    for _ in range(rounds):
+        for name, thunk in cases.items():
+            r = thunk()
+            t0 = time.perf_counter()
+            for _ in range(iters_per_round):
+                r = thunk()
+            _sync(r)
+            best[name] = min(
+                best[name],
+                (time.perf_counter() - t0) / iters_per_round * 1e3)
+    return {k: round(v, 3) for k, v in best.items()}
+
+
+def bench_attention(device, B=4, H=8, L=2048, D=64, iters=30):
     import jax
     import jax.numpy as jnp
 
@@ -326,33 +356,23 @@ def bench_attention(device, B=4, H=8, L=2048, D=64, iters=10):
     q, k, v = mk(), mk(), mk()
 
     out = {}
+    cases = {}
     for name, fn in (("flash", lambda q, k, v: flash_attention(
             q, k, v, causal=True)),
                      ("blockwise", lambda q, k, v: blockwise_attention(
                          q, k, v, causal=True))):
         try:
             f = jax.jit(fn)
-            r = f(q, k, v)
-            _sync(r)
-            t0 = time.perf_counter()
-            for _ in range(iters):
-                r = f(q, k, v)
-            _sync(r)    # device runs in-order: last result drains all
-            out[f"{name}_ms"] = round(
-                (time.perf_counter() - t0) / iters * 1e3, 3)
-            # fwd+bwd: exercises the hand-written Pallas dQ/dKV kernels
+            _sync(f(q, k, v))                       # compile
+            cases[f"{name}_ms"] = (lambda f=f: f(q, k, v))
             fb = jax.jit(jax.grad(
                 lambda a, b, c: jnp.sum(fn(a, b, c)), argnums=(0, 1, 2)))
-            r = fb(q, k, v)
-            _sync(r)
-            t0 = time.perf_counter()
-            for _ in range(iters):
-                r = fb(q, k, v)
-            _sync(r)
-            out[f"{name}_fwdbwd_ms"] = round(
-                (time.perf_counter() - t0) / iters * 1e3, 3)
+            _sync(fb(q, k, v))                      # compile bwd kernels
+            cases[f"{name}_fwdbwd_ms"] = (lambda fb=fb: fb(q, k, v))
         except Exception as e:          # pallas unavailable on this backend
             out[f"{name}_error"] = type(e).__name__
+    out.update(_timed_rounds(cases, rounds=3,
+                             iters_per_round=max(2, iters // 3)))
     if "flash_ms" in out and "blockwise_ms" in out:
         out["flash_speedup"] = round(out["blockwise_ms"] / out["flash_ms"], 2)
     if "flash_fwdbwd_ms" in out and "blockwise_fwdbwd_ms" in out:
@@ -366,7 +386,7 @@ def bench_attention(device, B=4, H=8, L=2048, D=64, iters=10):
 # wp-bigdl.md:192, realised on the MXU's native int8 path)
 # ---------------------------------------------------------------------------
 
-def bench_int8(device, n=4096, iters=20):
+def bench_int8(device, n=8192, iters=12):
     import jax
     import jax.numpy as jnp
 
@@ -390,16 +410,13 @@ def bench_int8(device, n=4096, iters=20):
         "int8": jax.jit(lambda a, q: int8_dot(a, q, wscale,
                                               x_scale=xscale)),
     }
+    thunks = {}
     for name, f in cases.items():
         arg = wq if name == "int8" else wd
-        r = f(x, arg)
-        _sync(r)
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            r = f(x, arg)
-        _sync(r)
-        out[f"{name}_ms"] = round((time.perf_counter() - t0) / iters * 1e3,
-                                  3)
+        _sync(f(x, arg))                            # compile
+        thunks[f"{name}_ms"] = (lambda f=f, arg=arg: f(x, arg))
+    out.update(_timed_rounds(thunks, rounds=3,
+                             iters_per_round=max(2, iters // 3)))
     out["int8_vs_f32_speedup"] = round(out["f32_ms"] / out["int8_ms"], 2)
     return out
 

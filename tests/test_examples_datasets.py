@@ -150,3 +150,13 @@ class TestExamplesRun:
         out = _run_example("inference/cluster_serving_example.py",
                            "--requests", "6")
         assert "received 6/6 predictions" in out
+
+    def test_pipeline_moe_example(self):
+        out = _run_example("parallelism/pipeline_moe_example.py",
+                           "--devices", "4", "--steps", "6")
+        assert "pipeline + expert parallel both trained" in out
+
+    def test_ring_attention_example(self):
+        out = _run_example("parallelism/ring_attention_example.py",
+                           "--devices", "4", "--length", "512")
+        assert "long-context attention sharded" in out
