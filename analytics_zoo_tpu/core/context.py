@@ -119,7 +119,22 @@ def make_mesh(devices, mesh_shape, axis_names) -> "jax.sharding.Mesh":
             f"mesh_shape {mesh_shape} needs {np.prod(mesh_shape)} devices, "
             f"have {n}"
         )
-    dev_array = np.asarray(devices).reshape(mesh_shape)
+    # ICI-topology-aware device placement: on real TPU slices
+    # mesh_utils orders devices so the minor mesh axes ride physical
+    # ICI rings (collectives on the model/expert axis stay on-chip
+    # links instead of hopping the torus).  Falls back to a plain
+    # reshape on CPU meshes / single hosts where it doesn't apply.
+    dev_array = None
+    if devices and getattr(devices[0], "platform", "") == "tpu":
+        try:
+            from jax.experimental import mesh_utils
+
+            dev_array = mesh_utils.create_device_mesh(
+                tuple(mesh_shape), devices=devices)
+        except Exception:
+            dev_array = None
+    if dev_array is None:
+        dev_array = np.asarray(devices).reshape(mesh_shape)
     return Mesh(dev_array, tuple(axis_names))
 
 

@@ -1,2 +1,3 @@
 from analytics_zoo_tpu.train import checkpoint, optimizers  # noqa: F401
 from analytics_zoo_tpu.train.estimator import Estimator  # noqa: F401
+from analytics_zoo_tpu.train.local_estimator import LocalEstimator  # noqa: F401
