@@ -1,0 +1,214 @@
+"""Randomized property tests + native-code sanitizer lane.
+
+SURVEY.md §4 lists "no property-based tests" and §5.2 "host-side C++
+should run under TSan/ASan" as gaps the reference never closed; this
+module closes both.  Properties are checked over many random
+shapes/seeds (no hypothesis dependency — explicit seed loops keep
+failures reproducible by seed).
+"""
+
+import ctypes
+import os
+import subprocess
+import tempfile
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.ops.attention import (blockwise_attention,
+                                             reference_attention)
+
+
+class TestAttentionProperties:
+    def test_blockwise_equals_reference_over_random_shapes(self):
+        rs = np.random.RandomState(0)
+        for seed in range(8):
+            b = int(rs.randint(1, 3))
+            h = int(rs.randint(1, 4))
+            lq = int(rs.choice([16, 48, 64, 128]))
+            lk = int(rs.choice([16, 64, 96]))
+            d = int(rs.choice([8, 16, 32]))
+            causal = bool(rs.randint(2)) and lq == lk
+            q = jnp.asarray(rs.randn(b, h, lq, d).astype(np.float32))
+            k = jnp.asarray(rs.randn(b, h, lk, d).astype(np.float32))
+            v = jnp.asarray(rs.randn(b, h, lk, d).astype(np.float32))
+            out = blockwise_attention(q, k, v, causal=causal,
+                                      block_size=16)
+            ref = reference_attention(q, k, v, causal=causal)
+            np.testing.assert_allclose(
+                np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4,
+                err_msg=f"seed={seed} {b}x{h}x{lq}x{lk}x{d} causal={causal}")
+
+    def test_softmax_rows_sum_to_one_property(self):
+        # combine weights of attention == convex combination of V rows:
+        # attention output of constant V must be that constant
+        rs = np.random.RandomState(1)
+        for seed in range(4):
+            q = jnp.asarray(rs.randn(1, 2, 32, 8).astype(np.float32))
+            k = jnp.asarray(rs.randn(1, 2, 32, 8).astype(np.float32))
+            v = jnp.ones((1, 2, 32, 8), jnp.float32) * (seed + 1)
+            out = blockwise_attention(q, k, v, block_size=16)
+            np.testing.assert_allclose(np.asarray(out), seed + 1.0,
+                                       rtol=1e-5)
+
+
+class TestPipelineProperties:
+    def test_random_configs_match_sequential(self):
+        from analytics_zoo_tpu.parallel import (pipeline_apply,
+                                                stack_stage_params)
+        from jax.sharding import Mesh
+
+        rs = np.random.RandomState(2)
+        for seed in range(4):
+            S = int(rs.choice([2, 4, 8]))
+            D = int(rs.choice([4, 8, 16]))
+            M = int(rs.choice([2, 4]))
+            B = M * int(rs.randint(1, 5))
+            stages = [{"w": jnp.asarray(
+                rs.randn(D, D).astype(np.float32) * 0.3)} for _ in range(S)]
+            stacked = stack_stage_params(stages)
+            x = jnp.asarray(rs.randn(B, D).astype(np.float32))
+            mesh = Mesh(np.asarray(jax.devices()[:S]).reshape(S), ("pipe",))
+            out = pipeline_apply(lambda p, xx: jnp.tanh(xx @ p["w"]),
+                                 stacked, x, mesh, n_microbatches=M)
+            ref = x
+            for p in stages:
+                ref = jnp.tanh(ref @ p["w"])
+            np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                       rtol=1e-5, atol=1e-5,
+                                       err_msg=f"seed={seed} S={S} M={M}")
+
+
+class TestMoEProperties:
+    def test_combine_mass_conservation(self):
+        """Per-token combine mass is in [0, 1]: 1 when all its expert
+        slots fit under capacity, less when overflow drops slots, never
+        more (no token is double-counted)."""
+        from analytics_zoo_tpu.nn.layers import SparseMoE
+
+        rs = np.random.RandomState(3)
+        for seed, cf in [(0, 8.0), (1, 1.0), (2, 0.25)]:
+            m = SparseMoE(n_experts=4, hidden_dim=8, top_k=2,
+                          capacity_factor=cf)
+            params, _ = m.init(jax.random.PRNGKey(seed), (64, 8))
+            x = jnp.asarray(rs.randn(64, 8).astype(np.float32))
+            gates = jax.nn.softmax(x @ params["gate"], axis=-1)
+            dispatch, combine, cap = m._route(gates, 64)
+            mass = np.asarray(combine.sum(axis=(1, 2)))
+            assert (mass <= 1.0 + 1e-5).all(), (seed, cf)
+            assert (mass >= -1e-6).all()
+            if cf >= 8.0:          # nothing can overflow
+                np.testing.assert_allclose(mass, 1.0, rtol=1e-5)
+            # capacity is a hard bound on tokens per expert
+            per_expert = np.asarray(dispatch.sum(axis=(0, 2)))
+            assert (per_expert <= cap + 1e-5).all()
+
+
+class TestQuantizationProperties:
+    def test_roundtrip_error_bound(self):
+        from analytics_zoo_tpu.ops.quantization import quantize_tensor
+
+        rs = np.random.RandomState(4)
+        for seed in range(6):
+            w = rs.randn(64, 64).astype(np.float32) * 10 ** rs.randint(-2, 3)
+            q, scale = quantize_tensor(w)
+            err = np.abs(np.asarray(q, np.float32) * np.asarray(scale) - w)
+            # quantization error is at most half a step per element
+            assert err.max() <= float(np.asarray(scale).max()) * 0.5 + 1e-7, \
+                seed
+
+
+@pytest.mark.skipif(os.environ.get("ZOO_SKIP_SANITIZER") == "1",
+                    reason="sanitizer lane disabled")
+class TestNativeSanitizer:
+    """Build zoo_native.cpp under ASan+UBSan and drive crc32c +
+    the multi-threaded gather through it (SURVEY §5.2)."""
+
+    def _build(self, flags):
+        src = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "analytics_zoo_tpu", "native",
+            "zoo_native.cpp")
+        out = os.path.join(tempfile.mkdtemp(), "zoo_native_san.so")
+        try:
+            subprocess.run(
+                ["g++", "-O1", "-g", "-shared", "-fPIC", "-pthread",
+                 "-std=c++17", *flags, src, "-o", out],
+                check=True, capture_output=True, timeout=180)
+        except (subprocess.CalledProcessError, FileNotFoundError,
+                subprocess.TimeoutExpired) as e:
+            pytest.skip(f"sanitizer build unavailable: {e}")
+        return out
+
+    def test_asan_ubsan_clean(self):
+        so = self._build(["-fsanitize=address,undefined",
+                          "-fno-sanitize-recover=all"])
+        # run in a subprocess: ASan must be loaded first (LD_PRELOAD-free
+        # route = fresh interpreter with the sanitized lib dlopened early)
+        code = f"""
+import ctypes, numpy as np
+lib = ctypes.CDLL({so!r})
+lib.zoo_crc32c.restype = ctypes.c_uint32
+lib.zoo_crc32c.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+data = b"hello world" * 1000
+print("crc", lib.zoo_crc32c(data, len(data)))
+rows, cols = 512, 64
+src = np.random.RandomState(0).randn(rows, cols).astype(np.float32)
+idx = np.random.RandomState(1).randint(0, rows, 2048).astype(np.int64)
+dst = np.zeros((2048, cols), np.float32)
+lib.zoo_gather_rows.argtypes = [
+    ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+    ctypes.c_int64, ctypes.c_int64, ctypes.c_int32]
+lib.zoo_gather_rows(src.ctypes.data, idx.ctypes.data, dst.ctypes.data,
+                    2048, cols * 4, 4)
+assert np.array_equal(dst, src[idx])
+print("gather ok")
+"""
+        asan_rt = subprocess.run(
+            ["g++", "-print-file-name=libasan.so"],
+            capture_output=True, text=True).stdout.strip()
+        env = dict(os.environ)
+        if asan_rt and os.path.sep in asan_rt:
+            env["LD_PRELOAD"] = asan_rt
+        env["ASAN_OPTIONS"] = "detect_leaks=0"
+        proc = subprocess.run(
+            ["python", "-c", code], capture_output=True, text=True,
+            timeout=180, env=env)
+        if proc.returncode != 0 and "ASan" in proc.stderr and \
+                "incompatible" in proc.stderr:
+            pytest.skip("ASan runtime preload incompatible here")
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        assert "gather ok" in proc.stdout
+
+    def test_tsan_gather_clean(self):
+        so = self._build(["-fsanitize=thread"])
+        code = f"""
+import ctypes, numpy as np
+lib = ctypes.CDLL({so!r})
+rows, cols = 1024, 32
+src = np.random.RandomState(0).randn(rows, cols).astype(np.float32)
+idx = np.random.RandomState(1).randint(0, rows, 65536).astype(np.int64)
+dst = np.zeros((65536, cols), np.float32)
+lib.zoo_gather_rows.argtypes = [
+    ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+    ctypes.c_int64, ctypes.c_int64, ctypes.c_int32]
+lib.zoo_gather_rows(src.ctypes.data, idx.ctypes.data, dst.ctypes.data,
+                    65536, cols * 4, 8)
+assert np.array_equal(dst, src[idx])
+print("tsan gather ok")
+"""
+        tsan_rt = subprocess.run(
+            ["g++", "-print-file-name=libtsan.so"],
+            capture_output=True, text=True).stdout.strip()
+        env = dict(os.environ)
+        if tsan_rt and os.path.sep in tsan_rt:
+            env["LD_PRELOAD"] = tsan_rt
+        proc = subprocess.run(
+            ["python", "-c", code], capture_output=True, text=True,
+            timeout=180, env=env)
+        if proc.returncode != 0 and ("incompatible" in proc.stderr
+                                     or "unsupported" in proc.stderr):
+            pytest.skip("TSan runtime preload incompatible here")
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        assert "tsan gather ok" in proc.stdout
