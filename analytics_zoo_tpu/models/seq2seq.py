@@ -210,6 +210,97 @@ class Seq2seqNet(KerasNet):
                                        length=max_seq_len)
         return toks.swapaxes(0, 1)  # (B, max_seq_len)
 
+    # -- beam search (beyond reference: Seq2seq.scala only greedy-decodes)
+    def beam_search(self, params, enc_ids, start_sign: int,
+                    max_seq_len: int, beam_size: int = 4,
+                    stop_sign: Optional[int] = None,
+                    length_penalty: float = 0.0):
+        """Fixed-shape beam search as one ``lax.scan`` (XLA-friendly: no
+        dynamic shapes, no host round trips; backtrace is a second scan).
+
+        Returns ``(tokens (B, max_seq_len), scores (B,))`` for the best
+        beam.  ``length_penalty`` > 0 divides scores by (length**p) at
+        the end (GNMT-style), favouring longer sequences.
+        """
+        V, K = self.vocab_size, beam_size
+        b = enc_ids.shape[0]
+        NEG = -1e30
+
+        enc_emb = self.embedding.forward(params["embed"], enc_ids)
+        _, enc_states = self.encoder.forward(params["enc"], enc_emb)
+        states = self.bridge.apply_states(params["bridge"], enc_states)
+        # replicate encoder states across beams: (B, ...) -> (B*K, ...)
+        states = jax.tree_util.tree_map(
+            lambda s: jnp.repeat(s, K, axis=0), states)
+
+        tok0 = jnp.full((b * K, 1), start_sign, jnp.int32)
+        # beam 0 starts live, others -inf so step 1 fans out of one beam
+        score0 = jnp.tile(jnp.asarray([0.0] + [NEG] * (K - 1),
+                                      jnp.float32), (b, 1))     # (B, K)
+        done0 = jnp.zeros((b, K), bool)
+
+        def gather_beams(tree, beam_idx):
+            # tree leaves (B*K, ...) -> pick beam_idx (B, K) per batch
+            def g(s):
+                sk = s.reshape((b, K) + s.shape[1:])
+                idx = beam_idx.reshape(
+                    (b, K) + (1,) * (s.ndim - 1)).astype(jnp.int32)
+                return jnp.take_along_axis(
+                    sk, jnp.broadcast_to(idx, (b, K) + s.shape[1:]),
+                    axis=1).reshape(s.shape)
+            return jax.tree_util.tree_map(g, tree)
+
+        def step(carry, _):
+            tok, states, scores, done = carry
+            emb = self.embedding.forward(params["embed"], tok)  # (B*K,1,E)
+            out, new_states = self.decoder.run_with_states(
+                params["dec"], emb, states, return_state=True)
+            logits = self.generator.forward(params["gen"], out[:, -1])
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+            logp = logp.reshape(b, K, V)
+            if stop_sign is not None:
+                # a finished beam can only extend with stop_sign, free
+                pad = jnp.full((V,), NEG).at[stop_sign].set(0.0)
+                logp = jnp.where(done[:, :, None], pad[None, None, :], logp)
+            total = scores[:, :, None] + logp                   # (B, K, V)
+            flat = total.reshape(b, K * V)
+            new_scores, top = jax.lax.top_k(flat, K)            # (B, K)
+            beam_idx = (top // V).astype(jnp.int32)
+            token = (top % V).astype(jnp.int32)
+            new_states = gather_beams(new_states, beam_idx)
+            done = jnp.take_along_axis(done, beam_idx, axis=1)
+            if stop_sign is not None:
+                done = done | (token == stop_sign)
+            return ((token.reshape(b * K, 1), new_states, new_scores,
+                     done), (token, beam_idx))
+
+        (_, _, scores, done), (toks, parents) = jax.lax.scan(
+            step, (tok0, states, score0, done0), None,
+            length=max_seq_len)                  # toks (T, B, K)
+
+        if length_penalty > 0 and stop_sign is not None:
+            lengths = jnp.sum(
+                jnp.cumprod((toks != stop_sign).astype(jnp.float32),
+                            axis=0), axis=0)     # (B, K) pre-stop length
+            scores = scores / jnp.maximum(lengths, 1.0) ** length_penalty
+
+        best = jnp.argmax(scores, axis=-1).astype(jnp.int32)    # (B,)
+
+        # backtrace: follow parent pointers from the best final beam
+        def back(beam, t_rev):
+            tk = jnp.take_along_axis(toks[t_rev], beam[:, None],
+                                     axis=1)[:, 0]
+            beam = jnp.take_along_axis(parents[t_rev], beam[:, None],
+                                       axis=1)[:, 0]
+            return beam, tk
+
+        _, seq_rev = jax.lax.scan(back, best,
+                                  jnp.arange(max_seq_len - 1, -1, -1))
+        seq = seq_rev[::-1].swapaxes(0, 1)                      # (B, T)
+        best_scores = jnp.take_along_axis(scores, best[:, None],
+                                          axis=1)[:, 0]
+        return seq, best_scores
+
 
 @register_model
 class Seq2seq(ZooModel):
@@ -252,3 +343,18 @@ class Seq2seq(ZooModel):
         out = self._infer_jit(est.params, jnp.asarray(enc_ids), start_sign,
                               max_seq_len, stop_sign)
         return np.asarray(out)
+
+    def infer_beam(self, enc_ids: np.ndarray, start_sign: int,
+                   max_seq_len: int = 30, beam_size: int = 4,
+                   stop_sign: Optional[int] = None,
+                   length_penalty: float = 0.0):
+        """Beam-search decode; returns (tokens (B, T), scores (B,))."""
+        est = self.model.estimator
+        est._ensure_built([np.asarray(enc_ids), np.asarray(enc_ids)])
+        if not hasattr(self, "_beam_jit"):
+            self._beam_jit = jax.jit(self.model.beam_search,
+                                     static_argnums=(2, 3, 4, 5, 6))
+        seq, scores = self._beam_jit(est.params, jnp.asarray(enc_ids),
+                                     start_sign, max_seq_len, beam_size,
+                                     stop_sign, length_penalty)
+        return np.asarray(seq), np.asarray(scores)

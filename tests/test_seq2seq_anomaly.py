@@ -52,6 +52,49 @@ class TestSeq2seq:
         np.testing.assert_array_equal(out1, out2)
         assert out1.dtype == np.int32
 
+    def test_beam_search_invariants(self):
+        """beam_size=1 reproduces greedy; wider beams never score worse;
+        the returned score IS the teacher-forced log-prob of the
+        returned sequence."""
+        import jax
+        import jax.numpy as jnp
+
+        m = Seq2seq(vocab_size=14, embed_dim=8, hidden_size=16)
+        m.compile(optimizer=Adam(1e-3),
+                  loss="sparse_categorical_crossentropy_with_logits")
+        enc, dec, tgt = self._data(n=32, t=5, vocab=14, seed=3)
+        m.fit([enc, dec], tgt, batch_size=16, nb_epoch=1, verbose=False)
+
+        greedy = m.infer(enc[:6], start_sign=1, max_seq_len=6)
+        seq1, sc1 = m.infer_beam(enc[:6], start_sign=1, max_seq_len=6,
+                                 beam_size=1)
+        np.testing.assert_array_equal(greedy, seq1)
+        seq4, sc4 = m.infer_beam(enc[:6], start_sign=1, max_seq_len=6,
+                                 beam_size=4)
+        assert (sc4 >= sc1 - 1e-5).all()
+
+        params = m.model.estimator.params
+        dec_in = np.concatenate(
+            [np.ones((6, 1), np.int32), np.asarray(seq4)[:, :-1]], axis=1)
+        logits, _ = m.model.call(params, {}, jnp.asarray(enc[:6]),
+                                 jnp.asarray(dec_in))
+        lp = jax.nn.log_softmax(np.asarray(logits, np.float32), axis=-1)
+        taken = np.take_along_axis(
+            np.asarray(lp), np.asarray(seq4)[:, :, None], axis=2)[:, :, 0]
+        np.testing.assert_allclose(taken.sum(axis=1), sc4, atol=1e-3)
+
+    def test_beam_search_stop_sign_and_length_penalty(self):
+        m = Seq2seq(vocab_size=10, embed_dim=8, hidden_size=16)
+        m.compile(optimizer=Adam(1e-3),
+                  loss="sparse_categorical_crossentropy_with_logits")
+        enc = np.random.RandomState(5).randint(
+            2, 10, (4, 5)).astype(np.int32)
+        seq, sc = m.infer_beam(enc, start_sign=1, max_seq_len=6,
+                               beam_size=3, stop_sign=2,
+                               length_penalty=0.6)
+        assert seq.shape == (4, 6) and sc.shape == (4,)
+        assert np.isfinite(sc).all()
+
     def test_infer_stop_sign_pads_after_stop(self):
         m = Seq2seq(vocab_size=10, embed_dim=8, hidden_size=16)
         m.compile(optimizer=Adam(1e-3),
