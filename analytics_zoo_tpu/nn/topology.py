@@ -30,7 +30,7 @@ class KerasNet(Layer):
 
     # -- training facade (delegates to train.Estimator) -------------------
     def compile(self, optimizer, loss, metrics=None, sharding="dp",
-                aux_loss_weight: float = 0.01):
+                aux_loss_weight: float = 0.01, grad_accum_steps: int = 1):
         """Configure training (reference Topology.scala:136-204).
 
         ``optimizer``/``loss``/``metrics`` accept strings (Keras-style
@@ -44,7 +44,8 @@ class KerasNet(Layer):
 
         self._estimator = Estimator(self, optimizer=optimizer, loss=loss,
                                     metrics=metrics, sharding=sharding,
-                                    aux_loss_weight=aux_loss_weight)
+                                    aux_loss_weight=aux_loss_weight,
+                                    grad_accum_steps=grad_accum_steps)
         # apply settings made before compile()
         if getattr(self, "_tb_dir", None):
             self._estimator.set_tensorboard(self._tb_dir)

@@ -88,10 +88,19 @@ class Estimator:
                  grad_clip_norm: Optional[float] = None,
                  grad_clip_value: Optional[float] = None,
                  sharding="dp", compute_dtype: Optional[str] = None,
-                 aux_loss_weight: float = 0.01):
+                 aux_loss_weight: float = 0.01,
+                 grad_accum_steps: int = 1):
         self.model = model
         self.aux_loss_weight = aux_loss_weight
         self.tx = optim_lib.get(optimizer)
+        if grad_accum_steps > 1:
+            # one optimizer update per A micro-batches: grads average in
+            # f32 inside opt-state, params stay fixed between updates —
+            # the A-times-larger effective batch without A-times the
+            # activation memory (complements steps_per_execution, which
+            # fuses real updates per dispatch)
+            self.tx = optax.MultiSteps(self.tx, grad_accum_steps)
+        self.grad_accum_steps = grad_accum_steps
         self._sharding_strategy = sharding  # "dp" | "tp" | ShardingStrategy
         if grad_clip_norm is not None:
             self.tx = optax.chain(optax.clip_by_global_norm(grad_clip_norm), self.tx)

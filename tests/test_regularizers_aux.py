@@ -65,6 +65,30 @@ class TestRegularizers:
         assert norm_after_fit(L2(0.5)) < norm_after_fit(None)
 
 
+class TestGradAccumulation:
+    def test_accum_equals_big_batch(self):
+        """A=4 accumulated micro-batches of 32 with SGD produce exactly
+        the same weights as one step on the concatenated 128 batch
+        (mean-of-means with equal micro-batch sizes)."""
+        import optax
+
+        init_zoo_context()
+        rs = np.random.RandomState(0)
+        x = rs.randn(512, 8).astype(np.float32)
+        y = rs.randn(512, 4).astype(np.float32)
+
+        def run(accum, batch):
+            reset_name_scope()
+            m = Sequential([Dense(4, input_shape=(8,))])
+            m.compile(optimizer=optax.sgd(0.1), loss="mse",
+                      grad_accum_steps=accum)
+            m.fit(x, y, batch_size=batch, nb_epoch=1, verbose=False)
+            key = next(iter(m.estimator.params))
+            return np.asarray(m.estimator.params[key]["kernel"])
+
+        np.testing.assert_allclose(run(4, 32), run(1, 128), atol=1e-5)
+
+
 class TestAuxLossTraining:
     def test_moe_in_sequential_trains_via_fit(self):
         init_zoo_context(mesh_shape=(4, 2), axis_names=("data", "expert"))
