@@ -381,3 +381,30 @@ class TestRecurrent:
     def test_time_distributed_dense(self):
         golden_check(rc.TimeDistributed(core.Dense(5)),
                      kl.TimeDistributed(kl.Dense(5)), _x(3, 4, 6), dense_w)
+
+    def test_conv_lstm_2d(self):
+        # weights are [kernel (kh,kw,cin,4F), recurrent (kh,kw,F,4F),
+        # bias (4F)] with gate order i,f,c,o in both frameworks
+        def w(kw, p):
+            return dict(p, kernel=kw[0], recurrent=kw[1], bias=kw[2])
+
+        # inner sigmoid (not hard_sigmoid): keras 3 redefined
+        # hard_sigmoid to relu6(x+3)/6 while the reference (and this
+        # framework) keep the classic clip(0.2x+0.5, 0, 1)
+        golden_check(
+            rc.ConvLSTM2D(3, 3, inner_activation="sigmoid"),
+            kl.ConvLSTM2D(3, 3, padding="same",
+                          recurrent_activation="sigmoid"),
+            _x(2, 4, 6, 6, 2, scale=0.5), w, rtol=5e-4, atol=5e-5)
+
+    def test_conv_lstm_2d_sequences(self):
+        def w(kw, p):
+            return dict(p, kernel=kw[0], recurrent=kw[1], bias=kw[2])
+
+        golden_check(
+            rc.ConvLSTM2D(2, 3, inner_activation="sigmoid",
+                          return_sequences=True),
+            kl.ConvLSTM2D(2, 3, padding="same",
+                          recurrent_activation="sigmoid",
+                          return_sequences=True),
+            _x(2, 3, 5, 5, 2, scale=0.5), w, rtol=5e-4, atol=5e-5)
