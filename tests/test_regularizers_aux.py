@@ -88,6 +88,25 @@ class TestGradAccumulation:
 
         np.testing.assert_allclose(run(4, 32), run(1, 128), atol=1e-5)
 
+    def test_accum_composes_with_tensor_parallel(self):
+        from analytics_zoo_tpu.parallel import TensorParallel
+
+        init_zoo_context(mesh_shape=(4, 2), axis_names=("data", "model"))
+        try:
+            reset_name_scope()
+            rs = np.random.RandomState(1)
+            x = rs.randn(256, 64).astype(np.float32)
+            y = rs.randn(256, 8).astype(np.float32)
+            m = Sequential([Dense(512, activation="relu",
+                                  input_shape=(64,)), Dense(8)])
+            m.compile(optimizer="adam", loss="mse",
+                      sharding=TensorParallel(axis="model", min_size=1024),
+                      grad_accum_steps=4)
+            h = m.fit(x, y, batch_size=32, nb_epoch=2, verbose=False)
+            assert h[-1]["loss"] < h[0]["loss"]
+        finally:
+            init_zoo_context()
+
 
 class TestAuxLossTraining:
     def test_moe_in_sequential_trains_via_fit(self):
