@@ -292,11 +292,46 @@ _SPATIAL_LAYERS = frozenset({
 })
 
 
+def _convert_rnn(cfg, w, kind: str):
+    """LSTM/GRU → the native (golden-tested) recurrent layers; weights
+    share keras's [kernel, recurrent_kernel, bias] layout and i,f,c,o /
+    z,r,h gate order."""
+    from analytics_zoo_tpu.nn.layers import recurrent as rc
+
+    ra = cfg.get("recurrent_activation", "sigmoid")
+    if ra == "hard_sigmoid":
+        raise UnsupportedLayerError(
+            "recurrent_activation='hard_sigmoid': keras 3's hard_sigmoid "
+            "(relu6(x+3)/6) differs from the classic clip(0.2x+0.5,0,1) "
+            "this framework implements — convert with 'sigmoid' instead")
+    if not cfg.get("use_bias", True):
+        raise UnsupportedLayerError(f"{kind} with use_bias=False")
+    if kind == "GRU" and cfg.get("reset_after", True):
+        raise UnsupportedLayerError(
+            "GRU reset_after=True (keras v2 formulation); rebuild the "
+            "keras layer with reset_after=False (v1) to convert")
+    common = dict(
+        activation=cfg.get("activation", "tanh") or "linear",
+        inner_activation=ra,
+        return_sequences=cfg.get("return_sequences", False),
+        go_backwards=cfg.get("go_backwards", False))
+    layer = (rc.LSTM(cfg["units"], **common) if kind == "LSTM"
+             else rc.GRU(cfg["units"], **common))
+    p = {"kernel": w[0], "recurrent": w[1], "bias": w[2]}
+
+    def fn(p, xs, training, rng):
+        return layer.forward(p, xs[0], training=training, rng=rng)
+
+    return p, _stateless(fn)
+
+
 def _convert_layer(class_name: str, cfg: Dict, weights: List[np.ndarray]):
     """Returns (params, op, state) for one keras layer."""
     cn = class_name
     if cn in _SPATIAL_LAYERS:
         _require_channels_last(cfg, cn)
+    if cn in ("LSTM", "GRU"):
+        return (*_convert_rnn(cfg, weights, cn), {})
     if cn == "Dense":
         return (*_convert_dense(cfg, weights), {})
     if cn == "Embedding":

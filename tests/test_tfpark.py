@@ -101,9 +101,38 @@ class TestConverterParity:
         x = np.random.RandomState(0).randn(2, 8, 8, 4).astype(np.float32)
         self._check(m, x)
 
+    def test_lstm_gru_stack(self):
+        m = tf.keras.Sequential([
+            tf.keras.layers.Input(shape=(6, 5)),
+            tf.keras.layers.LSTM(8, recurrent_activation="sigmoid",
+                                 return_sequences=True),
+            tf.keras.layers.GRU(7, recurrent_activation="sigmoid",
+                                reset_after=False),
+            tf.keras.layers.Dense(3, activation="softmax")])
+        x = np.random.RandomState(3).randn(4, 6, 5).astype(np.float32)
+        self._check(m, x, rtol=2e-4, atol=2e-5)
+
+    def test_lstm_go_backwards(self):
+        m = tf.keras.Sequential([
+            tf.keras.layers.Input(shape=(5, 4)),
+            tf.keras.layers.LSTM(6, recurrent_activation="sigmoid",
+                                 go_backwards=True)])
+        x = np.random.RandomState(4).randn(3, 5, 4).astype(np.float32)
+        self._check(m, x, rtol=2e-4, atol=2e-5)
+
+    def test_gru_reset_after_raises(self):
+        from analytics_zoo_tpu.tfpark import UnsupportedLayerError
+
+        m = tf.keras.Sequential([
+            tf.keras.layers.Input(shape=(5, 4)),
+            tf.keras.layers.GRU(6, reset_after=True)])
+        with pytest.raises(UnsupportedLayerError, match="reset_after"):
+            convert_keras_model(m)
+
     def test_unsupported_layer_raises(self):
         m = tf.keras.Sequential([
             tf.keras.layers.Input(shape=(4, 3)),
+            tf.keras.layers.GaussianNoise(0.1),
             tf.keras.layers.LSTM(5)])
         with pytest.raises(UnsupportedLayerError):
             convert_keras_model(m)
