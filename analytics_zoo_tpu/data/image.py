@@ -396,18 +396,34 @@ class ImageSet:
         return len(self.features)
 
     # -- materialization ---------------------------------------------------
-    def to_arrays(self, epoch_seed: int = 0
+    def to_arrays(self, epoch_seed: int = 0, num_workers: Optional[int] = None
                   ) -> Tuple[np.ndarray, Optional[np.ndarray]]:
-        imgs, labels = [], []
-        for idx, raw in enumerate(self.features):
+        """Apply the chain to every image.  Decode/augment run on a
+        thread pool (cv2 releases the GIL) — the parallel-decode role of
+        the reference's per-partition Spark executors.  Determinism is
+        per-index: each image's RandomState depends only on (seed,
+        epoch_seed, idx), so worker count never changes the output."""
+        def one(idx):
             rng = np.random.RandomState(
                 (self.seed + epoch_seed * 1_000_003 + idx) % (2 ** 31))
-            feat = ImageFeature(raw)
+            feat = ImageFeature(self.features[idx])
             if self.transforms is not None:
                 feat = self.transforms.apply(feat, rng)
-            imgs.append(np.asarray(feat.get("sample", feat.image), np.float32))
-            if feat.label is not None:
-                labels.append(feat.label)
+            return (np.asarray(feat.get("sample", feat.image), np.float32),
+                    feat.label)
+
+        n = len(self.features)
+        if num_workers is None:
+            num_workers = min(8, os.cpu_count() or 1)
+        if num_workers > 1 and n >= 4 * num_workers:
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(max_workers=num_workers) as ex:
+                results = list(ex.map(one, range(n)))
+        else:
+            results = [one(i) for i in range(n)]
+        imgs = [r[0] for r in results]
+        labels = [r[1] for r in results if r[1] is not None]
         x = np.stack(imgs)
         if labels and len(labels) != len(imgs):
             raise ValueError(
