@@ -381,6 +381,113 @@ def _register_structured():
     def shape_op(node):
         return lambda xs, t, r: jnp.asarray(xs[0].shape, jnp.int64)
 
+    def slice_op(node):
+        # opset-10+ takes starts/ends/axes/steps as inputs; opset-1 as
+        # attrs.  All must be static (constant-folded) — true for every
+        # exporter we target.
+        a_starts = node.attrs.get("starts")
+        a_ends = node.attrs.get("ends")
+        a_axes = node.attrs.get("axes")
+
+        def fn(xs, t, r):
+            x = xs[0]
+            starts = (a_starts if a_starts is not None
+                      else [int(v) for v in np.asarray(xs[1])])
+            ends = (a_ends if a_ends is not None
+                    else [int(v) for v in np.asarray(xs[2])])
+            axes = a_axes
+            if axes is None and len(xs) > 3 and xs[3] is not None:
+                axes = [int(v) for v in np.asarray(xs[3])]
+            if axes is None:
+                axes = list(range(len(starts)))
+            steps = ([int(v) for v in np.asarray(xs[4])]
+                     if len(xs) > 4 and xs[4] is not None
+                     else [1] * len(starts))
+            idx = [slice(None)] * x.ndim
+            for s, e, a, st in zip(starts, ends, axes, steps):
+                idx[int(a)] = slice(int(s), int(e), int(st))
+            return x[tuple(idx)]
+
+        return fn
+
+    def split_op(node):
+        ax = _axis_attr(node, 0)
+        a_split = node.attrs.get("split")
+
+        def fn(xs, t, r):
+            x = xs[0]
+            sizes = (a_split if a_split is not None
+                     else ([int(v) for v in np.asarray(xs[1])]
+                           if len(xs) > 1 and xs[1] is not None else None))
+            if sizes is None:
+                n = len(node.outputs)
+                return tuple(jnp.split(x, n, axis=ax))
+            bounds = np.cumsum(sizes)[:-1].tolist()
+            return tuple(jnp.split(x, bounds, axis=ax))
+
+        return fn
+
+    def expand(node):
+        def fn(xs, t, r):
+            shape = [int(s) for s in np.asarray(xs[1])]
+            return jnp.broadcast_to(
+                xs[0], np.broadcast_shapes(tuple(xs[0].shape),
+                                           tuple(shape)))
+
+        return fn
+
+    def where(node):
+        return lambda xs, t, r: jnp.where(xs[0].astype(bool), xs[1], xs[2])
+
+    def _mk_arg(fn):
+        def build(node):
+            ax = _axis_attr(node, 0)
+            keep = int(node.attrs.get("keepdims", 1))
+
+            def f(xs, t, r):
+                y = fn(xs[0], axis=ax).astype(jnp.int64)
+                return jnp.expand_dims(y, ax) if keep else y
+
+            return f
+        return build
+
+    def conv_transpose(node):
+        strides = tuple(int(s) for s in node.attrs.get("strides", (1, 1)))
+        pads = node.attrs.get("pads")
+        group = int(node.attrs.get("group", 1))
+        if group != 1:
+            raise UnsupportedOnnxOp("ConvTranspose group != 1")
+
+        def fn(xs, t, r):
+            x, w = xs[0], xs[1]          # x NCHW, w (Cin, Cout/g, kH, kW)
+            nd = x.ndim - 2
+            st = strides if len(strides) == nd else (1,) * nd
+            # canonical fractionally-strided conv: flip the kernel
+            # spatially, swap to OIHW, dilate the INPUT by the stride,
+            # and pad with k-1-p (ONNX deconv pads remove output)
+            w_f = jnp.flip(w, axis=tuple(range(2, 2 + nd)))
+            w_f = jnp.swapaxes(w_f, 0, 1)            # (Cout, Cin, k...)
+            dn = jax.lax.conv_dimension_numbers(
+                x.shape, w_f.shape,
+                ("NCHW", "OIHW", "NCHW") if nd == 2 else
+                ("NCW", "OIW", "NCW"))
+            if pads is not None:
+                onnx_pad = [(int(pads[i]), int(pads[i + nd]))
+                            for i in range(nd)]
+            else:
+                onnx_pad = [(0, 0)] * nd
+            padding = [(w.shape[2 + i] - 1 - onnx_pad[i][0],
+                        w.shape[2 + i] - 1 - onnx_pad[i][1])
+                       for i in range(nd)]
+            y = jax.lax.conv_general_dilated(
+                x, w_f, (1,) * nd, padding, lhs_dilation=st,
+                dimension_numbers=dn)
+            if len(xs) > 2 and xs[2] is not None:
+                y = y + xs[2].reshape((1, -1) + (1,) * nd)
+            return y
+
+        return fn
+
     _MAPPERS.update({
         "Softmax": softmax, "LogSoftmax": logsoftmax,
         "LeakyRelu": leaky, "Elu": elu, "HardSigmoid": hard_sigmoid,
@@ -391,6 +498,9 @@ def _register_structured():
         "Shape": shape_op,
         "ReduceMean": _mk_reduce(jnp.mean), "ReduceSum": _mk_reduce(jnp.sum),
         "ReduceMax": _mk_reduce(jnp.max), "ReduceMin": _mk_reduce(jnp.min),
+        "Slice": slice_op, "Split": split_op, "Expand": expand,
+        "Where": where, "ArgMax": _mk_arg(jnp.argmax),
+        "ArgMin": _mk_arg(jnp.argmin), "ConvTranspose": conv_transpose,
     })
 
 
@@ -450,10 +560,16 @@ class OnnxProgram:
         for (n, fn), r in zip(self.nodes, rngs):
             xs = _resolve_inputs(env, n.inputs)
             out = fn(xs, training, r)
-            env[n.outputs[0]] = out
-            for extra in n.outputs[1:]:
-                if extra:            # e.g. Dropout mask output — unused
-                    env[extra] = out
+            if isinstance(out, tuple) and len(n.outputs) > 1:
+                # true multi-output op (Split): one value per output
+                for name, val in zip(n.outputs, out):
+                    if name:
+                        env[name] = val
+            else:
+                env[n.outputs[0]] = out
+                for extra in n.outputs[1:]:
+                    if extra:        # e.g. Dropout mask output — unused
+                        env[extra] = out
         outs = [env[o] for o in self.output_names]
         return (outs[0] if len(outs) == 1 else outs), state
 

@@ -97,6 +97,61 @@ class TestProtoCodec:
         assert n2.attrs["il"] == [3, 4]
 
 
+def _structured_ops_onnx():
+    """Exercise Slice/Split/Expand/Where/ArgMax in one graph:
+    x (B, 8) -> Slice cols 0:6 -> Split into 2x3 -> Where(a>0, a, b)
+    -> Expand noop -> ArgMax."""
+    g = proto.Graph(
+        name="structured",
+        nodes=[
+            proto.Node("Slice", "sl", ["x"], ["xs"],
+                       {"starts": [0], "ends": [6], "axes": [1]}),
+            proto.Node("Split", "sp", ["xs"], ["a", "b"],
+                       {"axis": 1, "split": [3, 3]}),
+            proto.Node("Where", "w", ["m", "a", "b"], ["c"]),
+            proto.Node("ArgMax", "am", ["c"], ["y"],
+                       {"axis": 1, "keepdims": 0}),
+        ],
+        initializers=[proto.tensor_from_array(
+            "m", np.asarray([[1, 0, 1]], np.float32))],
+        inputs=[_vi("x", (None, 8))],
+        outputs=[_vi("y", (None,))])
+    return proto.Model(graph=g)
+
+
+class TestStructuredOps:
+    def test_slice_split_where_argmax(self):
+        prog = load_onnx_bytes(proto.encode_model(_structured_ops_onnx()))
+        x = np.random.RandomState(0).randn(5, 8).astype(np.float32)
+        out, _ = prog.call(prog.params, prog.state, jnp.asarray(x))
+        a, b = x[:, 0:3], x[:, 3:6]
+        ref = np.where(np.asarray([[1, 0, 1]], bool), a, b).argmax(axis=1)
+        np.testing.assert_array_equal(np.asarray(out), ref)
+
+    def test_conv_transpose_matches_torch(self):
+        torch = pytest.importorskip("torch")
+        rs = np.random.RandomState(2)
+        x = rs.randn(2, 3, 6, 6).astype(np.float32)
+        w = (rs.randn(3, 4, 3, 3) * 0.3).astype(np.float32)   # (Cin,Cout,kh,kw)
+        bias = rs.randn(4).astype(np.float32)
+        ref = torch.nn.functional.conv_transpose2d(
+            torch.tensor(x), torch.tensor(w), torch.tensor(bias),
+            stride=2, padding=1).numpy()
+
+        g = proto.Graph(
+            name="deconv",
+            nodes=[proto.Node("ConvTranspose", "d", ["x", "w", "b"], ["y"],
+                              {"strides": [2, 2], "pads": [1, 1, 1, 1]})],
+            initializers=[proto.tensor_from_array("w", w),
+                          proto.tensor_from_array("b", bias)],
+            inputs=[_vi("x", (None, 3, 6, 6))],
+            outputs=[_vi("y", (None, 4, 11, 11))])
+        prog = load_onnx_bytes(proto.encode_model(proto.Model(graph=g)))
+        out, _ = prog.call(prog.params, prog.state, jnp.asarray(x))
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4,
+                                   atol=1e-4)
+
+
 class TestOnnxLoader:
     def test_mlp_numerics(self):
         m, (w1, b1, w2, b2) = _mlp_onnx()
