@@ -444,12 +444,52 @@ class ClusterServing:
         if self._thread:
             self._thread.join(timeout=5)
 
+    # -- model hot reload (reference ClusterServingHelper.scala:185-193:
+    # the config/model path is re-checked periodically and the serving
+    # model swapped in place without stopping the stream) ----------------
+    def enable_hot_reload(self, model_path: str,
+                          check_interval_s: float = 10.0
+                          ) -> "ClusterServing":
+        self._reload_path = model_path
+        self._reload_interval = check_interval_s
+        self._reload_last_check = 0.0
+        self._reload_mtime = self._path_mtime(model_path)
+        return self
+
+    @staticmethod
+    def _path_mtime(path: str) -> float:
+        if os.path.isdir(path):
+            return max((os.path.getmtime(os.path.join(path, f))
+                        for f in os.listdir(path)), default=0.0)
+        return os.path.getmtime(path) if os.path.exists(path) else 0.0
+
+    def _maybe_reload(self) -> bool:
+        path = getattr(self, "_reload_path", None)
+        if path is None:
+            return False
+        now = time.time()
+        if now - self._reload_last_check < self._reload_interval:
+            return False
+        self._reload_last_check = now
+        mtime = self._path_mtime(path)
+        if mtime <= self._reload_mtime:
+            return False
+        from analytics_zoo_tpu.deploy.inference import InferenceModel
+
+        import logging
+        logging.getLogger("analytics_zoo_tpu.deploy").info(
+            "model at %s changed (mtime %.0f); hot-reloading", path, mtime)
+        self.model = InferenceModel.load(path)
+        self._reload_mtime = mtime
+        return True
+
     def run_forever(self) -> None:
         import logging
 
         log = logging.getLogger("analytics_zoo_tpu.deploy")
         while not self._stop.is_set():
             try:
+                self._maybe_reload()
                 self.serve_once()
             except Exception:  # keep serving: one bad batch must not
                 log.exception("serving batch failed; worker continues")

@@ -205,6 +205,40 @@ class TestClusterServing:
         np.testing.assert_allclose(np.asarray(res), ref, rtol=1e-4,
                                    atol=1e-4)
 
+    def test_hot_reload_swaps_model(self, tmp_path):
+        """Reference ClusterServingHelper.scala:185-193: the model is
+        re-checked periodically and swapped without stopping serving."""
+        import time
+
+        from analytics_zoo_tpu.models import NeuralCF
+
+        path = str(tmp_path / "model")
+        m1 = NeuralCF(user_count=20, item_count=10, class_num=2,
+                      user_embed=4, item_embed=4, hidden_layers=(8,),
+                      mf_embed=4)
+        m1.compile(optimizer="adam",
+                   loss="sparse_categorical_crossentropy")
+        x = [np.ones((16, 1), np.int32), np.ones((16, 1), np.int32)]
+        m1.fit(x, np.zeros(16, np.int32), batch_size=16, nb_epoch=1,
+               verbose=False)
+        m1.save_model(path)
+
+        srv = ClusterServing(InferenceModel.load(path), MemoryQueue(),
+                             ServingConfig(batch_size=4))
+        srv.enable_hot_reload(path, check_interval_s=0.1)
+        old = id(srv.model)
+
+        srv._reload_last_check = 0.0
+        assert srv._maybe_reload() is False        # unchanged: no reload
+
+        time.sleep(0.2)
+        m1.fit(x, np.zeros(16, np.int32), batch_size=16, nb_epoch=1,
+               verbose=False)
+        m1.save_model(path)                        # mtime bump
+        srv._reload_last_check = 0.0
+        assert srv._maybe_reload() is True
+        assert id(srv.model) != old
+
     def test_end_to_end_file_backend_with_images(self, tmp_path):
         net, _ = _trained_net(in_dim=27, out_dim=2)  # 3*3*3 image flattened
         m = InferenceModel.from_keras_net(
