@@ -474,6 +474,13 @@ class ClusterServing:
         mtime = self._path_mtime(path)
         if mtime <= self._reload_mtime:
             return False
+        # save_model writes config.json + weights.npz non-atomically:
+        # only reload once the mtime has been STABLE for a full check
+        # interval, so a mid-write snapshot (new config + old weights,
+        # or a truncated npz) is never loaded
+        if mtime != getattr(self, "_reload_pending_mtime", None):
+            self._reload_pending_mtime = mtime
+            return False
         from analytics_zoo_tpu.deploy.inference import InferenceModel
 
         import logging
@@ -481,6 +488,7 @@ class ClusterServing:
             "model at %s changed (mtime %.0f); hot-reloading", path, mtime)
         self.model = InferenceModel.load(path)
         self._reload_mtime = mtime
+        self._reload_pending_mtime = None
         return True
 
     def run_forever(self) -> None:

@@ -457,6 +457,18 @@ def _register_structured():
         group = int(node.attrs.get("group", 1))
         if group != 1:
             raise UnsupportedOnnxOp("ConvTranspose group != 1")
+        out_pad = node.attrs.get("output_padding")
+        if out_pad is not None and any(int(p) for p in out_pad):
+            raise UnsupportedOnnxOp("ConvTranspose output_padding != 0")
+        dil = node.attrs.get("dilations")
+        if dil is not None and any(int(d) != 1 for d in dil):
+            raise UnsupportedOnnxOp("ConvTranspose dilations != 1")
+        if node.attrs.get("output_shape") is not None:
+            raise UnsupportedOnnxOp("ConvTranspose explicit output_shape")
+        ap = node.attrs.get("auto_pad", b"NOTSET")
+        ap = ap.decode() if isinstance(ap, bytes) else ap
+        if ap not in ("NOTSET", ""):
+            raise UnsupportedOnnxOp(f"ConvTranspose auto_pad={ap}")
 
         def fn(xs, t, r):
             x, w = xs[0], xs[1]          # x NCHW, w (Cin, Cout/g, kH, kW)
@@ -560,8 +572,10 @@ class OnnxProgram:
         for (n, fn), r in zip(self.nodes, rngs):
             xs = _resolve_inputs(env, n.inputs)
             out = fn(xs, training, r)
-            if isinstance(out, tuple) and len(n.outputs) > 1:
-                # true multi-output op (Split): one value per output
+            if isinstance(out, tuple) and len(n.outputs) == len(out):
+                # true multi-output op (Split): one value per output —
+                # including the degenerate single-output Split, whose
+                # length-1 tuple must unwrap to the array
                 for name, val in zip(n.outputs, out):
                     if name:
                         env[name] = val

@@ -306,6 +306,13 @@ def _convert_rnn(cfg, w, kind: str):
             "this framework implements — convert with 'sigmoid' instead")
     if not cfg.get("use_bias", True):
         raise UnsupportedLayerError(f"{kind} with use_bias=False")
+    if (float(cfg.get("dropout", 0.0) or 0.0)
+            or float(cfg.get("recurrent_dropout", 0.0) or 0.0)):
+        raise UnsupportedLayerError(
+            f"{kind} with dropout/recurrent_dropout — the converted layer "
+            "would silently train unregularized; set both to 0 to convert")
+    if cfg.get("stateful"):
+        raise UnsupportedLayerError(f"stateful {kind}")
     if kind == "GRU" and cfg.get("reset_after", True):
         raise UnsupportedLayerError(
             "GRU reset_after=True (keras v2 formulation); rebuild the "

@@ -486,8 +486,7 @@ def bench_serving(n_requests=32, concurrency=8):
     return out
 
 
-def _device_preflight(timeout_s: int = 60, attempts: int = 3,
-                      retry_sleep_s: int = 20) -> bool:
+def _device_preflight(timeout_s: int = 150) -> bool:
     """Probe the accelerator in a SUBPROCESS: a wedged device transport
     (e.g. a dead tunnel) would hang any in-process op forever, and the
     driver must still receive a JSON line.  Retries with backoff —
@@ -520,12 +519,13 @@ def _device_preflight(timeout_s: int = 60, attempts: int = 3,
         return False
 
 
-def _preflight_with_retry(timeout_s: int = 60, attempts: int = 3,
-                          retry_sleep_s: int = 20) -> bool:
-    for i in range(attempts):
+def _preflight_with_retry(retry_sleep_s: int = 20) -> bool:
+    # first attempt is long enough for a cold backend init (~90-180s on
+    # tunnelled slices); the retry catches a transient blip
+    for i, timeout_s in enumerate((150, 90)):
         if _device_preflight(timeout_s):
             return True
-        if i + 1 < attempts:
+        if i == 0:
             time.sleep(retry_sleep_s)
     return False
 
@@ -561,7 +561,12 @@ def main():
             for line in proc.stdout.splitlines():
                 if line.startswith("CPUTPUT"):
                     value = float(line.split()[1])
-            extra["cpu_samples_per_sec"] = round(value, 1)
+            if value:
+                extra["cpu_samples_per_sec"] = round(value, 1)
+            else:       # a crashed child must be distinguishable from a
+                extra["cpu_fallback_error"] = (     # measured zero
+                    f"child rc={proc.returncode}: "
+                    f"{(proc.stderr or '')[-400:]}")
         except Exception as e:
             extra["cpu_fallback_error"] = f"{type(e).__name__}: {e}"
         print(json.dumps({

@@ -236,7 +236,9 @@ class TestClusterServing:
                verbose=False)
         m1.save_model(path)                        # mtime bump
         srv._reload_last_check = 0.0
-        assert srv._maybe_reload() is True
+        assert srv._maybe_reload() is False        # first sighting: defer
+        srv._reload_last_check = 0.0               # (torn-write guard)
+        assert srv._maybe_reload() is True         # stable: swap
         assert id(srv.model) != old
 
     def test_end_to_end_file_backend_with_images(self, tmp_path):
