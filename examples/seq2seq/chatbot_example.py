@@ -39,9 +39,13 @@ def main():
     s2s.fit([enc, dec_in], dec_out, batch_size=128, nb_epoch=args.epochs)
 
     reply = s2s.infer(enc[:2], start_sign=START, max_seq_len=enc.shape[1])
-    print("prompt   :", enc[0].tolist())
-    print("reply    :", reply[0].tolist())
-    print("expected :", enc[0].tolist())
+    print("prompt       :", enc[0].tolist())
+    print("greedy reply :", reply[0].tolist())
+    beam, scores = s2s.infer_beam(enc[:2], start_sign=START,
+                                  max_seq_len=enc.shape[1], beam_size=4)
+    print("beam-4 reply :", beam[0].tolist(),
+          f"(log-prob {scores[0]:.3f})")
+    print("expected     :", enc[0].tolist())
 
 
 if __name__ == "__main__":
