@@ -35,32 +35,41 @@ def _pair(v):
 
 class Dense(_core.Dense):
     def __init__(self, units: int, activation=None, use_bias: bool = True,
-                 kernel_initializer="glorot_uniform", **kw):
+                 kernel_initializer="glorot_uniform",
+                 kernel_regularizer=None, bias_regularizer=None, **kw):
         super().__init__(units, activation=activation, use_bias=use_bias,
-                         init=kernel_initializer, **kw)
+                         init=kernel_initializer,
+                         w_regularizer=kernel_regularizer,
+                         b_regularizer=bias_regularizer, **kw)
 
 
 class Conv1D(_cv.Convolution1D):
     def __init__(self, filters: int, kernel_size: int, strides: int = 1,
                  padding: str = "valid", activation=None,
                  dilation_rate: int = 1, use_bias: bool = True,
-                 kernel_initializer="glorot_uniform", **kw):
+                 kernel_initializer="glorot_uniform",
+                 kernel_regularizer=None, bias_regularizer=None, **kw):
         super().__init__(filters, kernel_size, subsample=strides,
                          border_mode=padding, activation=activation,
                          dilation=dilation_rate, bias=use_bias,
-                         init=kernel_initializer, **kw)
+                         init=kernel_initializer,
+                         w_regularizer=kernel_regularizer,
+                         b_regularizer=bias_regularizer, **kw)
 
 
 class Conv2D(_cv.Convolution2D):
     def __init__(self, filters: int, kernel_size, strides=1,
                  padding: str = "valid", activation=None, dilation_rate=1,
                  use_bias: bool = True,
-                 kernel_initializer="glorot_uniform", **kw):
+                 kernel_initializer="glorot_uniform",
+                 kernel_regularizer=None, bias_regularizer=None, **kw):
         kh, kw_ = _pair(kernel_size)
         super().__init__(filters, kh, kw_, subsample=_pair(strides),
                          border_mode=padding, activation=activation,
                          dilation=_pair(dilation_rate), bias=use_bias,
-                         init=kernel_initializer, **kw)
+                         init=kernel_initializer,
+                         w_regularizer=kernel_regularizer,
+                         b_regularizer=bias_regularizer, **kw)
 
 
 class Conv3D(_cv.Convolution3D):
