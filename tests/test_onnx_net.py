@@ -128,6 +128,54 @@ class TestStructuredOps:
         ref = np.where(np.asarray([[1, 0, 1]], bool), a, b).argmax(axis=1)
         np.testing.assert_array_equal(np.asarray(out), ref)
 
+    def test_slice_steps_and_split_default(self):
+        # Slice with step 2, Split with no sizes (even halves)
+        g = proto.Graph(
+            name="s2",
+            nodes=[
+                proto.Node("Slice", "sl", ["x"], ["xs"],
+                           {"starts": [1], "ends": [7], "axes": [1]}),
+                proto.Node("Split", "sp", ["xs"], ["a", "b"], {"axis": 1}),
+                proto.Node("Sub", "d", ["a", "b"], ["y"]),
+            ],
+            initializers=[],
+            inputs=[_vi("x", (None, 8))],
+            outputs=[_vi("y", (None, 3))])
+        prog = load_onnx_bytes(proto.encode_model(proto.Model(graph=g)))
+        x = np.random.RandomState(1).randn(4, 8).astype(np.float32)
+        out, _ = prog.call(prog.params, prog.state, jnp.asarray(x))
+        ref = x[:, 1:7][:, :3] - x[:, 1:7][:, 3:]
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-6)
+
+    def test_expand_broadcasts(self):
+        g = proto.Graph(
+            name="ex",
+            nodes=[proto.Node("Expand", "e", ["x", "shape"], ["y"])],
+            initializers=[proto.tensor_from_array(
+                "shape", np.asarray([3, 4], np.int64))],
+            inputs=[_vi("x", (1, 4))],
+            outputs=[_vi("y", (3, 4))])
+        prog = load_onnx_bytes(proto.encode_model(proto.Model(graph=g)))
+        x = np.arange(4, dtype=np.float32).reshape(1, 4)
+        out, _ = prog.call(prog.params, prog.state, jnp.asarray(x))
+        np.testing.assert_array_equal(np.asarray(out),
+                                      np.broadcast_to(x, (3, 4)))
+
+    def test_conv_transpose_unsupported_attrs_raise(self):
+        from analytics_zoo_tpu.onnx import UnsupportedOnnxOp
+
+        g = proto.Graph(
+            name="dc",
+            nodes=[proto.Node("ConvTranspose", "d", ["x", "w"], ["y"],
+                              {"strides": [2, 2],
+                               "output_padding": [1, 1]})],
+            initializers=[proto.tensor_from_array(
+                "w", np.zeros((3, 4, 3, 3), np.float32))],
+            inputs=[_vi("x", (None, 3, 6, 6))],
+            outputs=[_vi("y", (None, 4, 12, 12))])
+        with pytest.raises(UnsupportedOnnxOp, match="output_padding"):
+            load_onnx_bytes(proto.encode_model(proto.Model(graph=g)))
+
     def test_conv_transpose_matches_torch(self):
         torch = pytest.importorskip("torch")
         rs = np.random.RandomState(2)
