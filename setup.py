@@ -6,6 +6,10 @@ setup(
     description="TPU-native deep-learning framework (JAX/XLA/Pallas) with "
                 "Analytics Zoo capabilities",
     packages=find_packages(include=["analytics_zoo_tpu*"]),
+    # the native C++ source ships in the wheel and is compiled lazily on
+    # first use (native/__init__.py); without it installed copies would
+    # silently fall back to the pure-python paths
+    package_data={"analytics_zoo_tpu.native": ["*.cpp"]},
     python_requires=">=3.10",
     install_requires=["jax", "numpy", "optax"],
 )
