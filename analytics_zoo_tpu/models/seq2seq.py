@@ -238,6 +238,7 @@ class Seq2seqNet(KerasNet):
         score0 = jnp.tile(jnp.asarray([0.0] + [NEG] * (K - 1),
                                       jnp.float32), (b, 1))     # (B, K)
         done0 = jnp.zeros((b, K), bool)
+        len0 = jnp.zeros((b, K), jnp.float32)
 
         def gather_beams(tree, beam_idx):
             # tree leaves (B*K, ...) -> pick beam_idx (B, K) per batch
@@ -251,7 +252,7 @@ class Seq2seqNet(KerasNet):
             return jax.tree_util.tree_map(g, tree)
 
         def step(carry, _):
-            tok, states, scores, done = carry
+            tok, states, scores, done, lens = carry
             emb = self.embedding.forward(params["embed"], tok)  # (B*K,1,E)
             out, new_states = self.decoder.run_with_states(
                 params["dec"], emb, states, return_state=True)
@@ -268,20 +269,26 @@ class Seq2seqNet(KerasNet):
             beam_idx = (top // V).astype(jnp.int32)
             token = (top % V).astype(jnp.int32)
             new_states = gather_beams(new_states, beam_idx)
+            # length/done histories follow the beams they came from —
+            # gather with beam_idx BEFORE extending, so slot k's counter
+            # tracks one hypothesis even as beams reorder
             done = jnp.take_along_axis(done, beam_idx, axis=1)
+            lens = jnp.take_along_axis(lens, beam_idx, axis=1)
             if stop_sign is not None:
+                # count tokens strictly before the stop token
+                lens = lens + jnp.where(done | (token == stop_sign),
+                                        0.0, 1.0)
                 done = done | (token == stop_sign)
+            else:
+                lens = lens + 1.0
             return ((token.reshape(b * K, 1), new_states, new_scores,
-                     done), (token, beam_idx))
+                     done, lens), (token, beam_idx))
 
-        (_, _, scores, done), (toks, parents) = jax.lax.scan(
-            step, (tok0, states, score0, done0), None,
+        (_, _, scores, done, lengths), (toks, parents) = jax.lax.scan(
+            step, (tok0, states, score0, done0, len0), None,
             length=max_seq_len)                  # toks (T, B, K)
 
         if length_penalty > 0 and stop_sign is not None:
-            lengths = jnp.sum(
-                jnp.cumprod((toks != stop_sign).astype(jnp.float32),
-                            axis=0), axis=0)     # (B, K) pre-stop length
             scores = scores / jnp.maximum(lengths, 1.0) ** length_penalty
 
         best = jnp.argmax(scores, axis=-1).astype(jnp.int32)    # (B,)

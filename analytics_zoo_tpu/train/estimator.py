@@ -93,6 +93,14 @@ class Estimator:
         self.model = model
         self.aux_loss_weight = aux_loss_weight
         self.tx = optim_lib.get(optimizer)
+        # clip wraps the base optimizer BEFORE MultiSteps so that with
+        # grad accumulation the clip sees the accumulated/averaged
+        # gradient (conventional clip-after-accumulate semantics), not
+        # each micro-batch gradient
+        if grad_clip_norm is not None:
+            self.tx = optax.chain(optax.clip_by_global_norm(grad_clip_norm), self.tx)
+        elif grad_clip_value is not None:
+            self.tx = optax.chain(optax.clip(grad_clip_value), self.tx)
         if grad_accum_steps > 1:
             # one optimizer update per A micro-batches: grads average in
             # f32 inside opt-state, params stay fixed between updates —
@@ -102,10 +110,6 @@ class Estimator:
             self.tx = optax.MultiSteps(self.tx, grad_accum_steps)
         self.grad_accum_steps = grad_accum_steps
         self._sharding_strategy = sharding  # "dp" | "tp" | ShardingStrategy
-        if grad_clip_norm is not None:
-            self.tx = optax.chain(optax.clip_by_global_norm(grad_clip_norm), self.tx)
-        elif grad_clip_value is not None:
-            self.tx = optax.chain(optax.clip(grad_clip_value), self.tx)
         self.loss_fn = objectives.get(loss)
         self.metrics = [metrics_lib.get(m) for m in (metrics or [])]
         self.ctx = ctx or get_zoo_context()

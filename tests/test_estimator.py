@@ -178,3 +178,38 @@ def test_auc_metric(zoo_ctx):
     model.fit(x, y, batch_size=32, nb_epoch=20, verbose=False)
     res = model.evaluate(x, y)
     assert res["auc"] > 0.9, res
+
+
+def test_grad_clip_applies_to_accumulated_gradient(zoo_ctx):
+    """ADVICE r2: with grad_accum_steps > 1, clipping must see the
+    accumulated/averaged gradient, not each micro-batch gradient.
+
+    One huge micro-grad + one zero micro-grad: clip-after-accumulate
+    yields an update of norm lr*clip; the old clip-per-micro-batch
+    ordering would yield lr*clip/2.
+    """
+    import jax.numpy as jnp
+
+    from analytics_zoo_tpu.nn import Sequential
+    from analytics_zoo_tpu.nn.layers.core import Dense
+    from analytics_zoo_tpu.train.estimator import Estimator
+
+    def update_norms(est, grads):
+        params = {"w": jnp.zeros(2)}
+        state = est.tx.init(params)
+        outs = []
+        for g in grads:
+            upd, state = est.tx.update({"w": jnp.asarray(g)}, state, params)
+            outs.append(float(jnp.linalg.norm(upd["w"])))
+        return outs
+
+    model = Sequential([Dense(1)])
+    ref = Estimator(model, optimizer="sgd", loss="mse")
+    # unit-norm grad through plain sgd = lr
+    lr = update_norms(ref, [[1.0, 0.0]])[0]
+
+    est = Estimator(model, optimizer="sgd", loss="mse",
+                    grad_clip_norm=1.0, grad_accum_steps=2)
+    norms = update_norms(est, [[1000.0, 0.0], [0.0, 0.0]])
+    assert norms[0] == pytest.approx(0.0, abs=1e-9)   # mid-accumulation
+    assert norms[1] == pytest.approx(lr, rel=1e-5)    # clip(avg), not avg(clip)
