@@ -23,6 +23,9 @@ import jax.numpy as jnp
 from analytics_zoo_tpu.nn import activations, initializers
 from analytics_zoo_tpu.nn.module import Layer, StatelessLayer, split_rng
 from analytics_zoo_tpu.ops.attention import dot_product_attention
+from analytics_zoo_tpu.parallel.mode import (
+    current_pipeline as _current_pipeline,
+    current_seq_parallel as _current_seq_parallel)
 
 
 def _dense_params(rng, d_in, d_out, init, dtype=jnp.float32):
@@ -117,12 +120,36 @@ class MultiHeadAttention(StatelessLayer):
             elif mask.ndim == 3:    # (B, Lq, Lk) full mask
                 mask = mask[:, None, :, :]
         r1, r2 = split_rng(rng, 2)
-        # attn_drop acts on the softmax probabilities (reference
-        # TransformerLayer/BERT semantics) via the blockwise path, which
-        # keeps the flash memory bound; inference uses the fused kernels
-        drop = self.attn_drop if (training and r1 is not None) else 0.0
-        out = dot_product_attention(q, k, v, mask=mask, causal=self.causal,
-                                    dropout_rate=drop, dropout_rng=r1)
+        sp = _current_seq_parallel()
+        if sp is not None:
+            # sequence-parallel regime (compile(sharding="sp")): K/V
+            # rotate around the mesh's sequence ring instead of
+            # materialising blockwise attention on one device.  The ring
+            # kernel supports causal/no mask and skips attention-prob
+            # dropout (parallel/sequence.py).
+            if mask is not None:
+                raise ValueError(
+                    "sequence-parallel attention does not support "
+                    "padding/attention masks (causal=True is supported); "
+                    "drop the mask input or use sharding='dp'")
+            if kv_in is not q_in:
+                raise ValueError(
+                    "sequence-parallel attention supports self-attention "
+                    "only (q and kv shards must rotate together)")
+            from analytics_zoo_tpu.parallel.sequence import (
+                ring_self_attention)
+            out = ring_self_attention(q, k, v, sp.mesh, sp.axis,
+                                      causal=self.causal,
+                                      batch_axis=sp.batch_axis)
+        else:
+            # attn_drop acts on the softmax probabilities (reference
+            # TransformerLayer/BERT semantics) via the blockwise path,
+            # which keeps the flash memory bound; inference uses the
+            # fused kernels
+            drop = self.attn_drop if (training and r1 is not None) else 0.0
+            out = dot_product_attention(q, k, v, mask=mask,
+                                        causal=self.causal,
+                                        dropout_rate=drop, dropout_rng=r1)
         b, h, l, hd = out.shape
         out = out.transpose(0, 2, 1, 3).reshape(b, l, h * hd)
         out = _dense(params["o"], out)
@@ -191,6 +218,15 @@ class TransformerLayer(StatelessLayer):
 
     Input: int32 token ids (B, L) [+ optional position ids (B, L)].
     Output: hidden states (B, L, hidden_size).
+
+    ``stacked=True`` stores the homogeneous blocks as ONE pytree with a
+    leading ``n_block`` dim under ``params["blocks"]`` and runs them via
+    ``lax.scan`` — faster compiles for deep stacks, and the layout the
+    pipeline-parallel regime shards: under ``compile(sharding="pp")``
+    the stack lowers to the GPipe microbatch schedule
+    (parallel/pipeline.py) with stage weights 1/S per device.  Inside
+    pipeline stages dropout is disabled (the ppermute ring carries no
+    rng); embedding dropout still applies.
     """
 
     def __init__(self, vocab: int = 40990, seq_len: int = 77,
@@ -198,21 +234,33 @@ class TransformerLayer(StatelessLayer):
                  intermediate_size: Optional[int] = None,
                  hidden_drop: float = 0.1, attn_drop: float = 0.1,
                  embedding_drop: float = 0.1, causal: bool = True,
-                 after_norm: bool = False, init="glorot_uniform", **kw):
+                 after_norm: bool = False, init="glorot_uniform",
+                 stacked: bool = False, **kw):
         super().__init__(**kw)
         self.vocab, self.seq_len = vocab, seq_len
         self.hidden_size = hidden_size
         self.embedding_drop = embedding_drop
-        self.blocks = [
-            TransformerBlock(nhead, hidden_size, intermediate_size,
-                             hidden_drop, attn_drop, causal=causal,
-                             after_norm=after_norm, init=init,
-                             name=f"{self.name}_block{i}")
-            for i in range(n_block)]
+        self.n_block = n_block
+        self.stacked = stacked
+        if stacked:
+            # one template block; per-block weights differ via the rng
+            self.block = TransformerBlock(nhead, hidden_size,
+                                          intermediate_size, hidden_drop,
+                                          attn_drop, causal=causal,
+                                          after_norm=after_norm, init=init,
+                                          name=f"{self.name}_block")
+            self.blocks = []
+        else:
+            self.blocks = [
+                TransformerBlock(nhead, hidden_size, intermediate_size,
+                                 hidden_drop, attn_drop, causal=causal,
+                                 after_norm=after_norm, init=init,
+                                 name=f"{self.name}_block{i}")
+                for i in range(n_block)]
         self.initializer = initializers.get(init)
 
     def build_params(self, rng, ids_shape, *rest):
-        ks = jax.random.split(rng, 2 + len(self.blocks))
+        ks = jax.random.split(rng, 2 + self.n_block)
         d = self.hidden_size
         params = {
             "tok_embed": self.initializer(ks[0], (self.vocab, d),
@@ -221,9 +269,43 @@ class TransformerLayer(StatelessLayer):
                                           jnp.float32) * 0.1,
         }
         hshape = tuple(ids_shape) + (d,)
-        for i, blk in enumerate(self.blocks):
-            params[f"block{i}"] = blk.build_params(ks[2 + i], hshape)
+        if self.stacked:
+            per_block = [self.block.build_params(ks[2 + i], hshape)
+                         for i in range(self.n_block)]
+            params["blocks"] = jax.tree_util.tree_map(
+                lambda *ps: jnp.stack(ps, axis=0), *per_block)
+        else:
+            for i, blk in enumerate(self.blocks):
+                params[f"block{i}"] = blk.build_params(ks[2 + i], hshape)
         return params
+
+    def _run_stacked(self, blocks_params, x, training, rng):
+        pipe = _current_pipeline()
+        if pipe is not None:
+            from analytics_zoo_tpu.parallel.pipeline import pipeline_apply
+
+            def stage(p, h):
+                return self.block.forward(p, h, training=False, rng=None)
+
+            return pipeline_apply(stage, blocks_params, x, pipe.mesh,
+                                  pipe.axis, pipe.n_microbatches,
+                                  pipe.remat, batch_axis=pipe.batch_axis)
+        if rng is not None:
+            rngs = jax.random.split(rng, self.n_block)
+
+            def body(h, pr):
+                p, r = pr
+                return self.block.forward(p, h, training=training,
+                                          rng=r), None
+
+            x, _ = jax.lax.scan(body, x, (blocks_params, rngs))
+        else:
+            def body(h, p):
+                return self.block.forward(p, h, training=training,
+                                          rng=None), None
+
+            x, _ = jax.lax.scan(body, x, blocks_params)
+        return x
 
     def forward(self, params, ids, *rest, training=False, rng=None):
         pos_ids = rest[0] if rest else None
@@ -234,6 +316,10 @@ class TransformerLayer(StatelessLayer):
             x = x + params["pos_embed"][None, :l]
         else:
             x = x + params["pos_embed"][pos_ids.astype(jnp.int32)]
+        if self.stacked:
+            r0, rblocks = split_rng(rng, 2)
+            x = _dropout(r0, x, self.embedding_drop, training)
+            return self._run_stacked(params["blocks"], x, training, rblocks)
         rngs = split_rng(rng, 1 + len(self.blocks))
         x = _dropout(rngs[0], x, self.embedding_drop, training)
         for i, blk in enumerate(self.blocks):

@@ -2,9 +2,18 @@ from analytics_zoo_tpu.parallel.sharding import (  # noqa: F401
     AutoSharding,
     DataParallel,
     ExpertParallel,
+    PipelineStrategy,
+    SequenceParallel,
     ShardingStrategy,
     TensorParallel,
     make_strategy,
+)
+from analytics_zoo_tpu.parallel.mode import (  # noqa: F401
+    PipelineMode,
+    SeqParallelMode,
+    current_pipeline,
+    current_seq_parallel,
+    parallel_mode,
 )
 from analytics_zoo_tpu.parallel.sequence import (  # noqa: F401
     ring_attention,

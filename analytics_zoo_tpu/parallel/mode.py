@@ -1,0 +1,66 @@
+"""Active-parallelism context: how strategies reach inside model forwards.
+
+The Estimator's jitted steps wrap ``model.call`` in the strategy's
+``activate()`` context (train/estimator.py).  During *tracing*, layers
+that have a parallel lowering consult this module:
+
+- ``MultiHeadAttention`` switches to ring attention over the sequence
+  axis when ``current_seq_parallel()`` is set (parallel/sequence.py);
+- ``TransformerLayer(stacked=True)`` routes its block stack through the
+  GPipe schedule when ``current_pipeline()`` is set (parallel/pipeline.py).
+
+This is trace-time-only state (a thread-local read while jit traces the
+step); the compiled program embeds the parallel lowering, so nothing here
+runs in the hot loop.  Thread-local so concurrent builds (AutoML trials)
+can trace different regimes simultaneously.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from dataclasses import dataclass
+from typing import Optional
+
+from jax.sharding import Mesh
+
+_ACTIVE = threading.local()
+
+
+@dataclass(frozen=True)
+class SeqParallelMode:
+    """Ring attention over ``mesh[axis]`` (sequence/context parallelism).
+    ``batch_axis`` keeps the batch dim sharded (sp×dp composition) —
+    without it GSPMD would allgather the batch into every data group."""
+    mesh: Mesh
+    axis: str
+    batch_axis: Optional[str] = None
+
+
+@dataclass(frozen=True)
+class PipelineMode:
+    """GPipe microbatched schedule over ``mesh[axis]``."""
+    mesh: Mesh
+    axis: str
+    n_microbatches: int = 4
+    remat: bool = False
+    batch_axis: Optional[str] = None   # compose pp with dp
+
+
+def current_seq_parallel() -> Optional[SeqParallelMode]:
+    return getattr(_ACTIVE, "seq", None)
+
+
+def current_pipeline() -> Optional[PipelineMode]:
+    return getattr(_ACTIVE, "pipe", None)
+
+
+@contextlib.contextmanager
+def parallel_mode(seq: Optional[SeqParallelMode] = None,
+                  pipe: Optional[PipelineMode] = None):
+    prev = (getattr(_ACTIVE, "seq", None), getattr(_ACTIVE, "pipe", None))
+    _ACTIVE.seq, _ACTIVE.pipe = seq, pipe
+    try:
+        yield
+    finally:
+        _ACTIVE.seq, _ACTIVE.pipe = prev

@@ -50,13 +50,18 @@ StageFn = Callable[[Any, jax.Array], jax.Array]
 
 
 def pipeline_spmd(stage_fn: StageFn, stage_params, x, axis_name: str,
-                  n_microbatches: int, remat: bool = False):
+                  n_microbatches: int, remat: bool = False,
+                  vary_axes=None):
     """Per-device body — call inside shard_map/pjit with ``axis_name``.
 
     ``stage_params``: this device's stage slice, leading dim 1 (the shard
-    of the stacked (S, ...) pytree).  ``x``: the full (B, ...) batch
-    (replicated — every stage sees it; only stage 0 reads it).
-    Returns the full (B, ...) output, replicated via a final psum.
+    of the stacked (S, ...) pytree).  ``x``: the (B, ...) batch local to
+    this device's data group (replicated over the pipe axis — every
+    stage sees it; only stage 0 reads it).
+    Returns the (B, ...) output, replicated over the pipe axis via a
+    final psum.  ``vary_axes``: all shard_map axes the scan carries are
+    device-varying over — pass ``(pipe, data)`` when composing with a
+    data axis (defaults to ``(axis_name,)``).
     """
     S = lax.psum(1, axis_name)
     s = lax.axis_index(axis_name)
@@ -70,8 +75,9 @@ def pipeline_spmd(stage_fn: StageFn, stage_params, x, axis_name: str,
     mb = x.reshape((M, B // M) + x.shape[1:])
 
     perm = [(i, (i + 1) % S) for i in range(S)]
-    state0 = _pvary(jnp.zeros_like(mb[0]), axis_name)
-    out0 = _pvary(jnp.zeros_like(mb), axis_name)
+    vary = vary_axes or (axis_name,)
+    state0 = _pvary(jnp.zeros_like(mb[0]), vary)
+    out0 = _pvary(jnp.zeros_like(mb), vary)
 
     def tick(carry, t):
         state, outputs = carry
@@ -101,12 +107,17 @@ def pipeline_spmd(stage_fn: StageFn, stage_params, x, axis_name: str,
 
 def pipeline_apply(stage_fn: StageFn, stacked_params, x, mesh: Mesh,
                    axis_name: str = "pipe", n_microbatches: int = 4,
-                   remat: bool = False):
+                   remat: bool = False, batch_axis: str = None):
     """Run a homogeneous stage stack as a pipeline over ``mesh[axis_name]``.
 
     ``stacked_params``: pytree whose leaves have leading dim
     ``n_stages == mesh axis size`` (stage i's weights at index i).
     ``x``: (B, ...) batch.  Shape-preserving ``stage_fn(params, x) -> x``.
+
+    ``batch_axis``: compose pp×dp — shard the batch dim over this mesh
+    axis; each data group runs its own pipeline over its pipe ring (the
+    per-group microbatch count is still ``n_microbatches``, so the local
+    B/dp must divide by it).
     """
     sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
     if axis_name not in sizes:
@@ -122,11 +133,14 @@ def pipeline_apply(stage_fn: StageFn, stacked_params, x, mesh: Mesh,
 
     param_specs = jax.tree_util.tree_map(
         lambda p: P(axis_name, *([None] * (p.ndim - 1))), stacked_params)
+    x_spec = P(batch_axis) if batch_axis else P()
+    vary = (axis_name, batch_axis) if batch_axis else (axis_name,)
     body = functools.partial(pipeline_spmd, stage_fn,
                              axis_name=axis_name,
-                             n_microbatches=n_microbatches, remat=remat)
+                             n_microbatches=n_microbatches, remat=remat,
+                             vary_axes=vary)
     fn = shard_map(lambda ps, xs: body(ps, xs), mesh=mesh,
-                   in_specs=(param_specs, P()), out_specs=P())
+                   in_specs=(param_specs, x_spec), out_specs=x_spec)
     return fn(stacked_params, x)
 
 

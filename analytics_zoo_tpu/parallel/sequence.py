@@ -35,15 +35,26 @@ except ImportError:  # pragma: no cover — older jax
 NEG_INF = -1e30
 
 
-def mark_varying(x, axis_name: str):
+def mark_varying(x, axis_name):
     """Mark a freshly-created (replicated) array as device-varying along
-    ``axis_name`` so shard_map scan carry types match axis-dependent loop
-    outputs.  Shared by ring attention and the pipeline schedule."""
+    ``axis_name`` (a name or tuple of names) so shard_map scan carry
+    types match axis-dependent loop outputs.  Shared by ring attention
+    and the pipeline schedule."""
+    axes = (axis_name,) if isinstance(axis_name, str) else tuple(axis_name)
     try:
-        return lax.pcast(x, (axis_name,), to="varying")
+        # only mark axes the value is not already varying over (pcast
+        # rejects mixed varying/invarying inputs)
+        cur = jax.typeof(x).vma
+        axes = tuple(a for a in axes if a not in cur)
+    except (AttributeError, TypeError):
+        pass
+    if not axes:
+        return x
+    try:
+        return lax.pcast(x, axes, to="varying")
     except (AttributeError, TypeError):  # pragma: no cover — older jax
         try:
-            return lax.pvary(x, (axis_name,))
+            return lax.pvary(x, axes)
         except AttributeError:
             return x
 
@@ -85,7 +96,14 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
         return (m_out, l_new, acc, kc, vc), None
 
     def _vary(x):
-        return mark_varying(x, axis_name)
+        # fresh accumulators must carry the same varying-axes type as the
+        # q-derived scan outputs — including a batch axis when the caller
+        # composes sp with dp (q is then varying over ('data', seq))
+        try:
+            axes = tuple(jax.typeof(q).vma | {axis_name})
+        except (AttributeError, TypeError):
+            axes = axis_name
+        return mark_varying(x, axes)
 
     # f32 carry across ring steps, matching blockwise_attention/the Pallas
     # kernel's f32 scratch, so bf16 inputs don't round the accumulator
@@ -99,10 +117,15 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
 
 def ring_self_attention(q, k, v, mesh: Mesh, seq_axis: str,
                         causal: bool = False,
-                        sm_scale: Optional[float] = None):
+                        sm_scale: Optional[float] = None,
+                        batch_axis: Optional[str] = None):
     """Convenience wrapper: shard q/k/v (B, H, L, D) on dim 2 over
-    ``seq_axis`` of ``mesh`` and run ring attention."""
-    spec = P(None, None, seq_axis, None)
+    ``seq_axis`` of ``mesh`` and run ring attention.
+
+    ``batch_axis``: additionally shard dim 0 over this mesh axis — the
+    sp×dp composition (each data group runs its own ring; leaving it
+    unset on a multi-axis mesh makes GSPMD allgather the batch)."""
+    spec = P(batch_axis, None, seq_axis, None)
 
     fn = shard_map(
         functools.partial(ring_attention, axis_name=seq_axis, causal=causal,

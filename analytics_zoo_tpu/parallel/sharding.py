@@ -24,6 +24,16 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 SpecFn = Callable[[str, Any], P]
 
 
+def _infer_batch_axis(mesh, own_axis: str) -> Optional[str]:
+    """The mesh axis the batch shards over when composing with dp:
+    prefer an axis literally named 'data', else the first axis that is
+    not the strategy's own — None on a single-axis mesh."""
+    names = [a for a in mesh.axis_names if a != own_axis]
+    if not names:
+        return None
+    return "data" if "data" in names else names[0]
+
+
 def path_str(path) -> str:
     """jax tree path -> 'a/b/c' string for regex matching."""
     parts = []
@@ -49,6 +59,17 @@ class ShardingStrategy:
             return NamedSharding(mesh, self.spec(path_str(path), leaf))
 
         return jax.tree_util.tree_map_with_path(one, params)
+
+    def activate(self, mesh):
+        """Context manager active while the Estimator traces its steps.
+
+        Strategies that change the model's *forward lowering* (ring
+        attention for SP, the GPipe schedule for PP) publish themselves
+        through parallel.mode here; pure param-placement strategies
+        (DP/TP/EP) need no hook.
+        """
+        import contextlib
+        return contextlib.nullcontext()
 
 
 class DataParallel(ShardingStrategy):
@@ -172,6 +193,108 @@ class ExpertParallel(ShardingStrategy):
         return jax.tree_util.tree_map_with_path(one, params)
 
 
+class SequenceParallel(ShardingStrategy):
+    """Sequence/context parallelism: parameters replicated, attention
+    computed as ring attention with K/V rotating over ``mesh[axis]``
+    (parallel/sequence.py).
+
+    The regime the reference cannot reach (SURVEY §5.7: sequence length
+    bounded by single-node memory): per-device attention memory is
+    O(L·L/n) and the KV exchange rides ICI neighbour hops.  Activated
+    through ``Estimator`` — ``compile(sharding="sp")`` on a mesh with a
+    sequence axis makes every ``MultiHeadAttention`` in the model lower
+    to the ring. Constraints: self-attention only, no padding masks
+    (causal is fine), attention-prob dropout is skipped on the ring.
+    """
+
+    def __init__(self, axis: str = "seq"):
+        self.axis = axis
+
+    def activate(self, mesh):
+        from analytics_zoo_tpu.parallel.mode import (SeqParallelMode,
+                                                     parallel_mode)
+        if self.axis not in mesh.axis_names:
+            raise ValueError(
+                f"SequenceParallel axis {self.axis!r} not in mesh axes "
+                f"{tuple(mesh.axis_names)}; use init_zoo_context("
+                "mesh_shape=(d, s), axis_names=('data', 'seq'))")
+        return parallel_mode(seq=SeqParallelMode(
+            mesh, self.axis,
+            batch_axis=_infer_batch_axis(mesh, self.axis)))
+
+
+class PipelineStrategy(ShardingStrategy):
+    """GPipe pipeline parallelism as an Estimator regime.
+
+    Stage weights are the model's stacked homogeneous block subtree
+    (``TransformerLayer(stacked=True)`` stores its blocks as one pytree
+    with leading dim ``n_block``); leaves under a ``blocks`` path shard
+    over ``mesh[axis]`` (each device holds 1/S of the stack) and the
+    forward routes through the microbatched ppermute ring
+    (parallel/pipeline.py).  Everything outside the block stack
+    (embeddings, heads) stays replicated and runs outside the pipeline.
+
+    Composes with data parallelism: build the mesh as
+    ``axis_names=('data', 'pipe')`` — the batch shards over ``data``,
+    each data group runs its own pipeline over its ``pipe`` ring.
+    """
+
+    def __init__(self, axis: str = "pipe", n_microbatches: int = 4,
+                 remat: bool = False,
+                 pattern: str = r"(^|/)blocks(/|$)"):
+        self.axis = axis
+        self.n_microbatches = n_microbatches
+        self.remat = remat
+        self.pattern = re.compile(pattern)
+
+    def _axis_size(self, mesh) -> int:
+        sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+        if self.axis not in sizes:
+            raise ValueError(
+                f"PipelineStrategy axis {self.axis!r} not in mesh axes "
+                f"{tuple(mesh.axis_names)}; use init_zoo_context("
+                "mesh_shape=(d, p), axis_names=('data', 'pipe'))")
+        return sizes[self.axis]
+
+    def param_shardings(self, mesh, params):
+        n = self._axis_size(mesh)
+        matched = []
+
+        def one(path, leaf):
+            p = path_str(path)
+            shape = getattr(leaf, "shape", ())
+            if self.pattern.search(p) and shape:
+                if shape[0] != n:
+                    # the GPipe body takes exactly one stage per device
+                    # (pipeline_spmd reads p[0]); multiples cannot work
+                    raise ValueError(
+                        f"stacked block param {p!r} has {shape[0]} stages "
+                        f"but the {self.axis!r} axis has {n} devices — "
+                        "n_block must equal the pipe axis size")
+                matched.append(p)
+                return NamedSharding(
+                    mesh, P(self.axis, *([None] * (len(shape) - 1))))
+            return NamedSharding(mesh, P())
+
+        out = jax.tree_util.tree_map_with_path(one, params)
+        if not matched:
+            raise ValueError(
+                "sharding='pp' found no stacked block subtree (no param "
+                "path matches 'blocks') — pipeline the model by stacking "
+                "its homogeneous blocks, e.g. TransformerLayer("
+                "stacked=True)")
+        return out
+
+    def activate(self, mesh):
+        from analytics_zoo_tpu.parallel.mode import (PipelineMode,
+                                                     parallel_mode)
+        self._axis_size(mesh)
+        return parallel_mode(pipe=PipelineMode(
+            mesh, self.axis, n_microbatches=self.n_microbatches,
+            remat=self.remat,
+            batch_axis=_infer_batch_axis(mesh, self.axis)))
+
+
 class AutoSharding(TensorParallel):
     """Mesh-adaptive: tensor-parallel over the mesh's last axis when it has
     a dedicated (non-data) axis, plain data parallelism otherwise."""
@@ -208,6 +331,24 @@ def make_strategy(name: str, mesh, **kw) -> ShardingStrategy:
                 "init_zoo_context(mesh_shape=(d, e), "
                 "axis_names=('data', 'expert'))")
         return ExpertParallel(axis=axis, **kw)
+    if name in ("sp", "seq", "sequence", "sequence_parallel", "ring"):
+        axis = kw.pop("axis", "seq")
+        if axis not in mesh.axis_names:
+            raise ValueError(
+                f"sharding='sp' needs a mesh with a {axis!r} axis (got "
+                f"axes {tuple(mesh.axis_names)}); use "
+                "init_zoo_context(mesh_shape=(d, s), "
+                "axis_names=('data', 'seq'))")
+        return SequenceParallel(axis=axis, **kw)
+    if name in ("pp", "pipe", "pipeline", "pipeline_parallel", "gpipe"):
+        axis = kw.pop("axis", "pipe")
+        if axis not in mesh.axis_names:
+            raise ValueError(
+                f"sharding='pp' needs a mesh with a {axis!r} axis (got "
+                f"axes {tuple(mesh.axis_names)}); use "
+                "init_zoo_context(mesh_shape=(d, p), "
+                "axis_names=('data', 'pipe'))")
+        return PipelineStrategy(axis=axis, **kw)
     if name in ("tp", "tensor", "tensor_parallel"):
         axis = kw.pop("axis", None)
         if axis is None:
@@ -220,4 +361,4 @@ def make_strategy(name: str, mesh, **kw) -> ShardingStrategy:
             axis = mesh.axis_names[-1]
         return TensorParallel(axis=axis, **kw)
     raise ValueError(f"unknown sharding strategy {name!r}; "
-                     "known: dp, tp, ep, auto")
+                     "known: dp, tp, ep, sp, pp, auto")

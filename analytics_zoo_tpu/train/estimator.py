@@ -170,9 +170,9 @@ class Estimator:
                 self.tx.init, out_shardings=self._opt_shardings())(self.params)
         return self
 
-    def _param_shardings(self, params):
-        """Per-parameter shardings from the strategy (replicated for DP;
-        Megatron-style model-axis splits for TP — parallel/sharding.py)."""
+    def _strategy(self):
+        """The resolved ShardingStrategy (strings lowered per-call against
+        the current mesh, so one Estimator works across meshes)."""
         from analytics_zoo_tpu.parallel.sharding import (
             ShardingStrategy, make_strategy)
 
@@ -180,7 +180,13 @@ class Estimator:
         if isinstance(strat, str):
             strat = make_strategy(strat, self.ctx.mesh)
         assert isinstance(strat, ShardingStrategy)
-        return strat.param_shardings(self.ctx.mesh, params)
+        return strat
+
+    def _param_shardings(self, params):
+        """Per-parameter shardings from the strategy (replicated for DP;
+        Megatron-style model-axis splits for TP; stacked block splits for
+        PP — parallel/sharding.py)."""
+        return self._strategy().param_shardings(self.ctx.mesh, params)
 
     def _opt_shardings(self):
         """Sharding tree for the optimizer state: subtrees shaped like the
@@ -234,6 +240,9 @@ class Estimator:
         frozen = frozenset(getattr(model, "_frozen", ()))
         self._frozen_built = frozen
 
+        strat = self._strategy()
+        mesh = self.ctx.mesh
+
         def step(params, state, opt_state, rng, xs, y):
             # rng is carried ON DEVICE and split inside the step — passing
             # a host step counter per step would cost a blocking scalar
@@ -251,8 +260,13 @@ class Estimator:
                     st_c = _cast_floats(state, cdtype)
                 else:
                     p_c, xs_c, st_c = p, xs, state
-                preds, new_state = model.call(p_c, st_c, *xs_c, training=True,
-                                              rng=rng)
+                # the strategy context is live while jit TRACES this body:
+                # layers with a parallel lowering (ring attention for SP,
+                # the GPipe block stack for PP) read it and bake the
+                # regime into the compiled program (parallel/mode.py)
+                with strat.activate(mesh):
+                    preds, new_state = model.call(p_c, st_c, *xs_c,
+                                                  training=True, rng=rng)
                 if cdtype is not None:
                     preds = _cast_floats(preds, jnp.float32)
                     new_state = _cast_like(new_state, state)
@@ -340,13 +354,17 @@ class Estimator:
         supports_mask = getattr(loss_fn, "supports_mask", False)
         mask_count = getattr(loss_fn, "mask_count", None)
         cdtype = self.compute_dtype
+        strat = self._strategy()
+        mesh = self.ctx.mesh
 
         def step(params, state, xs, y, mask):
             if cdtype is not None:
                 params = _cast_floats(params, cdtype)
                 state = _cast_floats(state, cdtype)
                 xs = _cast_floats(xs, cdtype)
-            preds, _ = model.call(params, state, *xs, training=False, rng=None)
+            with strat.activate(mesh):
+                preds, _ = model.call(params, state, *xs, training=False,
+                                      rng=None)
             if cdtype is not None:
                 preds = _cast_floats(preds, jnp.float32)
             if batch_structured and supports_mask:
@@ -384,12 +402,17 @@ class Estimator:
         rep = self.ctx.replicated_sharding()
         cdtype = self.compute_dtype
 
+        strat = self._strategy()
+        mesh = self.ctx.mesh
+
         def step(params, state, xs):
             if cdtype is not None:
                 params = _cast_floats(params, cdtype)
                 state = _cast_floats(state, cdtype)
                 xs = _cast_floats(xs, cdtype)
-            preds, _ = model.call(params, state, *xs, training=False, rng=None)
+            with strat.activate(mesh):
+                preds, _ = model.call(params, state, *xs, training=False,
+                                      rng=None)
             if cdtype is not None:
                 preds = _cast_floats(preds, jnp.float32)
             return preds

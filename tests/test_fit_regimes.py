@@ -1,0 +1,226 @@
+"""PP/EP/SP as first-class Estimator regimes: ``compile(sharding=...)``
+trains real models through ``Estimator.fit`` with checkpoint/restore,
+composing with data parallelism and gradient accumulation.
+
+The reference's bar: its one distributed strategy is fully integrated
+into fit() (Topology.scala:1069-1267); these regimes (absent there —
+SURVEY.md §2.4/§5.7) must meet the same bar here.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def fresh_names():
+    from analytics_zoo_tpu.nn import reset_name_scope
+
+    reset_name_scope()
+
+
+def _lm_data(n=64, vocab=32, L=16, seed=0):
+    """Next-token-ish classification: label = most frequent token."""
+    rs = np.random.RandomState(seed)
+    ids = rs.randint(0, vocab, (n, L)).astype(np.int32)
+    y = np.asarray([np.bincount(r, minlength=vocab).argmax() % 4
+                    for r in ids], np.int32)
+    return ids, y
+
+
+def _tiny_transformer(vocab=32, L=16, n_block=4, stacked=False,
+                      causal=True, drop=0.0):
+    from analytics_zoo_tpu.nn import Sequential
+    from analytics_zoo_tpu.nn.layers.attention import TransformerLayer
+    from analytics_zoo_tpu.nn.layers.core import Dense
+    from analytics_zoo_tpu.nn.layers.pooling import GlobalAveragePooling1D
+
+    return Sequential([
+        TransformerLayer(vocab=vocab, seq_len=L, n_block=n_block, nhead=2,
+                         hidden_size=16, intermediate_size=32,
+                         hidden_drop=drop, attn_drop=drop,
+                         embedding_drop=drop, causal=causal,
+                         stacked=stacked),
+        GlobalAveragePooling1D(),
+        Dense(4, activation="softmax"),
+    ])
+
+
+def test_pp_through_fit_with_dp_and_grad_accum(tmp_path):
+    """pp×dp: mesh ('data', 'pipe') = (2, 4); a stacked 4-block
+    transformer trains through fit() with grad accumulation, then
+    resumes from its checkpoint."""
+    from analytics_zoo_tpu import init_zoo_context
+    from analytics_zoo_tpu.nn import reset_name_scope
+    from analytics_zoo_tpu.train.optimizers import Adam
+
+    init_zoo_context(mesh_shape=(2, 4), axis_names=("data", "pipe"))
+    try:
+        ids, y = _lm_data()
+        model = _tiny_transformer(n_block=4, stacked=True)
+        model.compile(optimizer=Adam(lr=3e-3),
+                      loss="sparse_categorical_crossentropy",
+                      metrics=["accuracy"], sharding="pp",
+                      grad_accum_steps=2)
+        model.estimator.set_checkpoint(str(tmp_path))
+        hist = model.fit(ids, y, batch_size=32, nb_epoch=8, verbose=False)
+        assert hist[-1]["loss"] < hist[0]["loss"], hist
+        step_before = model.estimator.global_step
+
+        # block weights really live 1/S per pipe device
+        blocks = model.estimator.params["transformerlayer_1"]["blocks"]
+        leaf = jax.tree_util.tree_leaves(blocks)[0]
+        assert "pipe" in str(leaf.sharding.spec), leaf.sharding
+
+        # restore into a fresh estimator and keep training
+        reset_name_scope()
+        model2 = _tiny_transformer(n_block=4, stacked=True)
+        model2.compile(optimizer=Adam(lr=3e-3),
+                       loss="sparse_categorical_crossentropy",
+                       sharding="pp", grad_accum_steps=2)
+        model2.estimator._ensure_built([ids])
+        model2.estimator.load_checkpoint(str(tmp_path))
+        assert model2.estimator.global_step == step_before
+        model2.fit(ids, y, batch_size=32, nb_epoch=10, verbose=False)
+        assert model2.estimator.finished_epochs == 10
+    finally:
+        init_zoo_context()
+
+
+def test_pp_forward_matches_scan_forward():
+    """The pipelined forward computes the same function as the plain
+    scan over blocks (same stacked params, dropout off)."""
+    import jax
+
+    from analytics_zoo_tpu import init_zoo_context
+    from analytics_zoo_tpu.nn import reset_name_scope
+
+    init_zoo_context(mesh_shape=(2, 4), axis_names=("data", "pipe"))
+    try:
+        ids, y = _lm_data(n=32)
+        model = _tiny_transformer(n_block=4, stacked=True)
+        model.compile(optimizer="adam",
+                      loss="sparse_categorical_crossentropy", sharding="pp")
+        pp_preds = model.predict(ids, batch_size=32)
+
+        reset_name_scope()
+        model2 = _tiny_transformer(n_block=4, stacked=True)
+        model2.compile(optimizer="adam",
+                       loss="sparse_categorical_crossentropy", sharding="dp")
+        model2.estimator._ensure_built([ids])
+        model2.estimator.set_initial_weights(
+            jax.device_get(model.estimator.params), {})
+        dp_preds = model2.predict(ids, batch_size=32)
+        np.testing.assert_allclose(pp_preds, dp_preds, rtol=2e-4, atol=2e-5)
+    finally:
+        init_zoo_context()
+
+
+def test_sp_through_fit_and_matches_dp():
+    """sp: mesh ('data', 'seq') = (2, 4); ring attention trains through
+    fit(), and its forward matches the dp (blockwise) forward."""
+    import jax
+
+    from analytics_zoo_tpu import init_zoo_context
+    from analytics_zoo_tpu.nn import reset_name_scope
+
+    init_zoo_context(mesh_shape=(2, 4), axis_names=("data", "seq"))
+    try:
+        ids, y = _lm_data(n=64, L=16)
+        model = _tiny_transformer(n_block=2, stacked=False, causal=True)
+        model.compile(optimizer="adam",
+                      loss="sparse_categorical_crossentropy",
+                      metrics=["accuracy"], sharding="sp")
+        hist = model.fit(ids, y, batch_size=32, nb_epoch=6, verbose=False)
+        assert hist[-1]["loss"] < hist[0]["loss"], hist
+        sp_preds = model.predict(ids, batch_size=32)
+
+        reset_name_scope()
+        model2 = _tiny_transformer(n_block=2, stacked=False, causal=True)
+        model2.compile(optimizer="adam",
+                       loss="sparse_categorical_crossentropy", sharding="dp")
+        model2.estimator._ensure_built([ids])
+        model2.estimator.set_initial_weights(
+            jax.device_get(model.estimator.params), {})
+        dp_preds = model2.predict(ids, batch_size=32)
+        np.testing.assert_allclose(sp_preds, dp_preds, rtol=2e-4, atol=2e-5)
+    finally:
+        init_zoo_context()
+
+
+def test_ep_through_fit_with_grad_accum(tmp_path):
+    """ep×dp: mesh ('data', 'expert') = (4, 2); a MoE model trains
+    through fit() with grad accumulation and checkpoints."""
+    from analytics_zoo_tpu import init_zoo_context
+    from analytics_zoo_tpu.nn import Sequential
+    from analytics_zoo_tpu.nn.layers import SparseMoE
+    from analytics_zoo_tpu.nn.layers.core import Dense
+    from analytics_zoo_tpu.train.optimizers import Adam
+
+    init_zoo_context(mesh_shape=(4, 2), axis_names=("data", "expert"))
+    try:
+        rs = np.random.RandomState(0)
+        x = rs.randn(256, 8).astype(np.float32)
+        y = (x[:, 0] > 0).astype(np.int32)
+        model = Sequential([
+            Dense(16, activation="relu"),
+            SparseMoE(n_experts=4, hidden_dim=32, top_k=2,
+                      capacity_factor=2.0, expert_axis="expert"),
+            Dense(2, activation="softmax"),
+        ])
+        model.compile(optimizer=Adam(lr=3e-3),
+                      loss="sparse_categorical_crossentropy",
+                      metrics=["accuracy"], sharding="ep",
+                      grad_accum_steps=2)
+        model.estimator.set_checkpoint(str(tmp_path))
+        hist = model.fit(x, y, batch_size=64, nb_epoch=10, verbose=False)
+        assert hist[-1]["loss"] < hist[0]["loss"], hist
+        res = model.evaluate(x, y, batch_size=64)
+        assert res["accuracy"] > 0.8, res
+
+        # expert weights really shard over the expert axis
+        import jax
+        moe_params = model.estimator.params["sparsemoe_1"]
+        assert "expert" in str(moe_params["w1"].sharding.spec), \
+            moe_params["w1"].sharding
+    finally:
+        init_zoo_context()
+
+
+def test_pp_requires_stacked_blocks():
+    from analytics_zoo_tpu import init_zoo_context
+
+    init_zoo_context(mesh_shape=(2, 4), axis_names=("data", "pipe"))
+    try:
+        ids, y = _lm_data(n=32)
+        model = _tiny_transformer(n_block=4, stacked=False)
+        model.compile(optimizer="adam",
+                      loss="sparse_categorical_crossentropy", sharding="pp")
+        with pytest.raises(ValueError, match="stacked"):
+            model.fit(ids, y, batch_size=32, nb_epoch=1, verbose=False)
+    finally:
+        init_zoo_context()
+
+
+def test_sp_rejects_padding_mask():
+    from analytics_zoo_tpu import init_zoo_context
+    from analytics_zoo_tpu.nn.layers.attention import MultiHeadAttention
+    from analytics_zoo_tpu.parallel.mode import (SeqParallelMode,
+                                                 parallel_mode)
+    import jax
+    import jax.numpy as jnp
+
+    ctx = init_zoo_context(mesh_shape=(2, 4), axis_names=("data", "seq"))
+    try:
+        mha = MultiHeadAttention(nhead=2)
+        x = jnp.ones((2, 8, 16))
+        mask = jnp.ones((2, 8))
+        params = mha.build_params(jax.random.PRNGKey(0), x.shape)
+        with parallel_mode(seq=SeqParallelMode(ctx.mesh, "seq")):
+            with pytest.raises(ValueError, match="mask"):
+                mha.forward(params, x, mask)
+    finally:
+        init_zoo_context()
+
+
+
