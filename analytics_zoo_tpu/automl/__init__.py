@@ -8,25 +8,33 @@ Capability parity, TPU-native design:
   (search/__init__.py): the reference bootstraps a second Ray runtime on
   Spark executors (RayOnSpark) because its training is JVM-cluster-bound;
   here every trial is a jitted JAX program on the local mesh, so trials
-  run in a thread pool and ray is not required (used if installed).
+  run concurrently in a thread/process pool and ray is not required.
+  ``search_alg="tpe"`` / ``BayesRecipe`` give BayesOpt-style sequential
+  model-based search (reference RayTuneSearchEngine.py:25 BayesOptSearch).
 - Feature engineering (rolling windows, datetime features, scaling) in
   feature/time_sequence.py (reference feature/time_sequence.py:30-540).
-- Models: VanillaLSTM (future_seq_len==1) and Seq2Seq (>1) on the native
-  nn stack (reference automl/model/VanillaLSTM.py, Seq2Seq.py).
+- Models: VanillaLSTM, encoder-decoder Seq2Seq (future_seq_len>1), and
+  MTNet (model/mtnet.py — the reference's flagship, MTNet_keras.py),
+  selectable via the config's ``model`` key.
 """
 
 from analytics_zoo_tpu.automl.common.metrics import Evaluator
 from analytics_zoo_tpu.automl.feature.time_sequence import (
     TimeSequenceFeatureTransformer)
+from analytics_zoo_tpu.automl.model.mtnet import MTNet, MTNetBlock
 from analytics_zoo_tpu.automl.pipeline.time_sequence import (
     TimeSequencePipeline, load_ts_pipeline)
 from analytics_zoo_tpu.automl.regression.time_sequence_predictor import (
     TimeSequencePredictor)
-from analytics_zoo_tpu.automl.search import (GridRandomRecipe, RandomRecipe,
+from analytics_zoo_tpu.automl.search import (BayesRecipe, GridRandomRecipe,
+                                             MTNetGridRandomRecipe,
+                                             MTNetSmokeRecipe, RandomRecipe,
                                              Recipe, SearchEngine,
-                                             SmokeRecipe)
+                                             SmokeRecipe, TPESampler)
 
 __all__ = ["TimeSequencePredictor", "TimeSequencePipeline",
            "load_ts_pipeline", "TimeSequenceFeatureTransformer",
            "Evaluator", "SearchEngine", "Recipe", "SmokeRecipe",
-           "RandomRecipe", "GridRandomRecipe"]
+           "RandomRecipe", "GridRandomRecipe", "BayesRecipe",
+           "MTNetSmokeRecipe", "MTNetGridRandomRecipe", "TPESampler",
+           "MTNet", "MTNetBlock"]

@@ -82,26 +82,121 @@ class VanillaLSTM:
     def restore(self, path: str, x_shape, out_dim, config) -> None:
         from analytics_zoo_tpu.train import checkpoint as ckpt
 
-        self.config = dict(config)
-        self.model = _build_lstm(x_shape, config, out_dim)
-        from analytics_zoo_tpu.train.optimizers import Adam
-
-        self.model.compile(
-            optimizer=Adam(lr=float(config.get("lr", 1e-3))), loss="mse")
+        # rebuild through _ensure so subclasses (encoder-decoder)
+        # reconstruct their own architecture
+        x = np.zeros((2,) + tuple(x_shape), np.float32)
+        y = np.zeros((2, out_dim), np.float32)
+        self._ensure(x, y, config)
         tree = ckpt.load_pytree(path)
         self.model.estimator.set_initial_weights(tree["params"],
                                                  tree.get("state", {}))
 
 
-class Seq2SeqForecaster(VanillaLSTM):
-    """Multi-step forecaster (future_seq_len > 1).
+def _build_encdec_block():
+    import jax
+    import jax.numpy as jnp
 
-    The reference uses an encoder-decoder (Seq2Seq.py); on TPU a direct
-    multi-horizon head on the LSTM encoder trains in one fused program
-    without a sequential decode loop — same capability (N-step forecast),
-    better XLA shape.
+    from analytics_zoo_tpu.nn.module import StatelessLayer
+
+    class Seq2SeqBlock(StatelessLayer):
+        """Encoder-decoder forecaster (reference automl/model/Seq2Seq.py):
+        an LSTM encodes the history window; a decoder LSTM unrolls
+        ``future_seq_len`` steps autoregressively from the encoder state
+        (its own previous prediction as input — inference-consistent, no
+        teacher-forcing/inference mismatch), each step projected to the
+        target dim.  Both scans are ``lax.scan`` — one jitted program.
+        """
+
+        def __init__(self, future_seq_len: int, latent_dim: int = 32,
+                     out_dim: int = 1, **kw):
+            super().__init__(**kw)
+            self.future_seq_len = future_seq_len
+            self.latent_dim = latent_dim
+            self.out_dim = out_dim
+
+        @staticmethod
+        def _lstm_params(rng, d_in, d_h):
+            k1, k2 = jax.random.split(rng)
+            glorot = jax.nn.initializers.glorot_uniform()
+            return {"wi": glorot(k1, (d_in, 4 * d_h), jnp.float32),
+                    "wh": glorot(k2, (d_h, 4 * d_h), jnp.float32),
+                    "b": jnp.zeros((4 * d_h,), jnp.float32)}
+
+        @staticmethod
+        def _lstm_step(p, carry, x):
+            h_prev, c_prev = carry
+            d_h = h_prev.shape[-1]
+            g = x @ p["wi"] + h_prev @ p["wh"] + p["b"]
+            i = jax.nn.sigmoid(g[..., :d_h])
+            f = jax.nn.sigmoid(g[..., d_h:2 * d_h] + 1.0)  # forget bias 1
+            o = jax.nn.sigmoid(g[..., 2 * d_h:3 * d_h])
+            c = f * c_prev + i * jnp.tanh(g[..., 3 * d_h:])
+            h = o * jnp.tanh(c)
+            return h, c
+
+        def build_params(self, rng, input_shape):
+            d_in = input_shape[-1]
+            k1, k2, k3 = jax.random.split(rng, 3)
+            glorot = jax.nn.initializers.glorot_uniform()
+            return {
+                "enc": self._lstm_params(k1, d_in, self.latent_dim),
+                "dec": self._lstm_params(k2, self.out_dim, self.latent_dim),
+                "proj_w": glorot(k3, (self.latent_dim, self.out_dim),
+                                 jnp.float32),
+                "proj_b": jnp.zeros((self.out_dim,), jnp.float32),
+            }
+
+        def forward(self, params, x, training=False, rng=None):
+            b = x.shape[0]
+            h0 = (jnp.zeros((b, self.latent_dim), x.dtype),
+                  jnp.zeros((b, self.latent_dim), x.dtype))
+
+            def enc_step(carry, x_t):
+                return self._lstm_step(params["enc"], carry, x_t), None
+
+            carry, _ = jax.lax.scan(enc_step, h0, x.swapaxes(0, 1))
+
+            y0 = jnp.zeros((b, self.out_dim), x.dtype)
+
+            def dec_step(state, _):
+                carry, y_prev = state
+                carry = self._lstm_step(params["dec"], carry, y_prev)
+                y_t = carry[0] @ params["proj_w"] + params["proj_b"]
+                return (carry, y_t), y_t
+
+            _, ys = jax.lax.scan(dec_step, (carry, y0), None,
+                                 length=self.future_seq_len)
+            return ys.swapaxes(0, 1).reshape(b, -1)   # (B, F*out_dim)
+
+    return Seq2SeqBlock
+
+
+class Seq2SeqForecaster(VanillaLSTM):
+    """Multi-step forecaster (future_seq_len > 1) — a true LSTM
+    encoder-decoder (reference automl/model/Seq2Seq.py), decoder unrolled
+    as a ``lax.scan`` over the horizon.
     """
 
     def __init__(self, future_seq_len: int = 2, **kw):
         super().__init__(**kw)
         self.future_seq_len = future_seq_len
+
+    def _ensure(self, x, y, config):
+        from analytics_zoo_tpu.nn import reset_name_scope
+        from analytics_zoo_tpu.nn.topology import Sequential
+        from analytics_zoo_tpu.train.optimizers import Adam
+
+        reset_name_scope()
+        out_dim = y.shape[1] if y.ndim > 1 else 1
+        self.config = dict(config)
+        block_cls = _build_encdec_block()
+        m = Sequential()
+        m.add(block_cls(
+            future_seq_len=max(self.future_seq_len, 1),
+            latent_dim=int(config.get("latent_dim",
+                                      config.get("lstm_1_units", 32))),
+            out_dim=max(1, out_dim // max(self.future_seq_len, 1)),
+            input_shape=tuple(x.shape[1:])))
+        self.model = m
+        self.model.compile(
+            optimizer=Adam(lr=float(config.get("lr", 1e-3))), loss="mse")

@@ -46,7 +46,8 @@ class TimeSequencePipeline:
         self.model.save(os.path.join(pipeline_dir, "model.npz"))
         meta = {"config": {k: (list(v) if isinstance(v, (list, tuple))
                                else v) for k, v in self.config.items()},
-                "future_seq_len": self.feature_transformer.future_seq_len}
+                "future_seq_len": self.feature_transformer.future_seq_len,
+                "model_class": type(self.model).__name__}
         with open(os.path.join(pipeline_dir, "pipeline.json"), "w") as f:
             json.dump(meta, f)
 
@@ -57,7 +58,17 @@ def load_ts_pipeline(pipeline_dir: str) -> TimeSequencePipeline:
     ft = TimeSequenceFeatureTransformer.load(
         os.path.join(pipeline_dir, "feature_transformer.json"))
     config = meta["config"]
-    model = VanillaLSTM()
+    fsl = int(meta.get("future_seq_len", 1))
+    cls_name = meta.get("model_class", "VanillaLSTM")
+    if cls_name == "MTNet":
+        from analytics_zoo_tpu.automl.model.mtnet import MTNet
+        model = MTNet(future_seq_len=fsl)
+    elif cls_name == "Seq2SeqForecaster":
+        from analytics_zoo_tpu.automl.model.time_sequence import (
+            Seq2SeqForecaster)
+        model = Seq2SeqForecaster(fsl)
+    else:
+        model = VanillaLSTM()
     # the transformer's config holds the RESOLVED feature selection and
     # window length (fit_transform persists them), so the model input
     # width is reconstructed exactly

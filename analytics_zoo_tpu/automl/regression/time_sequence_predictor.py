@@ -53,6 +53,22 @@ class TimeSequencePredictor:
                     raise ValueError(f"column {col!r} missing from frame")
         Evaluator.evaluate(metric, [0.0], [0.0])   # validates metric name
 
+    def _make_model(self, config: Dict):
+        """Model selection via the config's ``model`` key (reference
+        recipes carry "model": "LSTM"|"Seq2seq"|"MTNet",
+        time_sequence_predictor.py:70,99,162)."""
+        name = str(config.get("model", "")).lower()
+        if name == "mtnet":
+            from analytics_zoo_tpu.automl.model.mtnet import MTNet
+            return MTNet(future_seq_len=self.future_seq_len)
+        if name in ("seq2seq", "seq2seqforecaster"):
+            return Seq2SeqForecaster(max(self.future_seq_len, 1))
+        if name in ("lstm", "vanillalstm"):
+            return VanillaLSTM()
+        # default: horizon decides (the pre-"model"-key behavior)
+        return (VanillaLSTM() if self.future_seq_len == 1
+                else Seq2SeqForecaster(self.future_seq_len))
+
     def fit(self, input_df: pd.DataFrame,
             validation_df: Optional[pd.DataFrame] = None,
             metric: str = "mse", recipe: Optional[Recipe] = None,
@@ -83,15 +99,16 @@ class TimeSequencePredictor:
                 split = max(1, int(len(x) * 0.9))
                 val = (x[split:], y[split:]) if split < len(x) else None
                 x, y = x[:split], y[:split]
-            model = (VanillaLSTM() if self.future_seq_len == 1
-                     else Seq2SeqForecaster(self.future_seq_len))
+            model = self._make_model(config)
             score = model.fit_eval(x, y, validation_data=val, metric=metric,
                                    **config)
             return score, {"ft": ft, "model": model}
 
-        engine = SearchEngine(space, metric_mode=mode,
-                              num_samples=recipe.num_samples,
-                              max_parallel=max_parallel)
+        engine = SearchEngine(
+            space, metric_mode=mode, num_samples=recipe.num_samples,
+            max_parallel=max_parallel,
+            search_alg=getattr(recipe, "search_alg", "random"),
+            n_startup=getattr(recipe, "n_startup", None))
         engine.run(trainable)
         best = engine.best()
         logger.info("best config %s -> %s=%.6g", best.config, metric,

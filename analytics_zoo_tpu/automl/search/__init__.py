@@ -2,103 +2,33 @@
 in-process engine with a ray.tune-shaped API.
 
 Reference capability: ``RayTuneSearchEngine`` (automl/search/
-RayTuneSearchEngine.py:28) running trials as Ray actors over RayOnSpark.
-TPU-native redesign: a trial is a jitted JAX program on the local mesh,
-so the engine runs trials in a thread pool in-process — no second
-runtime to bootstrap (RayOnSpark's barrier-stage dance,
-ray/util/raycontext.py:155-189, is obsolete by construction).  If ray is
-installed the same search space works with ray.tune unchanged.
+RayTuneSearchEngine.py:28) running trials as Ray actors over RayOnSpark,
+with Bayesian optimization via tune's BayesOptSearch (:25).  TPU-native
+redesign: a trial is a jitted JAX program on the local mesh, so the
+engine runs trials concurrently in-process (thread pool; process pool
+for GIL-bound host-heavy trainables) — no second runtime to bootstrap
+(RayOnSpark's barrier-stage dance, ray/util/raycontext.py:155-189, is
+obsolete by construction).  ``search_alg="tpe"`` replaces BayesOptSearch
+with a numpy-only TPE sampler (search/tpe.py) whose proposals are a
+deterministic function of (seed, history) — reruns at the same
+parallelism reproduce bit-for-bit regardless of thread scheduling.
 """
 
 from __future__ import annotations
 
 import concurrent.futures as cf
-import itertools
 import logging
+import math
 import random
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
+from analytics_zoo_tpu.automl.search.space import (  # noqa: F401
+    Choice, FeatureSubset, GridSearch, LogUniform, RandInt, Sampler,
+    Uniform, expand_grid, finalize_config, sample_config)
+from analytics_zoo_tpu.automl.search.tpe import TPESampler
+
 logger = logging.getLogger("analytics_zoo_tpu.automl")
-
-
-# ---------------------------------------------------------------------------
-# sampling primitives (tune.choice / randint / uniform / grid_search)
-# ---------------------------------------------------------------------------
-
-class Sampler:
-    def sample(self, rng: random.Random) -> Any:
-        raise NotImplementedError
-
-
-@dataclass
-class Choice(Sampler):
-    values: Sequence[Any]
-
-    def sample(self, rng):
-        return rng.choice(list(self.values))
-
-
-@dataclass
-class RandInt(Sampler):
-    low: int
-    high: int    # inclusive
-
-    def sample(self, rng):
-        return rng.randint(self.low, self.high)
-
-
-@dataclass
-class Uniform(Sampler):
-    low: float
-    high: float
-
-    def sample(self, rng):
-        return rng.uniform(self.low, self.high)
-
-
-@dataclass
-class LogUniform(Sampler):
-    low: float
-    high: float
-
-    def sample(self, rng):
-        import math
-
-        return math.exp(rng.uniform(math.log(self.low), math.log(self.high)))
-
-
-@dataclass
-class GridSearch(Sampler):
-    """Expanded exhaustively (cartesian with other GridSearch dims)."""
-
-    values: Sequence[Any]
-
-
-def sample_config(space: Dict[str, Any], rng: random.Random) -> Dict[str, Any]:
-    out = {}
-    for k, v in space.items():
-        if isinstance(v, GridSearch):
-            out[k] = rng.choice(list(v.values))
-        elif isinstance(v, Sampler):
-            out[k] = v.sample(rng)
-        else:
-            out[k] = v
-    return out
-
-
-def expand_grid(space: Dict[str, Any]) -> List[Dict[str, Any]]:
-    """Cartesian product over GridSearch dims (non-grid dims untouched)."""
-    grid_keys = [k for k, v in space.items() if isinstance(v, GridSearch)]
-    if not grid_keys:
-        return [dict(space)]
-    combos = itertools.product(*[space[k].values for k in grid_keys])
-    out = []
-    for combo in combos:
-        d = dict(space)
-        d.update(dict(zip(grid_keys, combo)))
-        out.append(d)
-    return out
 
 
 # ---------------------------------------------------------------------------
@@ -182,19 +112,85 @@ class GridRandomRecipe(Recipe):
         }
 
 
-@dataclass
-class FeatureSubset(Sampler):
-    """Random non-empty subset of generated features (the reference's
-    per-feature Choice([0,1]) encoding, RayTuneSearchEngine.py)."""
+class MTNetSmokeRecipe(Recipe):
+    """One MTNet trial with fixed hyper-parameters (reference
+    MTNetSmokeRecipe, time_sequence_predictor.py:88-117).  past_seq_len
+    is pinned to (long_num + 1) * time_step as MTNet's window split
+    requires."""
 
-    values: Sequence[str]
+    num_samples = 1
+    training_iteration = 1
 
-    def sample(self, rng):
-        vals = list(self.values)
-        if not vals:
-            return []
-        picked = [v for v in vals if rng.random() < 0.5]
-        return picked or [rng.choice(vals)]
+    def search_space(self, all_available_features):
+        return {
+            "selected_features": list(all_available_features),
+            "model": "MTNet",
+            "lr": 1e-3,
+            "batch_size": 16,
+            "epochs": 1,
+            "dropout": 0.2,
+            "time_step": 3,
+            "long_num": 3,
+            "cnn_height": 2,
+            "ar_window": 2,
+            "cnn_hid_size": 16,
+            "rnn_hid_sizes": [8, 16],
+            "past_seq_len": (3 + 1) * 3,
+        }
+
+
+class MTNetGridRandomRecipe(Recipe):
+    """Grid over MTNet structure × random over training params; the
+    grid keeps (long_num, time_step) pairs with a consistent
+    past_seq_len per combo (the reference samples past_seq_len as a
+    dependent RandomSample — here each grid point carries its own)."""
+
+    def __init__(self, num_rand_samples: int = 1,
+                 time_steps: Sequence[int] = (3, 4),
+                 long_nums: Sequence[int] = (3, 4)):
+        self.num_samples = num_rand_samples
+        self.training_iteration = 10
+        combos = [{"time_step": t, "long_num": n,
+                   "past_seq_len": (n + 1) * t}
+                  for t in time_steps for n in long_nums]
+        self._combos = combos
+
+    def search_space(self, all_available_features):
+        return {
+            "selected_features": list(all_available_features),
+            "model": "MTNet",
+            "__mtnet_shape": GridSearch(self._combos),
+            "cnn_height": Choice([1, 2]),
+            "cnn_hid_size": Choice([16, 32]),
+            "ar_window": Choice([1, 2]),
+            "dropout": Uniform(0.2, 0.5),
+            "lr": LogUniform(1e-4, 1e-2),
+            "batch_size": Choice([32, 64]),
+            "epochs": 5,
+        }
+
+
+class BayesRecipe(Recipe):
+    """TPE (Bayesian-optimization-style) search over the LSTM space —
+    the reference's BayesRecipe (time_sequence_predictor.py, driving
+    tune BayesOptSearch).  Same space as RandomRecipe; the engine's TPE
+    sampler concentrates later trials around observed good regions, so
+    at equal trial budget it finds better configs than random sampling.
+    """
+
+    search_alg = "tpe"
+
+    def __init__(self, num_samples: int = 16, look_back: int = 2,
+                 n_startup: Optional[int] = None):
+        self.num_samples = num_samples
+        self.training_iteration = 10
+        self.look_back = look_back
+        self.n_startup = n_startup if n_startup is not None \
+            else max(4, num_samples // 4)
+
+    def search_space(self, all_available_features):
+        return RandomRecipe(1, self.look_back).search_space(
+            all_available_features)
 
 
 # ---------------------------------------------------------------------------
@@ -208,6 +204,26 @@ class TrialResult:
     extra: Dict[str, Any] = field(default_factory=dict)
 
 
+def _run_one_trial(trainable, fail_score: float, cfg: Dict[str, Any]
+                   ) -> TrialResult:
+    """One trial, exception-contained (module-level so the process
+    backend can pickle it).  A failing or non-numeric-scoring trial is
+    recorded as worst-possible, not fatal — one bad sampled config must
+    not lose the whole search (ray.tune's failed-trial tolerance)."""
+    cfg = finalize_config(cfg)
+    try:
+        out = trainable(dict(cfg))
+        if isinstance(out, tuple):
+            score, extra = out
+        else:
+            score, extra = out, {}
+        score = float(score)
+    except Exception as e:
+        logger.warning("trial failed for config %s: %s", cfg, e)
+        return TrialResult(cfg, fail_score, {"error": str(e)})
+    return TrialResult(cfg, score, extra)
+
+
 class SearchEngine:
     """Run trials over a search space, keep the best by metric.
 
@@ -216,12 +232,23 @@ class SearchEngine:
     """
 
     def __init__(self, search_space: Dict[str, Any], metric_mode: str = "min",
-                 num_samples: int = 1, max_parallel: int = 1, seed: int = 42):
+                 num_samples: int = 1, max_parallel: int = 1, seed: int = 42,
+                 search_alg: str = "random", backend: str = "thread",
+                 n_startup: Optional[int] = None):
+        """``search_alg``: "random" (i.i.d. sampling, grid dims expanded
+        exhaustively) or "tpe" (sequential model-based, search/tpe.py).
+        ``backend``: "thread" (default — trials are jitted programs that
+        release the GIL) or "process" (host-heavy picklable trainables).
+        ``n_startup``: random trials before TPE kicks in.
+        """
         self.search_space = search_space
         self.metric_mode = metric_mode
         self.num_samples = num_samples
         self.max_parallel = max(1, max_parallel)
         self.seed = seed
+        self.search_alg = search_alg
+        self.backend = backend
+        self.n_startup = n_startup
         self.results: List[TrialResult] = []
 
     def _configs(self) -> List[Dict[str, Any]]:
@@ -232,36 +259,77 @@ class SearchEngine:
                 configs.append(sample_config(grid_cfg, rng))
         return configs
 
-    def run(self, trainable: Callable[[Dict[str, Any]], Any]
-            ) -> List[TrialResult]:
-        configs = self._configs()
+    def _budget(self) -> int:
+        return len(expand_grid(self.search_space)) * self.num_samples
+
+    def _pool(self):
+        if self.backend == "process":
+            return cf.ProcessPoolExecutor(self.max_parallel)
+        return cf.ThreadPoolExecutor(self.max_parallel)
+
+    def _run_batch(self, trainable, configs) -> List[TrialResult]:
+        import functools
+        import pickle
+        from concurrent.futures.process import BrokenProcessPool
+
         fail_score = float("-inf") if self.metric_mode == "max" \
             else float("inf")
+        one = functools.partial(_run_one_trial, trainable, fail_score)
 
-        def one(cfg):
-            # a failing trial is recorded as worst-possible, not fatal —
-            # one bad sampled config must not lose the whole search
-            # (ray.tune's failed-trial tolerance)
+        if self.max_parallel == 1 or len(configs) == 1:
+            return [one(c) for c in configs]
+        if self.backend == "process":
             try:
-                out = trainable(dict(cfg))
-            except Exception as e:
-                logger.warning("trial failed for config %s: %s", cfg, e)
-                return TrialResult(cfg, fail_score, {"error": str(e)})
-            if isinstance(out, tuple):
-                score, extra = out
-            else:
-                score, extra = out, {}
-            return TrialResult(cfg, float(score), extra)
+                with self._pool() as pool:
+                    return list(pool.map(one, configs))
+            except (AttributeError, TypeError, ImportError,
+                    ModuleNotFoundError, pickle.PicklingError,
+                    BrokenProcessPool, OSError) as e:
+                # unpicklable trainable/results (closures, live models) or
+                # a crashed worker — degrade to threads.  NOTE: trials
+                # dispatched before the error may rerun; the process
+                # backend is for module-level pure trainables.
+                logger.warning("process pool unusable (%s); running "
+                               "trials in threads", e)
+        with cf.ThreadPoolExecutor(self.max_parallel) as pool:
+            return list(pool.map(one, configs))
 
-        if self.max_parallel == 1:
-            self.results = [one(c) for c in configs]
+    def run(self, trainable: Callable[[Dict[str, Any]], Any]
+            ) -> List[TrialResult]:
+        if self.search_alg in ("tpe", "bayes", "bayesopt"):
+            self.results = self._run_tpe(trainable)
         else:
-            with cf.ThreadPoolExecutor(self.max_parallel) as pool:
-                self.results = list(pool.map(one, configs))
+            self.results = self._run_batch(trainable, self._configs())
         for i, r in enumerate(self.results):
             logger.info("trial %d/%d metric=%.6g", i + 1,
                         len(self.results), r.metric)
         return self.results
+
+    def _run_tpe(self, trainable) -> List[TrialResult]:
+        """Sequential model-based search in rounds of ``max_parallel``:
+        propose a batch from the TPE sampler, evaluate concurrently,
+        feed the scores back.  Proposals are drawn sequentially from one
+        seeded rng in the driver thread, so a rerun at the same
+        parallelism reproduces the exact trial sequence regardless of
+        worker scheduling (within a batch, later proposals don't see
+        batch-mates' scores — the standard batching tradeoff)."""
+        budget = self._budget()
+        sampler = TPESampler(
+            self.search_space, mode=self.metric_mode,
+            n_startup=self.n_startup if self.n_startup is not None
+            else max(4, budget // 4),
+            seed=self.seed)
+        results: List[TrialResult] = []
+        history: List = []
+        while len(results) < budget:
+            k = min(self.max_parallel, budget - len(results))
+            batch = [sampler.propose(history) for _ in range(k)]
+            out = self._run_batch(trainable, batch)
+            results.extend(out)
+            # feed the sampler the RAW proposals (pre-finalize_config),
+            # so dependent-bundle keys keep being modeled
+            history.extend((raw, r.metric) for raw, r in zip(batch, out))
+        return results
 
     def best(self) -> TrialResult:
         if not self.results:
@@ -276,6 +344,8 @@ class SearchEngine:
 
 
 __all__ = ["SearchEngine", "TrialResult", "Recipe", "SmokeRecipe",
-           "RandomRecipe", "GridRandomRecipe", "Choice", "RandInt",
-           "Uniform", "LogUniform", "GridSearch", "FeatureSubset",
-           "sample_config", "expand_grid"]
+           "RandomRecipe", "GridRandomRecipe", "BayesRecipe",
+           "MTNetSmokeRecipe", "MTNetGridRandomRecipe", "Choice",
+           "RandInt", "Uniform", "LogUniform", "GridSearch",
+           "FeatureSubset", "TPESampler", "sample_config", "expand_grid",
+           "finalize_config"]

@@ -187,3 +187,282 @@ class TestTimeSequencePredictor:
         assert "promo" in feats
         pipeline = tsp.fit(df, recipe=SmokeRecipe())
         assert np.isfinite(pipeline.evaluate(df))
+
+
+# ---------------------------------------------------------------------------
+# TPE / BayesOpt-parity search (VERDICT r2 #5)
+# ---------------------------------------------------------------------------
+
+def _quadratic_space():
+    from analytics_zoo_tpu.automl.search import (Choice, LogUniform,
+                                                 Uniform)
+
+    return {
+        "x": Uniform(-4.0, 4.0),
+        "y": LogUniform(1e-3, 1e1),
+        "arch": Choice(["a", "b", "c"]),
+        "fixed": 7,
+    }
+
+
+def _quadratic_obj(cfg):
+    import math
+
+    # optimum at x=1.2, y=0.1, arch="b"
+    pen = {"a": 1.0, "b": 0.0, "c": 2.0}[cfg["arch"]]
+    return ((cfg["x"] - 1.2) ** 2
+            + (math.log10(cfg["y"]) - math.log10(0.1)) ** 2 + pen)
+
+
+def test_tpe_beats_random_equal_budget():
+    from analytics_zoo_tpu.automl.search import SearchEngine
+
+    budget = 48
+    space = _quadratic_space()
+    rnd_best, tpe_best = [], []
+    for seed in (0, 1, 2):
+        rnd = SearchEngine(space, num_samples=budget, seed=seed)
+        rnd.run(_quadratic_obj)
+        tpe = SearchEngine(space, num_samples=budget, seed=seed,
+                           search_alg="tpe")
+        tpe.run(_quadratic_obj)
+        assert len(tpe.results) == len(rnd.results) == budget
+        rnd_best.append(rnd.best().metric)
+        tpe_best.append(tpe.best().metric)
+    # TPE concentrates trials near the optimum: better on average over
+    # seeds at the same trial budget
+    assert sum(tpe_best) < sum(rnd_best), (tpe_best, rnd_best)
+
+
+def test_tpe_reproducible_under_concurrency():
+    """Concurrency determinism: re-running with the same seed and the
+    same parallelism yields the identical trial sequence — thread
+    scheduling cannot perturb proposals (they are drawn sequentially in
+    the driver; pool.map preserves result order)."""
+    from analytics_zoo_tpu.automl.search import SearchEngine
+
+    space = _quadratic_space()
+    runs = []
+    for _ in range(2):
+        eng = SearchEngine(space, num_samples=24, seed=5,
+                           search_alg="tpe", max_parallel=4)
+        eng.run(_quadratic_obj)
+        runs.append([(r.config, r.metric) for r in eng.results])
+    assert runs[0] == runs[1]
+
+
+def test_random_engine_identical_at_any_parallelism():
+    """The random engine pre-samples all configs from one seeded rng, so
+    its trial list is byte-identical at any max_parallel."""
+    from analytics_zoo_tpu.automl.search import SearchEngine
+
+    space = _quadratic_space()
+    runs = []
+    for mp in (1, 4):
+        eng = SearchEngine(space, num_samples=16, seed=7, max_parallel=mp)
+        eng.run(_quadratic_obj)
+        runs.append([(r.config, r.metric) for r in eng.results])
+    assert runs[0] == runs[1]
+
+
+def test_tpe_handles_failed_trials():
+    from analytics_zoo_tpu.automl.search import SearchEngine
+
+    def flaky(cfg):
+        if cfg["arch"] == "c":
+            raise RuntimeError("boom")
+        return _quadratic_obj(cfg)
+
+    eng = SearchEngine(_quadratic_space(), num_samples=24, seed=3,
+                       search_alg="tpe")
+    eng.run(flaky)
+    best = eng.best()
+    assert best.config["arch"] != "c"
+    assert len(eng.results) == 24
+
+
+def test_process_backend_falls_back_on_closure():
+    """Closures are unpicklable -> process backend must degrade to
+    threads, not crash."""
+    from analytics_zoo_tpu.automl.search import SearchEngine
+
+    captured = {"n": 0}
+
+    def obj(cfg):
+        captured["n"] += 1
+        return _quadratic_obj(cfg)
+
+    eng = SearchEngine(_quadratic_space(), num_samples=8, seed=0,
+                       max_parallel=2, backend="process")
+    eng.run(obj)
+    assert len(eng.results) == 8
+
+
+def test_bayes_recipe_through_predictor(tmp_path):
+    import numpy as np
+    import pandas as pd
+
+    from analytics_zoo_tpu.automl.regression.time_sequence_predictor import (
+        TimeSequencePredictor)
+    from analytics_zoo_tpu.automl.search import BayesRecipe
+
+    rs = np.random.RandomState(0)
+    n = 160
+    df = pd.DataFrame({
+        "datetime": pd.date_range("2020-01-01", periods=n, freq="h"),
+        "value": np.sin(np.arange(n) / 8.0) + 0.05 * rs.randn(n),
+    })
+    recipe = BayesRecipe(num_samples=3, n_startup=2)
+    recipe.training_iteration = 1
+    tsp = TimeSequencePredictor(future_seq_len=1)
+    pipeline = tsp.fit(df, metric="mse", recipe=recipe)
+    out = tsp.predict(df.iloc[-40:])
+    assert len(out) > 0
+
+
+# ---------------------------------------------------------------------------
+# MTNet + encoder-decoder Seq2Seq (VERDICT r2 #6, missing #2)
+# ---------------------------------------------------------------------------
+
+def _series_xy(n=200, past=12, d=3, seed=0):
+    rs = np.random.RandomState(seed)
+    base = np.sin(np.arange(n + past) / 6.0)
+    x = np.stack([np.stack([base[i:i + past]] * d, axis=-1)
+                  for i in range(n)]).astype(np.float32)
+    x += 0.02 * rs.randn(*x.shape).astype(np.float32)
+    y = base[past:past + n].astype(np.float32)[:, None]
+    return x, y
+
+
+def test_mtnet_block_shapes_and_grads(zoo_ctx):
+    import jax
+    import jax.numpy as jnp
+
+    from analytics_zoo_tpu.automl.model.mtnet import MTNetBlock
+
+    blk = MTNetBlock(output_dim=2, time_step=4, long_num=3, ar_window=2,
+                     cnn_height=2, cnn_hid_size=8, rnn_hid_sizes=[4, 8])
+    rng = jax.random.PRNGKey(0)
+    params = blk.build_params(rng, (5, 3, 4, 3), (5, 4, 3))
+    long = jnp.asarray(np.random.RandomState(0).randn(5, 3, 4, 3),
+                       jnp.float32)
+    short = jnp.asarray(np.random.RandomState(1).randn(5, 4, 3),
+                        jnp.float32)
+    out = blk.forward(params, long, short)
+    assert out.shape == (5, 2)
+
+    def loss(p):
+        return jnp.mean(blk.forward(p, long, short) ** 2)
+
+    grads = jax.grad(loss)(params)
+    flat = jax.tree_util.tree_leaves(grads)
+    assert all(np.all(np.isfinite(np.asarray(g))) for g in flat)
+    # every parameter group receives gradient (attention + AR + heads)
+    norms = {k: float(sum(np.abs(np.asarray(l)).sum()
+                          for l in jax.tree_util.tree_leaves(v)))
+             for k, v in grads.items()}
+    assert all(v > 0 for v in norms.values()), norms
+
+
+def test_mtnet_fit_eval_learns(zoo_ctx):
+    from analytics_zoo_tpu.automl.model.mtnet import MTNet
+
+    x, y = _series_xy(n=160, past=12, d=2)
+    m = MTNet()
+    cfg = dict(time_step=3, long_num=3, cnn_height=2, cnn_hid_size=8,
+               rnn_hid_sizes=[8], ar_window=2, lr=5e-3, batch_size=32,
+               epochs=12)
+    score = m.fit_eval(x, y, metric="mse", **cfg)
+    # sine next-step from a 12-step window: must beat predict-zero (~0.5)
+    assert score < 0.1, score
+    pred = m.predict(x)
+    assert pred.shape == (160, 1)
+
+
+def test_mtnet_save_restore_roundtrip(zoo_ctx, tmp_path):
+    from analytics_zoo_tpu.automl.model.mtnet import MTNet
+
+    x, y = _series_xy(n=64, past=8, d=2)
+    cfg = dict(time_step=2, long_num=3, cnn_height=1, cnn_hid_size=4,
+               rnn_hid_sizes=[4], ar_window=1, lr=1e-3, batch_size=16,
+               epochs=1)
+    m = MTNet()
+    m.fit_eval(x, y, metric="mse", **cfg)
+    p1 = m.predict(x)
+    path = str(tmp_path / "mtnet.npz")
+    m.save(path)
+
+    m2 = MTNet()
+    m2.restore(path, x.shape[1:], 1, cfg)
+    p2 = m2.predict(x)
+    np.testing.assert_allclose(p1, p2, rtol=1e-5, atol=1e-6)
+
+
+def test_seq2seq_forecaster_is_encoder_decoder(zoo_ctx):
+    from analytics_zoo_tpu.automl.model.time_sequence import (
+        Seq2SeqForecaster)
+
+    x, y = _series_xy(n=160, past=10, d=1)
+    # 3-step horizon targets: stack shifted copies
+    y3 = np.concatenate([np.roll(y, -k) for k in range(3)], axis=1)[:-3]
+    x = x[:-3]
+    m = Seq2SeqForecaster(future_seq_len=3)
+    score = m.fit_eval(x, y3, metric="mse", latent_dim=32, lr=5e-3,
+                       batch_size=32, epochs=8)
+    assert score < 0.15, score
+    # decoder params exist (true enc-dec, not a direct head)
+    params = m.model.estimator.params
+    flat = {k for k in str(params.keys())}
+    names = list(params.values())[0].keys()
+    assert {"enc", "dec", "proj_w"} <= set(names), names
+
+
+def test_mtnet_smoke_recipe_through_predictor(zoo_ctx):
+    import pandas as pd
+
+    from analytics_zoo_tpu.automl.pipeline.time_sequence import (
+        load_ts_pipeline)
+    from analytics_zoo_tpu.automl.regression.time_sequence_predictor import (
+        TimeSequencePredictor)
+    from analytics_zoo_tpu.automl.search import MTNetSmokeRecipe
+
+    rs = np.random.RandomState(0)
+    n = 200
+    df = pd.DataFrame({
+        "datetime": pd.date_range("2020-01-01", periods=n, freq="h"),
+        "value": np.sin(np.arange(n) / 8.0) + 0.05 * rs.randn(n),
+    })
+    tsp = TimeSequencePredictor(future_seq_len=1)
+    pipeline = tsp.fit(df, metric="mse", recipe=MTNetSmokeRecipe())
+    out = tsp.predict(df.iloc[-60:])
+    assert len(out) > 0
+
+    # pipeline save/load restores the MTNet variant
+    import tempfile
+    d = tempfile.mkdtemp()
+    pipeline.save(d)
+    pipe2 = load_ts_pipeline(d)
+    out2 = pipe2.predict(df.iloc[-60:])
+    pd.testing.assert_frame_equal(out, out2)
+
+
+def _picklable_quadratic(cfg):
+    """Module-level trainable so the PROCESS backend can pickle it."""
+    import os
+
+    return _quadratic_obj(cfg), {"pid": os.getpid()}
+
+
+def test_process_backend_engages_for_picklable_trainable():
+    """With a module-level trainable the process pool really runs the
+    trials in worker processes (not the thread fallback)."""
+    import os
+
+    from analytics_zoo_tpu.automl.search import SearchEngine
+
+    eng = SearchEngine(_quadratic_space(), num_samples=4, seed=0,
+                       max_parallel=2, backend="process")
+    eng.run(_picklable_quadratic)
+    assert len(eng.results) == 4
+    pids = {r.extra.get("pid") for r in eng.results}
+    assert pids and os.getpid() not in pids, pids
