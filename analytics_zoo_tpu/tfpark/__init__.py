@@ -9,6 +9,8 @@ converter.py) so the training hot loop is one XLA program.
 
 from analytics_zoo_tpu.tfpark.converter import (  # noqa: F401
     GraphProgram, UnsupportedLayerError, convert_keras_model)
+from analytics_zoo_tpu.tfpark.estimator import (  # noqa: F401
+    EstimatorSpec, ModeKeys, TFEstimator)
 from analytics_zoo_tpu.tfpark.gan import GANEstimator  # noqa: F401
 from analytics_zoo_tpu.tfpark.model import (  # noqa: F401
     FunctionModel, KerasModel, TFNet, TFOptimizer, TorchCriterion,
