@@ -1,0 +1,3 @@
+from analytics_zoo_tpu.caffe.loader import (  # noqa: F401
+    UnsupportedCaffeLayer, decode_caffemodel, load_caffe, load_caffe_parts,
+    parse_prototxt)

@@ -72,9 +72,13 @@ class Net:
 
     @staticmethod
     def load_caffe(def_path: str, model_path: str):
-        raise NotImplementedError(
-            "Caffe models are a legacy format; convert to ONNX "
-            "(caffe2onnx) and use Net.load_onnx")
+        """prototxt + caffemodel → trainable program (reference
+        Net.loadCaffe, api/Net.scala:169-189; importer
+        caffe/loader.py — the conv-net vocabulary; exotic layers raise
+        with caffe2onnx guidance)."""
+        from analytics_zoo_tpu.caffe import load_caffe as _load
+
+        return _load(def_path, model_path)
 
     # -- exporters (reference NetSaver, Net.scala:277-445) -----------------
     @staticmethod

@@ -340,8 +340,10 @@ class TestNetLoaders:
     def test_legacy_formats_guide_users(self):
         with pytest.raises(NotImplementedError, match="ONNX"):
             Net.load_bigdl("x")
-        with pytest.raises(NotImplementedError, match="ONNX"):
-            Net.load_caffe("x", "y")
+        # Caffe now has a real importer (caffe/loader.py); missing files
+        # surface as IO errors, not a decline
+        with pytest.raises(FileNotFoundError):
+            Net.load_caffe("/nonexistent.prototxt", "/nonexistent.caffemodel")
 
 
 class TestGraphNet:
