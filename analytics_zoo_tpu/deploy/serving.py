@@ -281,8 +281,22 @@ class RedisQueue:
         out = []
         for _, entries in resp or []:
             for eid, fields in entries:
-                blob = json.loads(fields["blob"])
-                out.append((blob["rid"], blob["record"]))
+                if "blob" in fields:
+                    # native client envelope (json)
+                    blob = json.loads(fields["blob"])
+                    out.append((blob["rid"], blob["record"]))
+                else:
+                    # reference-client wire shape: flat fields
+                    # {uri, image: b64(jpg bytes)} (client.py:102-110) —
+                    # lift into the worker's record schema (the b64 file
+                    # codec is exactly decode_image's "file" path)
+                    rec = dict(fields)
+                    rid = rec.get("uri") or eid
+                    if "image" in rec and not isinstance(rec["image"],
+                                                         dict):
+                        rec = {"uri": rid, "codec": "file",
+                               "image": rec["image"]}
+                    out.append((rid, rec))
                 self._r.xack(self.name, self.GROUP, eid)
         return out
 
