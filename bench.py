@@ -15,11 +15,16 @@ carries the supporting evidence the north star asks for:
   oracle ceiling: HR@10 0.86 vs oracle 0.975, i.e. the framework
   recovers ~88%% of the recoverable signal.
 - ncf_f32 / ncf_bf16: the mixed-precision delta (compute_dtype knob).
-- resnet50_imgs_per_sec_per_chip: BASELINE config #2 (bf16 train step;
-  batch 256 by on-chip sweep - 1559 imgs/s vs 305 at batch 32, the MXU
-  needs the batch to tile).
-- flash_attention_ms vs blockwise_ms: the Pallas kernel ON SILICON
-  against the pure-XLA blockwise fallback at L=2048.
+- resnet50_imgs_per_sec_per_chip (+ the K-fused variant): BASELINE
+  config #2 throughput (bf16 train step; batch 256 by on-chip sweep -
+  1559 imgs/s vs 305 at batch 32, the MXU needs the batch to tile).
+- resnet_accuracy: config #2's accuracy leg — cats-vs-dogs-shaped
+  convergence with a quoted ceiling.
+- wide_and_deep_samples_per_sec / nnframes: BASELINE configs #4 and #3,
+  so all five configs carry measurements.
+- attention_l{1024,2048,8192}: the hand-written Pallas kernel ON SILICON
+  vs the pure-XLA blockwise fallback vs the STOCK pallas tpu kernel
+  (adopt-or-beat).
 
 Baseline: the same jitted training step on the host CPU — the honest
 stand-in for "BigDL-on-CPU on this machine" given BigDL targets CPU and
@@ -208,12 +213,19 @@ def _movielens_like(n_users=6040, n_items=3706, latent=8, pos_per_user=20,
             heldout, scores)
 
 
-def bench_ncf_convergence(epochs=6, batch=2048, n_users=6040, n_items=3706,
-                          n_eval=2000, embed=32, mf_embed=32,
-                          hidden=(64, 32, 16), lr=1e-3, pos_per_user=50):
+def bench_ncf_convergence(epochs=16, batch=2048, n_users=6040, n_items=3706,
+                          n_eval=2000, embed=64, mf_embed=64,
+                          hidden=(128, 64, 32), lr=2e-3, pos_per_user=50,
+                          resample_negs_every=4):
     """Full framework path: negative sampling -> FeatureSet -> Estimator
     (prefetch, fused multi-step dispatch, donated buffers) -> HR@10
-    (held-out positive vs 99 negatives, the NCF paper's protocol)."""
+    (held-out positive vs 99 negatives, the NCF paper's protocol).
+
+    Recipe per the NCF paper + reference NeuralCFexample.scala:44-120:
+    4 negatives/positive RESAMPLED periodically (fresh negatives are the
+    paper's per-epoch sampling — reusing one fixed negative set caps
+    HR@10 well below the oracle), wide predictive factors (64), cosine
+    LR decay over the run."""
     from analytics_zoo_tpu import init_zoo_context
     from analytics_zoo_tpu.data.featureset import FeatureSet
     from analytics_zoo_tpu.models import NeuralCF
@@ -225,21 +237,30 @@ def bench_ncf_convergence(epochs=6, batch=2048, n_users=6040, n_items=3706,
     users, items, heldout, true_scores = _movielens_like(
         n_users, n_items, pos_per_user=pos_per_user)
 
-    tr_u, tr_i, tr_y = negative_sample(users, items, n_items,
-                                       neg_per_pos=4, seed=1)
     from analytics_zoo_tpu.train.optimizers import Adam
 
+    steps_per_epoch = (len(users) * 5) // batch
     ncf = NeuralCF(user_count=n_users, item_count=n_items, class_num=2,
                    user_embed=embed, item_embed=embed, hidden_layers=hidden,
                    mf_embed=mf_embed)
-    ncf.compile(optimizer=Adam(lr=lr),
+    ncf.compile(optimizer=Adam(lr=lr, schedule="cosine",
+                               total_steps=max(1, steps_per_epoch * epochs)),
                 loss="sparse_categorical_crossentropy",
                 metrics=["accuracy"])
-    fs = FeatureSet.from_ndarrays(
-        [tr_u[:, None].astype(np.int32), tr_i[:, None].astype(np.int32)],
-        tr_y.astype(np.int32))
     t0 = time.perf_counter()
-    ncf.fit(fs, batch_size=batch, nb_epoch=epochs, verbose=False)
+    done = 0
+    while done < epochs:
+        # fresh negatives every few epochs (paper: every epoch; chunked
+        # here so the fused-dispatch epochs stay long)
+        chunk = min(resample_negs_every, epochs - done)
+        tr_u, tr_i, tr_y = negative_sample(users, items, n_items,
+                                           neg_per_pos=4, seed=1 + done)
+        fs = FeatureSet.from_ndarrays(
+            [tr_u[:, None].astype(np.int32),
+             tr_i[:, None].astype(np.int32)], tr_y.astype(np.int32))
+        ncf.estimator.fit(fs, batch_size=batch,
+                          epochs=done + chunk, verbose=False)
+        done += chunk
     train_s = time.perf_counter() - t0
 
     # HR@10, the NCF paper's protocol: held-out positive vs 99 negatives
@@ -318,6 +339,194 @@ def bench_resnet50(device, batch=256, warmup=1, iters=4):
     return batch * iters / dt
 
 
+def bench_resnet50_fused(device, batch=256, k_steps=4, iters=3):
+    """ResNet-50 with K train steps fused into one dispatch (lax.scan
+    over a stacked superbatch) — removes the per-step launch latency the
+    plain bench pays (~2.5-8ms of ~160ms/step on the tunnel)."""
+    import jax
+    import jax.numpy as jnp
+
+    from analytics_zoo_tpu.models.image.imageclassification import resnet50
+    from analytics_zoo_tpu.nn import reset_name_scope
+    from analytics_zoo_tpu.nn.objectives import (
+        sparse_categorical_crossentropy_with_logits)
+    from analytics_zoo_tpu.train.optimizers import Adam
+
+    reset_name_scope()
+    model = resnet50(class_num=1000)
+    rs = np.random.RandomState(0)
+    x = rs.randn(k_steps, batch, 224, 224, 3).astype(np.float32)
+    y = rs.randint(0, 1000, (k_steps, batch)).astype(np.int32)
+
+    with jax.default_device(device):
+        params, state = model.init(jax.random.PRNGKey(0))
+        tx = Adam(lr=1e-3)
+        opt_state = tx.init(params)
+        step = build_step(model, tx,
+                          sparse_categorical_crossentropy_with_logits,
+                          compute_dtype=jnp.bfloat16)
+
+        def fused(params, state, opt_state, xk, yk):
+            def body(carry, bt):
+                p, s, o = carry
+                bx, by = bt
+                p, s, o, loss = step(p, s, o, [bx], by)
+                return (p, s, o), loss
+
+            (params, state, opt_state), losses = jax.lax.scan(
+                body, (params, state, opt_state), (xk, yk))
+            return params, state, opt_state, losses[-1]
+
+        fused = jax.jit(fused, donate_argnums=(0, 1, 2))
+        xd = jax.device_put(jnp.asarray(x), device)
+        yd = jax.device_put(jnp.asarray(y), device)
+        carry = (jax.device_put(params, device),
+                 jax.device_put(state, device),
+                 jax.device_put(opt_state, device))
+        dt = _time_steps(fused, carry, (xd, yd), 1, iters)
+    return batch * k_steps * iters / dt
+
+
+def bench_resnet_accuracy(device, n=2048, size=64, epochs=8, batch=256):
+    """Accuracy evidence for BASELINE config #2: train a ResNet on a
+    cats-vs-dogs-shaped binary set to convergence through the full
+    Estimator path.  The synthetic classes differ by a localized texture
+    statistic (fully separable ⇒ quoted ceiling 1.0); the number shows
+    the conv stack + BN + training loop actually learn, not just move
+    bytes."""
+    import jax
+
+    from analytics_zoo_tpu import init_zoo_context
+    from analytics_zoo_tpu.models.image.imageclassification import resnet50
+    from analytics_zoo_tpu.nn import reset_name_scope
+    from analytics_zoo_tpu.train.optimizers import Adam
+
+    init_zoo_context(compute_dtype="bfloat16", steps_per_execution=4)
+    reset_name_scope()
+    rs = np.random.RandomState(0)
+    y = rs.randint(0, 2, n).astype(np.int32)
+    x = rs.rand(n, size, size, 3).astype(np.float32) * 0.5
+    # class-1 images carry a high-frequency checker patch (texture cue)
+    checker = np.indices((16, 16)).sum(0) % 2
+    for i in range(n):
+        if y[i]:
+            cx, cy = rs.randint(0, size - 16, 2)
+            x[i, cy:cy + 16, cx:cx + 16, 0] += 0.5 * checker
+    split = int(0.9 * n)
+    model = resnet50(class_num=2, input_shape=(size, size, 3))
+    model.compile(optimizer=Adam(lr=1e-3),
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    t0 = time.perf_counter()
+    model.fit(x[:split], y[:split], batch_size=batch, nb_epoch=epochs,
+              verbose=False)
+    dt = time.perf_counter() - t0
+    res = model.evaluate(x[split:], y[split:], batch_size=batch)
+    return {"val_accuracy": round(float(res["accuracy"]), 4),
+            "ceiling": 1.0, "epochs": epochs,
+            "train_imgs_per_sec": round(split * epochs / dt, 1)}
+
+
+# ---------------------------------------------------------------------------
+# WideAndDeep (BASELINE config #4) + NNFrames pipeline (config #3)
+# ---------------------------------------------------------------------------
+
+def bench_wide_and_deep(device, batch=8192, k_steps=32, iters=3,
+                        compute_dtype="bfloat16"):
+    """WideAndDeep training throughput, census-shaped features
+    (reference WideAndDeepExample.scala; BASELINE config #4): 2 wide
+    cross columns, 2 embedding columns, 11 continuous — fused K-step
+    dispatch like the NCF headline."""
+    import jax
+    import jax.numpy as jnp
+
+    from analytics_zoo_tpu.models import WideAndDeep
+    from analytics_zoo_tpu.nn import reset_name_scope
+    from analytics_zoo_tpu.nn.objectives import (
+        sparse_categorical_crossentropy)
+    from analytics_zoo_tpu.train.optimizers import Adam
+
+    reset_name_scope()
+    wnd = WideAndDeep(class_num=2, wide_base_dims=(1000, 1000),
+                      embed_in_dims=(5000, 1000), embed_out_dims=(64, 64),
+                      continuous_cols=11, hidden_layers=(100, 75, 50, 25))
+    model = wnd.model
+    rs = np.random.RandomState(0)
+    wide = rs.randint(0, 1000, (k_steps, batch, 2)).astype(np.int32)
+    wide[:, :, 1] += 1000
+    emb = np.stack([rs.randint(0, 5000, (k_steps, batch)),
+                    rs.randint(0, 1000, (k_steps, batch))],
+                   axis=-1).astype(np.int32)
+    cont = rs.randn(k_steps, batch, 11).astype(np.float32)
+    yk = rs.randint(0, 2, (k_steps, batch)).astype(np.int32)
+
+    with jax.default_device(device):
+        params, state = model.init(jax.random.PRNGKey(0))
+        tx = Adam(lr=1e-3)
+        opt_state = tx.init(params)
+        cd = jnp.bfloat16 if compute_dtype == "bfloat16" else None
+        step = build_step(model, tx, sparse_categorical_crossentropy,
+                          compute_dtype=cd)
+
+        def fused(params, state, opt_state, xs_stack, y_stack):
+            def body(carry, bt):
+                p, s, o = carry
+                (bw, be, bc), by = bt
+                p, s, o, loss = step(p, s, o, [bw, be, bc], by)
+                return (p, s, o), loss
+
+            (params, state, opt_state), losses = jax.lax.scan(
+                body, (params, state, opt_state),
+                ((xs_stack[0], xs_stack[1], xs_stack[2]), y_stack))
+            return params, state, opt_state, losses[-1]
+
+        fused = jax.jit(fused, donate_argnums=(0, 1, 2))
+        xs = [jax.device_put(jnp.asarray(a), device)
+              for a in (wide, emb, cont)]
+        yd = jax.device_put(jnp.asarray(yk), device)
+        carry = (jax.device_put(params, device),
+                 jax.device_put(state, device),
+                 jax.device_put(opt_state, device))
+        dt = _time_steps(fused, carry, (xs, yd), 1, iters)
+    return batch * k_steps * iters / dt
+
+
+def bench_nnframes(n=200_000, epochs=2, batch=8192):
+    """NNFrames end-to-end rows/sec (BASELINE config #3): DataFrame →
+    NNEstimator.fit → NNModel.transform, including the pandas column
+    extraction — the whole Spark-ML-shaped pipeline, not just the jitted
+    step (reference NNEstimator.scala:414-491)."""
+    import pandas as pd
+
+    from analytics_zoo_tpu import init_zoo_context
+    from analytics_zoo_tpu.nn import reset_name_scope
+    from analytics_zoo_tpu.nn.layers.core import Dense
+    from analytics_zoo_tpu.nn.topology import Sequential
+    from analytics_zoo_tpu.nnframes import NNEstimator
+
+    init_zoo_context(steps_per_execution=8)
+    reset_name_scope()
+    rs = np.random.RandomState(0)
+    x = rs.randn(n, 16).astype(np.float32)
+    yv = (x @ rs.randn(16)).astype(np.float32)
+    df = pd.DataFrame({"features": list(x), "label": yv})
+
+    m = Sequential()
+    m.add(Dense(64, activation="relu", input_shape=(16,)))
+    m.add(Dense(1))
+    est = (NNEstimator(m, criterion="mse")
+           .setBatchSize(batch).setMaxEpoch(epochs).setLearningRate(1e-3))
+    t0 = time.perf_counter()
+    nn_model = est.fit(df)
+    fit_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    out = nn_model.transform(df)
+    tr_s = time.perf_counter() - t0
+    assert len(out) == n
+    return {"fit_rows_per_sec": round(n * epochs / fit_s, 1),
+            "transform_rows_per_sec": round(n / tr_s, 1)}
+
+
 # ---------------------------------------------------------------------------
 # Attention: Pallas flash kernel on silicon vs XLA blockwise fallback
 # ---------------------------------------------------------------------------
@@ -343,7 +552,11 @@ def _timed_rounds(cases, rounds=3, iters_per_round=8):
     return {k: round(v, 3) for k, v in best.items()}
 
 
-def bench_attention(device, B=4, H=8, L=2048, D=64, iters=30):
+def bench_attention(device, B=4, H=8, L=2048, D=64, iters=30,
+                    include_stock=True):
+    """Hand-written Pallas flash kernel vs the XLA blockwise fallback vs
+    the STOCK jax.experimental.pallas.ops.tpu flash kernel — the
+    adopt-or-beat comparison (VERDICT r2 weak #5)."""
     import jax
     import jax.numpy as jnp
 
@@ -357,10 +570,21 @@ def bench_attention(device, B=4, H=8, L=2048, D=64, iters=30):
 
     out = {}
     cases = {}
-    for name, fn in (("flash", lambda q, k, v: flash_attention(
-            q, k, v, causal=True)),
-                     ("blockwise", lambda q, k, v: blockwise_attention(
-                         q, k, v, causal=True))):
+    pairs = [("flash", lambda q, k, v: flash_attention(
+                  q, k, v, causal=True)),
+             ("blockwise", lambda q, k, v: blockwise_attention(
+                 q, k, v, causal=True))]
+    if include_stock:
+        try:
+            from jax.experimental.pallas.ops.tpu.flash_attention import (
+                flash_attention as stock_flash)
+            sm = 1.0 / float(np.sqrt(D))
+            pairs.append(("stock_pallas",
+                          lambda q, k, v: stock_flash(q, k, v, causal=True,
+                                                      sm_scale=sm)))
+        except Exception as e:
+            out["stock_pallas_error"] = type(e).__name__
+    for name, fn in pairs:
         try:
             f = jax.jit(fn)
             _sync(f(q, k, v))                       # compile
@@ -378,6 +602,9 @@ def bench_attention(device, B=4, H=8, L=2048, D=64, iters=30):
     if "flash_fwdbwd_ms" in out and "blockwise_fwdbwd_ms" in out:
         out["flash_bwd_speedup"] = round(
             out["blockwise_fwdbwd_ms"] / out["flash_fwdbwd_ms"], 2)
+    if "flash_ms" in out and "stock_pallas_ms" in out:
+        out["flash_vs_stock"] = round(
+            out["stock_pallas_ms"] / out["flash_ms"], 2)
     return out
 
 
@@ -519,15 +746,26 @@ def _device_preflight(timeout_s: int = 150) -> bool:
         return False
 
 
-def _preflight_with_retry(retry_sleep_s: int = 20) -> bool:
-    # first attempt is long enough for a cold backend init (~90-180s on
-    # tunnelled slices); the retry catches a transient blip
-    for i, timeout_s in enumerate((150, 90)):
+def _preflight_with_retry(budget_frac: float = 0.8,
+                          retry_sleep_s: int = 15) -> bool:
+    """Keep retrying the transport for ~``budget_frac`` of the bench
+    budget before giving up.  An outage at bench time zeroes the round's
+    TPU evidence (it did in r02 — BENCH_r02.json is a cpu_fallback), so
+    nearly the whole window goes to reconnection attempts: a late real
+    number beats an early fallback."""
+    deadline = _T0 + budget_frac * _BUDGET_S
+    attempt = 0
+    while True:
+        remaining = deadline - time.time()
+        if remaining <= 5:
+            return False
+        # first attempt long enough for a cold backend init (~90-180s on
+        # tunnelled slices); later probes shorter so blips get many shots
+        timeout_s = min(150 if attempt == 0 else 60, remaining)
         if _device_preflight(timeout_s):
             return True
-        if i == 0:
-            time.sleep(retry_sleep_s)
-    return False
+        attempt += 1
+        time.sleep(min(retry_sleep_s, max(0, deadline - time.time())))
 
 
 def main():
@@ -622,14 +860,17 @@ def main():
     t0 = time.time()
     if _remaining() > 150:
         try:
-            extra["ncf_convergence"] = bench_ncf_convergence()
+            # scale the epoch budget to the time actually left
+            ep = 16 if _remaining() > 280 else 8
+            extra["ncf_convergence"] = bench_ncf_convergence(epochs=ep)
         except Exception as e:
             extra["ncf_convergence_error"] = f"{type(e).__name__}: {e}"
     else:
         extra["ncf_convergence_skipped"] = "time budget"
 
     _mark("ncf_convergence", t0)
-    # BASELINE config #2: ResNet-50 imgs/sec (bf16 train step)
+    # BASELINE config #2: ResNet-50 imgs/sec (bf16 train step; the
+    # K-fused variant amortizes launch latency — MFU evidence)
     t0 = time.time()
     if _remaining() > 120:
         try:
@@ -639,17 +880,63 @@ def main():
             extra["resnet50_error"] = f"{type(e).__name__}: {e}"
     else:
         extra["resnet50_skipped"] = "time budget"
+    if on_tpu and _remaining() > 100:
+        try:
+            extra["resnet50_fused_k4_imgs_per_sec"] = round(
+                bench_resnet50_fused(accel), 2)
+        except Exception as e:
+            extra["resnet50_fused_error"] = f"{type(e).__name__}: {e}"
 
     _mark("resnet50", t0)
-    # Pallas flash attention on silicon vs blockwise fallback
+    # config #2 accuracy leg: cats-vs-dogs-shaped convergence
+    t0 = time.time()
+    if _remaining() > 150:
+        try:
+            extra["resnet_accuracy"] = bench_resnet_accuracy(accel)
+        except Exception as e:
+            extra["resnet_accuracy_error"] = f"{type(e).__name__}: {e}"
+    else:
+        extra["resnet_accuracy_skipped"] = "time budget"
+
+    _mark("resnet_accuracy", t0)
+    # BASELINE config #4: WideAndDeep throughput
+    t0 = time.time()
+    if _remaining() > 90:
+        try:
+            extra["wide_and_deep_samples_per_sec"] = round(
+                bench_wide_and_deep(accel), 1)
+        except Exception as e:
+            extra["wide_and_deep_error"] = f"{type(e).__name__}: {e}"
+    else:
+        extra["wide_and_deep_skipped"] = "time budget"
+
+    _mark("wide_and_deep", t0)
+    # BASELINE config #3: NNFrames DataFrame pipeline rows/sec
+    t0 = time.time()
+    if _remaining() > 90:
+        try:
+            extra["nnframes"] = bench_nnframes()
+        except Exception as e:
+            extra["nnframes_error"] = f"{type(e).__name__}: {e}"
+    else:
+        extra["nnframes_skipped"] = "time budget"
+
+    _mark("nnframes", t0)
+    # Pallas flash attention on silicon: hand-written vs blockwise vs the
+    # stock pallas kernel, across context lengths (VERDICT r2 #10)
     t0 = time.time()
     if _remaining() > 45:
         try:
             extra["attention_l2048"] = bench_attention(accel)
         except Exception as e:
             extra["attention_error"] = f"{type(e).__name__}: {e}"
-    else:
-        extra["attention_skipped"] = "time budget"
+    for L in (1024, 8192):
+        if _remaining() > 60:
+            try:
+                extra[f"attention_l{L}"] = bench_attention(
+                    accel, L=L, iters=12)
+            except Exception as e:
+                extra[f"attention_l{L}_error"] = f"{type(e).__name__}: {e}"
 
     _mark("attention", t0)
     # int8 MXU matmul vs f32/bf16 (the ~2x int8 inference claim) — runs

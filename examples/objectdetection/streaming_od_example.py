@@ -1,0 +1,128 @@
+"""Streaming object detection: an SSD detector behind the Cluster
+Serving worker — images flow through a queue, detections flow back
+(reference zoo/.../examples/streaming/objectdetection/
+StreamingObjectDetection.scala: a Spark streaming query feeding
+InferenceModel; here the stream is the serving queue and the "query"
+is the worker loop on one chip).
+
+One process (memory queue):
+    python streaming_od_example.py
+
+Cross-process (file queue; start the worker first):
+    python streaming_od_example.py --queue-dir /tmp/odq --role worker
+    python streaming_od_example.py --queue-dir /tmp/odq --role client
+"""
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+from analytics_zoo_tpu import init_zoo_context
+from analytics_zoo_tpu.deploy.inference import InferenceModel
+from analytics_zoo_tpu.deploy.serving import (ClusterServing, FileQueue,
+                                              InputQueue, MemoryQueue,
+                                              OutputQueue, ServingConfig)
+from analytics_zoo_tpu.models.objectdetection import ObjectDetector
+
+SMALL_CONFIG = {
+    "image_size": 64,
+    "feature_sizes": (8, 4, 2, 1, 1, 1),
+    "min_sizes": (6, 13, 26, 38, 51, 58),
+    "max_sizes": (13, 26, 38, 51, 58, 70),
+    "aspect_ratios": ((2,), (2, 3), (2, 3), (2, 3), (2,), (2,)),
+}
+
+
+def synthetic_frames(n=16, size=64, seed=0):
+    rs = np.random.RandomState(seed)
+    imgs = rs.rand(n, size, size, 3).astype(np.float32) * 0.2
+    for i in range(n):
+        w, h = rs.randint(16, 40, 2)
+        x, y = rs.randint(0, size - w), rs.randint(0, size - h)
+        imgs[i, y:y + h, x:x + w] = 1.0
+    return imgs
+
+
+def trained_detector(epochs=3):
+    rs = np.random.RandomState(0)
+    imgs = synthetic_frames(32)
+    boxes = np.zeros((32, 1, 4), np.float32)
+    labels = np.ones((32, 1), np.int64)
+    for i in range(32):
+        ys, xs = np.where(imgs[i, :, :, 0] > 0.9)
+        if len(xs):
+            boxes[i, 0] = (xs.min() / 64, ys.min() / 64,
+                           (xs.max() + 1) / 64, (ys.max() + 1) / 64)
+    det = ObjectDetector(class_num=2, config=SMALL_CONFIG)
+    det.compile(optimizer="adam", loss=det.loss())
+    det.fit_detection(imgs, boxes, labels, batch_size=8, nb_epoch=epochs,
+                      verbose=False)
+    return det
+
+
+def detection_forward(det):
+    """Serving forward: padded image batch → JSON-safe detections
+    (boxes/scores/labels per frame) via the detector's NMS path."""
+    def forward(xs):
+        out = []
+        for b, s, l in det.detect(np.asarray(xs[0]), score_threshold=0.2):
+            out.append({"boxes": np.asarray(b).tolist(),
+                        "scores": np.asarray(s).tolist(),
+                        "labels": np.asarray(l).tolist()})
+        return np.asarray([json.dumps(o) for o in out], dtype=object)
+    return forward
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--role", choices=["both", "worker", "client"],
+                    default="both")
+    ap.add_argument("--queue-dir", default=None,
+                    help="FileQueue dir for cross-process streaming")
+    ap.add_argument("--frames", type=int, default=8)
+    ap.add_argument("--epochs", type=int, default=3)
+    args = ap.parse_args()
+
+    init_zoo_context()
+    queue = (FileQueue(args.queue_dir) if args.queue_dir
+             else MemoryQueue())
+
+    worker = None
+    if args.role in ("both", "worker"):
+        det = trained_detector(args.epochs)
+        infer = InferenceModel(detection_forward(det),
+                               batch_buckets=(1, 4, 8))
+        worker = ClusterServing(infer, queue,
+                                ServingConfig(batch_size=8,
+                                              poll_timeout_s=0.05))
+        worker.start()
+        print("worker: detector online, polling the stream")
+        if args.role == "worker":
+            try:
+                while True:
+                    time.sleep(1)
+            except KeyboardInterrupt:
+                worker.stop()
+                return
+
+    inq, outq = InputQueue(queue), OutputQueue(queue)
+    frames = synthetic_frames(args.frames, seed=7)
+    t0 = time.time()
+    for i, frame in enumerate(frames):
+        inq.enqueue_image(f"frame{i:04d}", image=frame)
+    for i in range(args.frames):
+        det_json = outq.query(f"frame{i:04d}", timeout=30.0)
+        dets = json.loads(det_json) if isinstance(det_json, str) else det_json
+        print(f"frame{i:04d}: {len(dets['scores'])} detections "
+              f"{['%.2f' % s for s in dets['scores'][:3]]}")
+    dt = time.time() - t0
+    print(f"streamed {args.frames} frames in {dt:.2f}s "
+          f"({args.frames / dt:.1f} fps end-to-end)")
+    if worker is not None:
+        worker.stop()
+
+
+if __name__ == "__main__":
+    main()
