@@ -81,8 +81,20 @@ class NeuralCF(Recommender):
     def __init__(self, user_count: int, item_count: int, class_num: int = 5,
                  user_embed: int = 20, item_embed: int = 20,
                  hidden_layers: Sequence[int] = (40, 20, 10),
-                 include_mf: bool = True, mf_embed: int = 20):
+                 include_mf: bool = True, mf_embed: int = 20,
+                 dropout: float = 0.0):
         super().__init__()
+        if class_num < 2:
+            # softmax over 1 class is constant 1.0 — the model would
+            # train to nothing, silently; binary tasks use class_num=2
+            raise ValueError(
+                f"class_num must be >= 2, got {class_num} (the head is "
+                "a softmax; use class_num=2 with int {0,1} labels for "
+                "binary ratings)")
+        if not 0.0 <= dropout < 1.0:
+            # dropout=1.0 would zero the whole MLP tower every training
+            # step — silent degradation, like class_num=1 above
+            raise ValueError(f"dropout must be in [0, 1), got {dropout}")
         self.user_count = user_count
         self.item_count = item_count
         self.class_num = class_num
@@ -91,6 +103,9 @@ class NeuralCF(Recommender):
         self.hidden_layers = tuple(hidden_layers)
         self.include_mf = include_mf
         self.mf_embed = mf_embed
+        # regularization knob beyond the reference (its NeuralCF has no
+        # dropout); applied between MLP tower layers at training time
+        self.dropout = dropout
         self.build()
 
     def config(self):
@@ -98,7 +113,8 @@ class NeuralCF(Recommender):
                     class_num=self.class_num, user_embed=self.user_embed,
                     item_embed=self.item_embed,
                     hidden_layers=list(self.hidden_layers),
-                    include_mf=self.include_mf, mf_embed=self.mf_embed)
+                    include_mf=self.include_mf, mf_embed=self.mf_embed,
+                    dropout=self.dropout)
 
     def build(self):
         user = Input(shape=(1,), dtype=jnp.int32, name="user")
@@ -113,6 +129,8 @@ class NeuralCF(Recommender):
         h = merge([mlp_u, mlp_i], mode="concat")
         for k, width in enumerate(self.hidden_layers):
             h = Dense(width, activation="relu", name=f"mlp_dense_{k}")(h)
+            if self.dropout > 0:
+                h = Dropout(self.dropout, name=f"mlp_drop_{k}")(h)
 
         if self.include_mf:
             mf_u = Flatten()(Embedding(self.user_count + 1, self.mf_embed,
@@ -147,6 +165,10 @@ class WideAndDeep(Recommender):
                  continuous_cols: int = 0,
                  hidden_layers: Sequence[int] = (40, 20, 10)):
         super().__init__()
+        if class_num < 2:
+            raise ValueError(
+                f"class_num must be >= 2, got {class_num} (softmax head; "
+                "use class_num=2 for binary targets)")
         self.class_num = class_num
         self.model_type = model_type
         self.wide_base_dims = tuple(wide_base_dims)

@@ -142,3 +142,32 @@ def test_negative_sampling():
     assert len(u) == 15  # 5 pos + 10 neg
     assert y.sum() == 5
     assert set(np.unique(u)) <= {1, 2, 3}
+
+
+def test_class_num_one_rejected():
+    """softmax over one class trains to nothing — reject loudly."""
+    from analytics_zoo_tpu.models import NeuralCF, WideAndDeep
+
+    with pytest.raises(ValueError, match="class_num"):
+        NeuralCF(user_count=5, item_count=5, class_num=1)
+    with pytest.raises(ValueError, match="class_num"):
+        WideAndDeep(class_num=1, wide_base_dims=(4,))
+
+
+def test_ncf_dropout_trains(zoo_ctx):
+    """dropout knob (beyond the reference): trains and predicts
+    deterministically at inference."""
+    from analytics_zoo_tpu.models import NeuralCF
+    from analytics_zoo_tpu.train.optimizers import Adam
+
+    u, i, y = _synthetic_ml(n=512)
+    ncf = NeuralCF(user_count=50, item_count=40, class_num=5,
+                   user_embed=8, item_embed=8, hidden_layers=(16, 8),
+                   mf_embed=8, dropout=0.3)
+    ncf.compile(optimizer=Adam(lr=3e-3),
+                loss="sparse_categorical_crossentropy")
+    hist = ncf.fit([u, i], y, batch_size=128, nb_epoch=6, verbose=False)
+    assert hist[-1]["loss"] < hist[0]["loss"]
+    p1 = ncf.predict([u[:32], i[:32]])
+    p2 = ncf.predict([u[:32], i[:32]])
+    np.testing.assert_array_equal(p1, p2)   # dropout off at inference
