@@ -621,6 +621,10 @@ class Estimator:
                 if end_trigger is not None and end_trigger(tstate):
                     break
             except (KeyboardInterrupt,):
+                # release the prefetch producer (its sentinel delivery
+                # waits for close() on abandonment)
+                if batches is not None and hasattr(batches, "close"):
+                    batches.close()
                 raise
             except Exception as e:  # failure-retry (Topology.scala:1179-1261)
                 if batches is not None and hasattr(batches, "close"):

@@ -34,26 +34,34 @@ class PrefetchIterator:
         self._err: Optional[BaseException] = None
         self._stop = threading.Event()
 
+        def put_retry(obj) -> bool:
+            """Deliver unless the consumer called close(); never drop."""
+            while not self._stop.is_set():
+                try:
+                    self._q.put(obj, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
         def run():
             try:
                 for item in it:
                     if transform is not None:
                         item = transform(item)
-                    while not self._stop.is_set():
-                        try:
-                            self._q.put(item, timeout=0.1)
-                            break
-                        except queue.Full:
-                            continue
-                    if self._stop.is_set():
+                    if not put_retry(item):
                         return
             except BaseException as e:  # propagate to consumer
                 self._err = e
             finally:
-                try:
-                    self._q.put(_SENTINEL, timeout=10)
-                except queue.Full:
-                    pass
+                # The sentinel must NEVER be dropped: with a short epoch
+                # the whole dataset fits in the queue while the consumer
+                # sits in its first XLA compile (minutes for big models),
+                # and a dropped sentinel leaves the consumer blocked on
+                # get() forever once it drains the queue.  Consumers must
+                # close() on early exit (the Estimator does) so this
+                # retry terminates on abandonment.
+                put_retry(_SENTINEL)
 
         self._thread = threading.Thread(target=run, daemon=True)
         self._thread.start()
@@ -62,7 +70,23 @@ class PrefetchIterator:
         return self
 
     def __next__(self) -> Any:
-        item = self._q.get()
+        # poll rather than block indefinitely: if the producer thread is
+        # gone without its sentinel having been consumed (belt to the
+        # suspenders above), surface its error / end-of-iteration instead
+        # of hanging the training loop
+        while True:
+            try:
+                item = self._q.get(timeout=1.0)
+                break
+            except queue.Empty:
+                if not self._thread.is_alive():
+                    try:
+                        item = self._q.get_nowait()
+                        break
+                    except queue.Empty:
+                        if self._err is not None:
+                            raise self._err
+                        raise StopIteration from None
         if item is _SENTINEL:
             self._thread.join()
             if self._err is not None:

@@ -45,7 +45,7 @@ def synthetic_frames(n=16, size=64, seed=0):
     return imgs
 
 
-def trained_detector(epochs=3):
+def trained_detector(epochs=3, width_mult=1.0):
     rs = np.random.RandomState(0)
     imgs = synthetic_frames(32)
     boxes = np.zeros((32, 1, 4), np.float32)
@@ -55,7 +55,8 @@ def trained_detector(epochs=3):
         if len(xs):
             boxes[i, 0] = (xs.min() / 64, ys.min() / 64,
                            (xs.max() + 1) / 64, (ys.max() + 1) / 64)
-    det = ObjectDetector(class_num=2, config=SMALL_CONFIG)
+    det = ObjectDetector(class_num=2, config=SMALL_CONFIG,
+                         width_mult=width_mult)
     det.compile(optimizer="adam", loss=det.loss())
     det.fit_detection(imgs, boxes, labels, batch_size=8, nb_epoch=epochs,
                       verbose=False)
@@ -83,6 +84,8 @@ def main():
                     help="FileQueue dir for cross-process streaming")
     ap.add_argument("--frames", type=int, default=8)
     ap.add_argument("--epochs", type=int, default=3)
+    ap.add_argument("--width-mult", type=float, default=1.0,
+                    help="SSD trunk width (0.125 for quick CPU smoke)")
     args = ap.parse_args()
 
     init_zoo_context()
@@ -91,7 +94,7 @@ def main():
 
     worker = None
     if args.role in ("both", "worker"):
-        det = trained_detector(args.epochs)
+        det = trained_detector(args.epochs, args.width_mult)
         infer = InferenceModel(detection_forward(det),
                                batch_buckets=(1, 4, 8))
         worker = ClusterServing(infer, queue,

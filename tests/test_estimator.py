@@ -213,3 +213,37 @@ def test_grad_clip_applies_to_accumulated_gradient(zoo_ctx):
     norms = update_norms(est, [[1000.0, 0.0], [0.0, 0.0]])
     assert norms[0] == pytest.approx(0.0, abs=1e-9)   # mid-accumulation
     assert norms[1] == pytest.approx(lr, rel=1e-5)    # clip(avg), not avg(clip)
+
+
+@pytest.mark.slow
+def test_prefetch_sentinel_survives_slow_consumer():
+    """r3 regression: with a short epoch the whole dataset fits in the
+    prefetch queue while the consumer sits in a long first compile
+    (minutes); the end-of-iteration sentinel must wait for the consumer,
+    not be dropped (the old 10s give-up hung training forever).  The
+    11s sleep deliberately exceeds that old drop window with the queue
+    FULL and the producer already exhausted."""
+    import time
+
+    from analytics_zoo_tpu.train.prefetch import prefetch
+
+    it = prefetch(iter(range(3)), depth=3)
+    time.sleep(11.0)         # producer exhausted; queue full; sentinel
+    got = list(it)           # pending the whole time — must still arrive
+    assert got == [0, 1, 2]
+
+
+def test_prefetch_propagates_producer_error():
+    from analytics_zoo_tpu.train.prefetch import prefetch
+
+    def boom(x):
+        if x == 2:
+            raise RuntimeError("producer boom")
+        return x
+
+    it = prefetch(iter(range(4)), transform=boom, depth=1)
+    out = []
+    with pytest.raises(RuntimeError, match="producer boom"):
+        for x in it:
+            out.append(x)
+    assert out == [0, 1]
