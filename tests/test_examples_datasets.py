@@ -160,3 +160,27 @@ class TestExamplesRun:
         out = _run_example("parallelism/ring_attention_example.py",
                            "--devices", "4", "--length", "512")
         assert "long-context attention sharded" in out
+
+
+@pytest.mark.examples
+class TestExamplesRunRound3:
+    def test_streaming_od_example(self):
+        out = _run_example("objectdetection/streaming_od_example.py",
+                           "--frames", "2", "--epochs", "1",
+                           "--width-mult", "0.125", timeout=600)
+        assert "fps end-to-end" in out
+
+    def test_imagenet_training_example(self):
+        out = _run_example(
+            "imageclassification/imagenet_training_example.py",
+            "--model", "resnet", "--epochs", "2",
+            "--epochs-before-resume", "1", "--n", "96", "--classes", "4",
+            "--batch", "32", "--image-size", "32", timeout=600)
+        assert "resumed at step" in out
+        assert "final:" in out
+
+    def test_vae_example(self):
+        out = _run_example("vae/vae_example.py", "--epochs", "4",
+                           "--n", "512", timeout=600)
+        assert "reconstruction mse" in out
+        assert "generated 8 samples" in out
