@@ -812,9 +812,13 @@ def main():
                     "from bench import bench_ncf;"
                     "print('CPUTPUT', bench_ncf(jax.devices('cpu')[0],"
                     " warmup=1, iters=2, k_steps=8))")
+            # the preflight may have spent ~80% of the budget retrying;
+            # the fallback must fit in what remains or the driver's
+            # window closes with no JSON line at all
             proc = subprocess.run([sys.executable, "-c", code],
                                   capture_output=True, text=True,
-                                  timeout=240,
+                                  timeout=max(30, min(240,
+                                                      _remaining() - 15)),
                                   cwd=os.path.dirname(
                                       os.path.abspath(__file__)))
             for line in proc.stdout.splitlines():
