@@ -184,3 +184,10 @@ class TestExamplesRunRound3:
                            "--n", "512", timeout=600)
         assert "reconstruction mse" in out
         assert "generated 8 samples" in out
+
+    def test_image_augmentation_example(self):
+        out = _run_example(
+            "imageclassification/image_augmentation_example.py",
+            "--epochs", "2", "--n", "64", timeout=600)
+        assert "augmented batch:" in out
+        assert "augmentation delta:" in out
