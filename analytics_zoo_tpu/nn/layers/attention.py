@@ -212,6 +212,57 @@ class TransformerBlock(StatelessLayer):
         return x
 
 
+def _stack_block_params(block, keys, hshape):
+    """Build one params pytree per key and stack on a leading dim — the
+    layout `lax.scan` consumes and the PipelineStrategy shards."""
+    per_block = [block.build_params(k, hshape) for k in keys]
+    return jax.tree_util.tree_map(lambda *ps: jnp.stack(ps, axis=0),
+                                  *per_block)
+
+
+def _run_block_stack(block, n_block, blocks_params, x, training, rng,
+                     mask=None):
+    """Run a stacked homogeneous block pytree: the GPipe schedule under
+    an active pipeline regime, otherwise one `lax.scan` (per-block rng
+    threading for dropout).  Shared by TransformerLayer and BERT so the
+    two stacked paths cannot diverge."""
+    pipe = _current_pipeline()
+    if pipe is not None:
+        from analytics_zoo_tpu.parallel.pipeline import pipeline_apply
+
+        if mask is not None:
+            raise ValueError(
+                "pipeline parallelism supports single-activation stages "
+                "only — an attention mask cannot ride the ppermute ring; "
+                "drop the mask input or use sharding='dp'/'tp'")
+
+        def stage(p, h):
+            return block.forward(p, h, training=False, rng=None)
+
+        return pipeline_apply(stage, blocks_params, x, pipe.mesh,
+                              pipe.axis, pipe.n_microbatches,
+                              pipe.remat, batch_axis=pipe.batch_axis)
+
+    def apply(p, h, r):
+        args = (h,) if mask is None else (h, mask)
+        return block.forward(p, *args, training=training, rng=r)
+
+    if rng is not None:
+        rngs = jax.random.split(rng, n_block)
+
+        def body(h, pr):
+            p, r = pr
+            return apply(p, h, r), None
+
+        x, _ = jax.lax.scan(body, x, (blocks_params, rngs))
+    else:
+        def body(h, p):
+            return apply(p, h, None), None
+
+        x, _ = jax.lax.scan(body, x, blocks_params)
+    return x
+
+
 class TransformerLayer(StatelessLayer):
     """GPT-style decoder stack over token ids
     (reference api/keras/layers/TransformerLayer.scala:56).
@@ -270,42 +321,12 @@ class TransformerLayer(StatelessLayer):
         }
         hshape = tuple(ids_shape) + (d,)
         if self.stacked:
-            per_block = [self.block.build_params(ks[2 + i], hshape)
-                         for i in range(self.n_block)]
-            params["blocks"] = jax.tree_util.tree_map(
-                lambda *ps: jnp.stack(ps, axis=0), *per_block)
+            params["blocks"] = _stack_block_params(
+                self.block, ks[2:2 + self.n_block], hshape)
         else:
             for i, blk in enumerate(self.blocks):
                 params[f"block{i}"] = blk.build_params(ks[2 + i], hshape)
         return params
-
-    def _run_stacked(self, blocks_params, x, training, rng):
-        pipe = _current_pipeline()
-        if pipe is not None:
-            from analytics_zoo_tpu.parallel.pipeline import pipeline_apply
-
-            def stage(p, h):
-                return self.block.forward(p, h, training=False, rng=None)
-
-            return pipeline_apply(stage, blocks_params, x, pipe.mesh,
-                                  pipe.axis, pipe.n_microbatches,
-                                  pipe.remat, batch_axis=pipe.batch_axis)
-        if rng is not None:
-            rngs = jax.random.split(rng, self.n_block)
-
-            def body(h, pr):
-                p, r = pr
-                return self.block.forward(p, h, training=training,
-                                          rng=r), None
-
-            x, _ = jax.lax.scan(body, x, (blocks_params, rngs))
-        else:
-            def body(h, p):
-                return self.block.forward(p, h, training=training,
-                                          rng=None), None
-
-            x, _ = jax.lax.scan(body, x, blocks_params)
-        return x
 
     def forward(self, params, ids, *rest, training=False, rng=None):
         pos_ids = rest[0] if rest else None
@@ -319,7 +340,8 @@ class TransformerLayer(StatelessLayer):
         if self.stacked:
             r0, rblocks = split_rng(rng, 2)
             x = _dropout(r0, x, self.embedding_drop, training)
-            return self._run_stacked(params["blocks"], x, training, rblocks)
+            return _run_block_stack(self.block, self.n_block,
+                                    params["blocks"], x, training, rblocks)
         rngs = split_rng(rng, 1 + len(self.blocks))
         x = _dropout(rngs[0], x, self.embedding_drop, training)
         for i, blk in enumerate(self.blocks):
@@ -340,24 +362,35 @@ class BERT(StatelessLayer):
                  n_block: int = 12, nhead: int = 12,
                  intermediate_size: int = 3072, max_position_len: int = 512,
                  type_vocab_size: int = 2, hidden_drop: float = 0.1,
-                 attn_drop: float = 0.1, init="glorot_uniform", **kw):
+                 attn_drop: float = 0.1, init="glorot_uniform",
+                 stacked: bool = False, **kw):
         super().__init__(**kw)
         self.vocab = vocab
         self.hidden_size = hidden_size
         self.max_position_len = max_position_len
         self.type_vocab_size = type_vocab_size
         self.hidden_drop = hidden_drop
-        self.blocks = [
-            TransformerBlock(nhead, hidden_size, intermediate_size,
-                             hidden_drop, attn_drop, causal=False,
-                             activation="gelu", after_norm=False, init=init,
-                             name=f"{self.name}_enc{i}")
-            for i in range(n_block)]
+        self.n_block = n_block
+        # stacked=True: blocks live as ONE pytree (leading n_block dim)
+        # run via lax.scan — compile time stays flat as the stack
+        # deepens (trace one block, not twelve); the attention mask
+        # threads through the scan as a broadcast operand
+        self.stacked = stacked
+        mk = lambda name: TransformerBlock(
+            nhead, hidden_size, intermediate_size, hidden_drop, attn_drop,
+            causal=False, activation="gelu", after_norm=False, init=init,
+            name=name)
+        if stacked:
+            self.block = mk(f"{self.name}_enc")
+            self.blocks = []
+        else:
+            self.blocks = [mk(f"{self.name}_enc{i}")
+                           for i in range(n_block)]
         self.initializer = initializers.get(init)
 
     def build_params(self, rng, ids_shape, *rest):
         d = self.hidden_size
-        ks = jax.random.split(rng, 4 + len(self.blocks))
+        ks = jax.random.split(rng, 4 + self.n_block)
         params = {
             "word_embed": self.initializer(ks[0], (self.vocab, d),
                                            jnp.float32) * 0.1,
@@ -369,8 +402,12 @@ class BERT(StatelessLayer):
             "pooler": _dense_params(ks[3], d, d, self.initializer),
         }
         hshape = tuple(ids_shape) + (d,)
-        for i, blk in enumerate(self.blocks):
-            params[f"enc{i}"] = blk.build_params(ks[4 + i], hshape)
+        if self.stacked:
+            params["blocks"] = _stack_block_params(
+                self.block, ks[4:4 + self.n_block], hshape)
+        else:
+            for i, blk in enumerate(self.blocks):
+                params[f"enc{i}"] = blk.build_params(ks[4 + i], hshape)
         return params
 
     def forward(self, params, ids, *rest, training=False, rng=None):
@@ -386,11 +423,18 @@ class BERT(StatelessLayer):
         else:
             x = x + params["pos_embed"][pos_ids.astype(jnp.int32)]
         x = _layernorm(params["embed_ln"], x)
-        rngs = split_rng(rng, 1 + len(self.blocks))
-        x = _dropout(rngs[0], x, self.hidden_drop, training)
-        for i, blk in enumerate(self.blocks):
-            args = (x,) if mask is None else (x, mask)
-            x = blk.forward(params[f"enc{i}"], *args, training=training,
-                            rng=rngs[1 + i])
+        if self.stacked:
+            r0, rblocks = split_rng(rng, 2)
+            x = _dropout(r0, x, self.hidden_drop, training)
+            x = _run_block_stack(self.block, self.n_block,
+                                 params["blocks"], x, training, rblocks,
+                                 mask=mask)
+        else:
+            rngs = split_rng(rng, 1 + len(self.blocks))
+            x = _dropout(rngs[0], x, self.hidden_drop, training)
+            for i, blk in enumerate(self.blocks):
+                args = (x,) if mask is None else (x, mask)
+                x = blk.forward(params[f"enc{i}"], *args,
+                                training=training, rng=rngs[1 + i])
         pooled = jnp.tanh(_dense(params["pooler"], x[:, 0]))
         return [x, pooled]

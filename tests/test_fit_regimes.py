@@ -224,3 +224,115 @@ def test_sp_rejects_padding_mask():
 
 
 
+
+
+def test_sp_checkpoint_resume(tmp_path):
+    """sp regime checkpoints like any other: save mid-training, restore
+    into a fresh estimator, keep training on the ring."""
+    from analytics_zoo_tpu import init_zoo_context
+    from analytics_zoo_tpu.nn import reset_name_scope
+    from analytics_zoo_tpu.train.optimizers import Adam
+
+    init_zoo_context(mesh_shape=(2, 4), axis_names=("data", "seq"))
+    try:
+        ids, y = _lm_data(n=64, L=16)
+        model = _tiny_transformer(n_block=2, stacked=False, causal=True)
+        model.compile(optimizer=Adam(lr=3e-3),
+                      loss="sparse_categorical_crossentropy",
+                      sharding="sp")
+        model.estimator.set_checkpoint(str(tmp_path))
+        model.fit(ids, y, batch_size=32, nb_epoch=3, verbose=False)
+        step = model.estimator.global_step
+
+        reset_name_scope()
+        model2 = _tiny_transformer(n_block=2, stacked=False, causal=True)
+        model2.compile(optimizer=Adam(lr=3e-3),
+                       loss="sparse_categorical_crossentropy",
+                       sharding="sp")
+        model2.estimator._ensure_built([ids])
+        model2.estimator.load_checkpoint(str(tmp_path))
+        assert model2.estimator.global_step == step
+        model2.fit(ids, y, batch_size=32, nb_epoch=5, verbose=False)
+        assert model2.estimator.finished_epochs == 5
+    finally:
+        init_zoo_context()
+
+
+def test_bert_stacked_matches_loop(zoo_ctx):
+    """BERT(stacked=True) computes the same function as the per-block
+    loop (same weights, mask honoured through the scan)."""
+    import jax.numpy as jnp
+
+    from analytics_zoo_tpu.nn import reset_name_scope
+    from analytics_zoo_tpu.nn.layers.attention import BERT
+
+    rs = np.random.RandomState(0)
+    ids = rs.randint(0, 50, (2, 12)).astype(np.int32)
+    seg = np.zeros_like(ids)
+    mask = np.ones((2, 12), np.float32)
+    mask[:, 9:] = 0.0
+
+    reset_name_scope()
+    loop = BERT(vocab=50, hidden_size=16, n_block=3, nhead=2,
+                intermediate_size=32, max_position_len=32,
+                hidden_drop=0.0, attn_drop=0.0)
+    p_loop = loop.build_params(jax.random.PRNGKey(0), ids.shape)
+
+    reset_name_scope()
+    stk = BERT(vocab=50, hidden_size=16, n_block=3, nhead=2,
+               intermediate_size=32, max_position_len=32,
+               hidden_drop=0.0, attn_drop=0.0, stacked=True)
+    p_stk = stk.build_params(jax.random.PRNGKey(0), ids.shape)
+    # graft loop weights into the stacked layout
+    p_stk = dict(p_stk)
+    p_stk["blocks"] = jax.tree_util.tree_map(
+        lambda *ps: jnp.stack(ps, axis=0),
+        *[p_loop[f"enc{i}"] for i in range(3)])
+    for k in ("word_embed", "pos_embed", "type_embed", "embed_ln",
+              "pooler"):
+        p_stk[k] = p_loop[k]
+
+    seq1, pool1 = loop.forward(p_loop, ids, seg, None, mask)
+    seq2, pool2 = stk.forward(p_stk, ids, seg, None, mask)
+    np.testing.assert_allclose(np.asarray(seq1), np.asarray(seq2),
+                               rtol=2e-5, atol=2e-6)
+    np.testing.assert_allclose(np.asarray(pool1), np.asarray(pool2),
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_bert_stacked_rng_branch_and_pp_mask_guard(zoo_ctx):
+    """The rng-threaded scan branch computes the same function at
+    dropout 0, and a masked BERT under an active pipeline regime is
+    rejected loudly (masks cannot ride the ppermute ring)."""
+    import jax.numpy as jnp
+
+    from analytics_zoo_tpu import init_zoo_context
+    from analytics_zoo_tpu.nn import reset_name_scope
+    from analytics_zoo_tpu.nn.layers.attention import BERT
+    from analytics_zoo_tpu.parallel.mode import (PipelineMode,
+                                                 parallel_mode)
+
+    rs = np.random.RandomState(0)
+    ids = rs.randint(0, 50, (2, 12)).astype(np.int32)
+    seg = np.zeros_like(ids)
+    mask = np.ones((2, 12), np.float32)
+
+    reset_name_scope()
+    stk = BERT(vocab=50, hidden_size=16, n_block=3, nhead=2,
+               intermediate_size=32, max_position_len=32,
+               hidden_drop=0.0, attn_drop=0.0, stacked=True)
+    p = stk.build_params(jax.random.PRNGKey(0), ids.shape)
+    seq_norng, _ = stk.forward(p, ids, seg, None, mask)
+    seq_rng, _ = stk.forward(p, ids, seg, None, mask, training=True,
+                             rng=jax.random.PRNGKey(7))
+    np.testing.assert_allclose(np.asarray(seq_norng),
+                               np.asarray(seq_rng), rtol=2e-5, atol=2e-6)
+
+    ctx = init_zoo_context(mesh_shape=(2, 4), axis_names=("data", "pipe"))
+    try:
+        with parallel_mode(pipe=PipelineMode(ctx.mesh, "pipe",
+                                             batch_axis="data")):
+            with pytest.raises(ValueError, match="mask"):
+                stk.forward(p, ids, seg, None, mask)
+    finally:
+        init_zoo_context()
