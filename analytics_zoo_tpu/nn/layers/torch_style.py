@@ -1,0 +1,372 @@
+"""Torch-style element/shape layers.
+
+Reference capability: pyzoo/zoo/pipeline/api/keras/layers/torch.py (Select:28,
+Narrow:61, Squeeze:94, AddConstant:130, MulConstant:153, CAdd:271, CMul:302,
+Exp:334, Identity:355, Log:374, Mul:395, Power:416, Scale:445, Sqrt:472,
+Square:493, HardShrink:514, HardTanh:537, Negative:562, SoftShrink:644,
+BinaryThreshold:696, Threshold:721, SelectTable:793) and the Scala-only
+Max.scala / Expand.scala / GetShape.scala.
+
+TPU-native design: every layer is a pure ``jnp`` expression — XLA fuses these
+into the neighbouring matmul/conv, so none of them costs a kernel launch the
+way the reference's per-layer torch modules do.  Axis conventions follow the
+reference python API: ``dim`` is a 0-based index over the FULL tensor
+(batch included); the batch dimension (dim 0) cannot be selected / narrowed /
+squeezed / reduced; negative dims count from the end.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.nn.module import StatelessLayer
+
+
+def _norm_dim(dim: int, rank: int, what: str) -> int:
+    d = dim + rank if dim < 0 else dim
+    if not 0 <= d < rank:
+        raise ValueError(f"{what}: dim {dim} out of range for rank {rank}")
+    if d == 0:
+        raise ValueError(f"{what}: cannot operate on the batch dimension")
+    return d
+
+
+# ---------------------------------------------------------------------------
+# element-wise math (no parameters)
+# ---------------------------------------------------------------------------
+
+class Square(StatelessLayer):
+    """Element-wise ``x**2`` (reference torch.py:493)."""
+
+    def forward(self, params, x, training=False, rng=None):
+        return jnp.square(x)
+
+
+class Sqrt(StatelessLayer):
+    """Element-wise square root (reference torch.py:472)."""
+
+    def forward(self, params, x, training=False, rng=None):
+        return jnp.sqrt(x)
+
+
+class Log(StatelessLayer):
+    """Element-wise natural log (reference torch.py:374)."""
+
+    def forward(self, params, x, training=False, rng=None):
+        return jnp.log(x)
+
+
+class Exp(StatelessLayer):
+    """Element-wise exp (reference torch.py:334)."""
+
+    def forward(self, params, x, training=False, rng=None):
+        return jnp.exp(x)
+
+
+class Negative(StatelessLayer):
+    """Element-wise negation (reference torch.py:562)."""
+
+    def forward(self, params, x, training=False, rng=None):
+        return -x
+
+
+class Identity(StatelessLayer):
+    """Pass-through (reference torch.py:355)."""
+
+    def forward(self, params, x, training=False, rng=None):
+        return x
+
+
+class Power(StatelessLayer):
+    """``f(x) = (shift + scale * x) ** power`` (reference torch.py:416)."""
+
+    def __init__(self, power, scale=1.0, shift=0.0, **kw):
+        super().__init__(**kw)
+        self.power = float(power)
+        self.scale = float(scale)
+        self.shift = float(shift)
+
+    def forward(self, params, x, training=False, rng=None):
+        return jnp.power(self.shift + self.scale * x, self.power)
+
+
+class AddConstant(StatelessLayer):
+    """Add a non-learnable scalar constant (reference torch.py:130)."""
+
+    def __init__(self, constant, **kw):
+        super().__init__(**kw)
+        self.constant = float(constant)
+
+    def forward(self, params, x, training=False, rng=None):
+        return x + self.constant
+
+
+class MulConstant(StatelessLayer):
+    """Multiply by a non-learnable scalar constant (reference torch.py:153)."""
+
+    def __init__(self, constant, **kw):
+        super().__init__(**kw)
+        self.constant = float(constant)
+
+    def forward(self, params, x, training=False, rng=None):
+        return x * self.constant
+
+
+# ---------------------------------------------------------------------------
+# thresholding / shrinkage activations
+# ---------------------------------------------------------------------------
+
+class HardTanh(StatelessLayer):
+    """Clip to ``[min_value, max_value]`` (reference torch.py:537)."""
+
+    def __init__(self, min_value=-1.0, max_value=1.0, **kw):
+        super().__init__(**kw)
+        if max_value <= min_value:
+            raise ValueError("HardTanh needs max_value > min_value")
+        self.min_value = float(min_value)
+        self.max_value = float(max_value)
+
+    def forward(self, params, x, training=False, rng=None):
+        return jnp.clip(x, self.min_value, self.max_value)
+
+
+class HardShrink(StatelessLayer):
+    """``x if |x| > value else 0`` (reference torch.py:514)."""
+
+    def __init__(self, value=0.5, **kw):
+        super().__init__(**kw)
+        self.value = float(value)
+
+    def forward(self, params, x, training=False, rng=None):
+        return jnp.where(jnp.abs(x) > self.value, x, 0.0)
+
+
+class SoftShrink(StatelessLayer):
+    """``x-v if x>v; x+v if x<-v; else 0`` (reference torch.py:644)."""
+
+    def __init__(self, value=0.5, **kw):
+        super().__init__(**kw)
+        self.value = float(value)
+
+    def forward(self, params, x, training=False, rng=None):
+        v = self.value
+        return jnp.where(x > v, x - v, jnp.where(x < -v, x + v, 0.0))
+
+
+class Threshold(StatelessLayer):
+    """``x if x > th else v`` (reference torch.py:721)."""
+
+    def __init__(self, th=1e-6, v=0.0, **kw):
+        super().__init__(**kw)
+        self.th = float(th)
+        self.v = float(v)
+
+    def forward(self, params, x, training=False, rng=None):
+        return jnp.where(x > self.th, x, self.v)
+
+
+class BinaryThreshold(StatelessLayer):
+    """``0 where x < value, 1 elsewhere`` (reference torch.py:696)."""
+
+    def __init__(self, value=1e-6, **kw):
+        super().__init__(**kw)
+        self.value = float(value)
+
+    def forward(self, params, x, training=False, rng=None):
+        return jnp.where(x < self.value, 0.0, 1.0).astype(x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# learnable element-wise layers
+# ---------------------------------------------------------------------------
+
+class CAdd(StatelessLayer):
+    """Learnable bias of shape ``size`` added element-wise with broadcast
+    (reference torch.py:271).  Expansion follows numpy broadcasting rules:
+    singleton dims of the bias repeat against the input."""
+
+    def __init__(self, size: Sequence[int], b_regularizer=None, **kw):
+        super().__init__(**kw)
+        self.size = tuple(int(s) for s in size)
+        from analytics_zoo_tpu.nn import regularizers as _reg
+        self.b_regularizer = _reg.get(b_regularizer)
+
+    def build_params(self, rng, input_shape):
+        return {"bias": jnp.zeros(self.size, jnp.float32)}
+
+    def forward(self, params, x, training=False, rng=None):
+        return x + params["bias"]
+
+    def regularization_loss(self, params):
+        if self.b_regularizer is None:
+            return 0.0
+        return self.b_regularizer(params["bias"])
+
+
+class CMul(StatelessLayer):
+    """Learnable weight of shape ``size`` multiplied element-wise with
+    broadcast (reference torch.py:302)."""
+
+    def __init__(self, size: Sequence[int], W_regularizer=None, **kw):
+        super().__init__(**kw)
+        self.size = tuple(int(s) for s in size)
+        from analytics_zoo_tpu.nn import regularizers as _reg
+        self.w_regularizer = _reg.get(W_regularizer)
+
+    def build_params(self, rng, input_shape):
+        return {"weight": jnp.ones(self.size, jnp.float32)}
+
+    def forward(self, params, x, training=False, rng=None):
+        return x * params["weight"]
+
+    def regularization_loss(self, params):
+        if self.w_regularizer is None:
+            return 0.0
+        return self.w_regularizer(params["weight"])
+
+
+class Mul(StatelessLayer):
+    """Single learnable scalar factor (reference torch.py:395)."""
+
+    def build_params(self, rng, input_shape):
+        return {"weight": jnp.ones((), jnp.float32)}
+
+    def forward(self, params, x, training=False, rng=None):
+        return x * params["weight"]
+
+
+class Scale(StatelessLayer):
+    """CMul then CAdd with shared ``size`` (reference torch.py:445)."""
+
+    def __init__(self, size: Sequence[int], **kw):
+        super().__init__(**kw)
+        self.size = tuple(int(s) for s in size)
+
+    def build_params(self, rng, input_shape):
+        return {"weight": jnp.ones(self.size, jnp.float32),
+                "bias": jnp.zeros(self.size, jnp.float32)}
+
+    def forward(self, params, x, training=False, rng=None):
+        return x * params["weight"] + params["bias"]
+
+
+# ---------------------------------------------------------------------------
+# shape / indexing layers
+# ---------------------------------------------------------------------------
+
+class Select(StatelessLayer):
+    """Select ``index`` along ``dim`` and drop that dimension
+    (reference torch.py:28).  ``dim``/``index`` may be negative."""
+
+    def __init__(self, dim: int, index: int, **kw):
+        super().__init__(**kw)
+        self.dim = int(dim)
+        self.index = int(index)
+
+    def forward(self, params, x, training=False, rng=None):
+        d = _norm_dim(self.dim, x.ndim, "Select")
+        i = self.index + x.shape[d] if self.index < 0 else self.index
+        if not 0 <= i < x.shape[d]:
+            raise IndexError(
+                f"Select: index {self.index} out of range for dim {d} "
+                f"of size {x.shape[d]}")
+        return jax.lax.index_in_dim(x, i, axis=d, keepdims=False)
+
+
+class Narrow(StatelessLayer):
+    """Slice ``[offset, offset+length)`` along ``dim`` without reducing rank
+    (reference torch.py:61).  ``length=-1`` means to the end."""
+
+    def __init__(self, dim: int, offset: int, length: int = 1, **kw):
+        super().__init__(**kw)
+        self.dim = int(dim)
+        self.offset = int(offset)
+        self.length = int(length)
+
+    def forward(self, params, x, training=False, rng=None):
+        d = _norm_dim(self.dim, x.ndim, "Narrow")
+        length = (x.shape[d] - self.offset if self.length == -1
+                  else self.length)
+        return jax.lax.slice_in_dim(x, self.offset, self.offset + length,
+                                    axis=d)
+
+
+class Squeeze(StatelessLayer):
+    """Drop singleton dim(s); never the batch dim (reference torch.py:94).
+    ``dim=None`` drops every non-batch singleton dimension."""
+
+    def __init__(self, dim: Union[int, Sequence[int], None] = None, **kw):
+        super().__init__(**kw)
+        if isinstance(dim, int):
+            dim = (dim,)
+        self.dim = tuple(dim) if dim is not None else None
+
+    def forward(self, params, x, training=False, rng=None):
+        if self.dim is None:
+            axes = tuple(d for d in range(1, x.ndim) if x.shape[d] == 1)
+        else:
+            axes = tuple(_norm_dim(d, x.ndim, "Squeeze") for d in self.dim)
+            for d in axes:
+                if x.shape[d] != 1:
+                    raise ValueError(
+                        f"Squeeze: dim {d} has size {x.shape[d]}, not 1")
+        return jnp.squeeze(x, axis=axes)
+
+
+class SelectTable(StatelessLayer):
+    """Pick element ``index`` from a multi-input list (reference
+    torch.py:793)."""
+
+    def __init__(self, index: int, **kw):
+        super().__init__(**kw)
+        self.index = int(index)
+
+    def forward(self, params, *inputs, training=False, rng=None):
+        return inputs[self.index]
+
+
+class Max(StatelessLayer):
+    """Max over ``dim``, keeping it as size 1 (reference Max.scala:39 —
+    ``computeOutputShape`` pins the reduced dim to 1).  ``return_value=False``
+    returns the argmax indices instead."""
+
+    def __init__(self, dim: int, return_value: bool = True, **kw):
+        super().__init__(**kw)
+        self.dim = int(dim)
+        self.return_value = bool(return_value)
+
+    def forward(self, params, x, training=False, rng=None):
+        d = _norm_dim(self.dim, x.ndim, "Max")
+        if self.return_value:
+            return jnp.max(x, axis=d, keepdims=True)
+        return jnp.argmax(x, axis=d, keepdims=True).astype(jnp.int32)
+
+
+class Expand(StatelessLayer):
+    """Broadcast singleton dims to ``tgt_sizes`` (full shape incl. batch;
+    ``-1`` keeps a dim unchanged).  Reference Expand.scala:InternalExpand."""
+
+    def __init__(self, tgt_sizes: Sequence[int], **kw):
+        super().__init__(**kw)
+        self.tgt_sizes = tuple(int(s) for s in tgt_sizes)
+
+    def forward(self, params, x, training=False, rng=None):
+        if len(self.tgt_sizes) != x.ndim:
+            raise ValueError(
+                f"Expand: tgt_sizes rank {len(self.tgt_sizes)} != input "
+                f"rank {x.ndim} (include the batch dim; use -1 to keep)")
+        target = tuple(x.shape[i] if t == -1 else t
+                       for i, t in enumerate(self.tgt_sizes))
+        return jnp.broadcast_to(x, target)
+
+
+class GetShape(StatelessLayer):
+    """Return the input's full shape as an int32 vector of length ``rank``
+    (reference GetShape.scala — zero gradient, which holds trivially here
+    because the output does not depend on the input values)."""
+
+    def forward(self, params, x, training=False, rng=None):
+        return jnp.asarray(x.shape, jnp.int32)

@@ -59,10 +59,18 @@ class KerasNet(Layer):
             raise RuntimeError("call compile(optimizer, loss) before fit/evaluate")
         return self._estimator
 
-    def fit(self, x, y=None, batch_size: int = 32, nb_epoch: int = 1,
-            validation_data=None, **kw):
+    def fit(self, x, y=None, batch_size: int = 32,
+            nb_epoch: Optional[int] = None,
+            validation_data=None, epochs: Optional[int] = None, **kw):
+        """``nb_epoch`` mirrors the reference (Topology.scala:344); ``epochs``
+        is accepted as the modern-Keras alias for the same knob."""
+        if nb_epoch is not None and epochs is not None:
+            raise ValueError(
+                "pass either nb_epoch= or epochs= (aliases), not both")
+        n = nb_epoch if nb_epoch is not None else (
+            epochs if epochs is not None else 1)
         return self.estimator.fit(x, y, batch_size=batch_size,
-                                  epochs=nb_epoch,
+                                  epochs=n,
                                   validation_data=validation_data, **kw)
 
     def evaluate(self, x, y=None, batch_size: int = 32):

@@ -247,3 +247,18 @@ def test_prefetch_propagates_producer_error():
         for x in it:
             out.append(x)
     assert out == [0, 1]
+
+
+def test_fit_epochs_alias(zoo_ctx):
+    """``epochs=`` is accepted as an alias for ``nb_epoch=`` (and passing
+    both is a clear error, not a TypeError from kwarg collision)."""
+    from analytics_zoo_tpu.nn import Sequential
+    from analytics_zoo_tpu.nn.layers.core import Dense
+
+    x, y = _toy_classification(n=64)
+    model = Sequential([Dense(3, activation="softmax")])
+    model.compile(optimizer="adam", loss="sparse_categorical_crossentropy")
+    history = model.fit(x, y, batch_size=32, epochs=2, verbose=False)
+    assert len(history) == 2
+    with pytest.raises(ValueError, match="not both"):
+        model.fit(x, y, batch_size=32, nb_epoch=1, epochs=1, verbose=False)
