@@ -56,6 +56,18 @@ class ZooContext:
 
         return NamedSharding(self.mesh, P())
 
+    @property
+    def process_count(self) -> int:
+        import jax
+
+        return jax.process_count()
+
+    @property
+    def local_device_count(self) -> int:
+        import jax
+
+        return jax.local_device_count()
+
 
 def init_zoo_context(
     config: Optional[ZooConfig] = None,
@@ -63,6 +75,9 @@ def init_zoo_context(
     mesh_shape: Optional[Sequence[int]] = None,
     axis_names: Optional[Sequence[str]] = None,
     multihost: bool = False,
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
     **config_overrides,
 ) -> ZooContext:
     """Initialise (or re-initialise) the global framework context.
@@ -87,7 +102,24 @@ def init_zoo_context(
     logging.basicConfig(level=getattr(logging, config.log_level.upper(), 20))
 
     if multihost:
-        jax.distributed.initialize()
+        # On TPU pods the three coordination args are discovered from the
+        # environment; on CPU/GPU clusters (or tests) they are explicit.
+        # NOTE: must run before anything touches the XLA backend (even
+        # jax.process_count()), hence the try-based idempotency guard.
+        try:
+            # None values mean auto-discover (TPU pod metadata / env vars)
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes, process_id=process_id)
+        except RuntimeError as e:
+            if "once" not in str(e):
+                raise
+            # Already initialised: keep the live cluster, but surface it —
+            # if the caller passed different coordination args they are
+            # NOT applied.
+            logger.warning(
+                "jax.distributed already initialised; ignoring multihost "
+                "coordination args (%s)", e)
 
     if mesh_shape is not None:
         config = config.replace(mesh_shape=tuple(mesh_shape))

@@ -288,10 +288,13 @@ class Narrow(StatelessLayer):
 
     def forward(self, params, x, training=False, rng=None):
         d = _norm_dim(self.dim, x.ndim, "Narrow")
-        length = (x.shape[d] - self.offset if self.length == -1
-                  else self.length)
-        return jax.lax.slice_in_dim(x, self.offset, self.offset + length,
-                                    axis=d)
+        ofs = self.offset + x.shape[d] if self.offset < 0 else self.offset
+        length = x.shape[d] - ofs if self.length == -1 else self.length
+        if not (0 <= ofs and ofs + length <= x.shape[d] and length >= 0):
+            raise IndexError(
+                f"Narrow: [{self.offset}, {self.offset}+{self.length}) out "
+                f"of range for dim {d} of size {x.shape[d]}")
+        return jax.lax.slice_in_dim(x, ofs, ofs + length, axis=d)
 
 
 class Squeeze(StatelessLayer):

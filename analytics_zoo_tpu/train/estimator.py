@@ -338,12 +338,31 @@ class Estimator:
             donate_argnums=(0, 1, 2, 3),
         )
 
+    def _put_sharded(self, arrs: List[np.ndarray], shard):
+        """Host batch → device arrays under ``shard``.  Multi-controller
+        processes hold only their LOCAL rows of the global batch; the
+        runtime assembles the global array without cross-host copies
+        (every process must supply the same row count per step)."""
+        if self.ctx.process_count > 1:
+            return [jax.make_array_from_process_local_data(
+                shard, np.asarray(a)) for a in arrs]
+        return [jax.device_put(jnp.asarray(a), shard) for a in arrs]
+
+    @property
+    def _data_div(self) -> int:
+        """Row-count divisor for batches: local devices under
+        multi-controller (batches count process-local rows), the full
+        mesh otherwise."""
+        return (self.ctx.local_device_count if self.ctx.process_count > 1
+                else self.ctx.num_devices)
+
     def _shard_chunk(self, arrs: List[np.ndarray]):
         from jax.sharding import NamedSharding, PartitionSpec as P
 
+        # batch axis is axis 1 of the (K, B, ...) superbatch
         shard = NamedSharding(self.ctx.mesh, P(None, self.ctx.data_axis))
         with timeit("estimator/shard_chunk"):
-            return [jax.device_put(jnp.asarray(a), shard) for a in arrs]
+            return self._put_sharded(arrs, shard)
 
     def _build_eval_step(self):
         model, loss_fn, mets = self.model, self.loss_fn, self.metrics
@@ -417,9 +436,14 @@ class Estimator:
                 preds = _cast_floats(preds, jnp.float32)
             return preds
 
+        # Multi-controller: a data-sharded output spans non-addressable
+        # devices, so each process could not read its rows back —
+        # replicate the (small, batch-sized) predictions instead and let
+        # predict_raw slice out the local rows.
+        out_shard = (rep if self.ctx.process_count > 1 else data_shard)
         self._predict_step = jax.jit(
             step, in_shardings=(None, None, data_shard),
-            out_shardings=data_shard)
+            out_shardings=out_shard)
 
     # ------------------------------------------------------------------
     # data plumbing
@@ -430,7 +454,7 @@ class Estimator:
         every step sees ONE static shape (no per-remainder recompiles);
         returns the real row count."""
         n = arrs[0].shape[0]
-        d = self.ctx.num_devices
+        d = self._data_div
         target = max(batch, d, int(math.ceil(n / d)) * d)
         if target == n:
             return arrs, n
@@ -441,9 +465,8 @@ class Estimator:
         return padded, n
 
     def _shard_batch(self, arrs: List[np.ndarray]):
-        shard = self.ctx.data_sharding()
         with timeit("estimator/shard_batch"):
-            return [jax.device_put(jnp.asarray(a), shard) for a in arrs]
+            return self._put_sharded(arrs, self.ctx.data_sharding())
 
     def _maybe_midepoch_validation(self, validation_data, epoch: int,
                                    train_batch: int):
@@ -505,7 +528,10 @@ class Estimator:
         xs = _as_list(x)
         assert y is not None, "y required for array training"
         n = xs[0].shape[0]
-        d = self.ctx.num_devices
+        # multi-controller: x/y are the process-LOCAL shard of the dataset
+        # and batch_size counts local rows, so divisibility is against the
+        # local device count (the global batch is local x process_count).
+        d = self._data_div
         eff_batch = max(batch_size, d)
         if batch_size % d != 0:
             eff_batch = int(math.ceil(batch_size / d)) * d
@@ -822,8 +848,11 @@ class Estimator:
         if self._predict_step is None:
             self._build_predict_step()
         n = xs[0].shape[0]
-        d = self.ctx.num_devices
+        d = self._data_div
         eff_batch = int(math.ceil(max(batch_size, d) / d)) * d
+        # multi-controller: the replicated global output stacks every
+        # process's rows in process order — ours start at this offset
+        multiproc = self.ctx.process_count > 1
         outs: Optional[List[List[np.ndarray]]] = None
         for s in range(int(math.ceil(n / eff_batch))):
             sl = slice(s * eff_batch, min((s + 1) * eff_batch, n))
@@ -836,8 +865,9 @@ class Estimator:
                 preds = [preds]
             if outs is None:
                 outs = [[] for _ in preds]
+            row0 = jax.process_index() * bx_p[0].shape[0] if multiproc else 0
             for o, p in zip(outs, preds):
-                o.append(np.asarray(p)[:real])
+                o.append(np.asarray(p)[row0:row0 + real])
         return [np.concatenate(o, axis=0) for o in outs]
 
     # ------------------------------------------------------------------

@@ -1,0 +1,96 @@
+"""Worker for the real two-process ``jax.distributed`` test.
+
+Each process forces a 2-device virtual CPU backend, joins the gloo
+coordination service, assembles the 4-device GLOBAL mesh through
+``init_zoo_context(multihost=True, ...)``, and trains the same tiny
+model on its process-LOCAL half of every global batch.  The final loss
+history is written to ``outfile`` so the parent can assert parity with
+a single-process 4-device run of the identical problem.
+
+Replaces (and automates) the reference's manual two-executor
+integration script (pyzoo/test/zoo/ray/integration/ray_on_yarn.py:23-33).
+
+Usage: multiprocess_worker.py <process_id> <num_processes> <port> <outfile>
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    pid, nproc = int(sys.argv[1]), int(sys.argv[2])
+    port, outfile = sys.argv[3], sys.argv[4]
+
+    # 4 global devices regardless of process count: nproc processes each
+    # expose 4/nproc local CPU devices, so the single-process reference
+    # run and the two-process run see the SAME mesh and global batches.
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + f" --xla_force_host_platform_device_count="
+                                 f"{4 // nproc}").strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from analytics_zoo_tpu import init_zoo_context
+    from analytics_zoo_tpu.nn import Sequential
+    from analytics_zoo_tpu.nn.layers.core import Dense
+
+    if nproc > 1:
+        ctx = init_zoo_context(
+            multihost=True,
+            coordinator_address=f"127.0.0.1:{port}",
+            num_processes=nproc,
+            process_id=pid,
+            seed=7,
+        )
+    else:
+        ctx = init_zoo_context(seed=7)
+    assert ctx.num_devices == 4, ctx.num_devices
+    assert ctx.process_count == nproc
+
+    # deterministic problem; every process generates the full dataset and
+    # slices out its rows of each global batch (global batch 16 =
+    # nproc x local batch)
+    import numpy as np
+
+    rs = np.random.RandomState(0)
+    n, d, classes = 128, 8, 3
+    x = rs.randn(n, d).astype(np.float32)
+    w = rs.randn(d, classes)
+    y = np.argmax(x @ w, axis=1).astype(np.int32)
+
+    g_batch = 16
+    local = g_batch // nproc
+    # rows of global batch k that live on THIS process's devices: the
+    # data axis is laid out [dev0..dev3] = [p0.d0, p0.d1, p1.d0, p1.d1],
+    # so process p owns the contiguous middle slice of every batch.
+    keep = np.concatenate([
+        np.arange(k * g_batch + pid * local,
+                  k * g_batch + (pid + 1) * local)
+        for k in range(n // g_batch)])
+    x_loc, y_loc = x[keep], y[keep]
+
+    model = Sequential([Dense(16, activation="relu"),
+                        Dense(classes, activation="softmax")])
+    model.compile(optimizer="adam", loss="sparse_categorical_crossentropy")
+    hist = model.fit(x_loc, y_loc, batch_size=local, epochs=3,
+                     shuffle=False, verbose=False)
+
+    # the process-crossing predict/evaluate paths must agree with the
+    # single-process run too (order-insensitive summaries)
+    preds = model.predict(x_loc, batch_size=local)
+    ev = model.evaluate(x_loc, y_loc, batch_size=local)
+
+    with open(outfile, "w") as f:
+        json.dump({"process_id": pid,
+                   "losses": [h["loss"] for h in hist],
+                   "pred_rows": int(np.asarray(preds).shape[0]),
+                   "pred_sum": float(np.asarray(preds).sum()),
+                   "eval_loss": float(ev["loss"])}, f)
+
+
+if __name__ == "__main__":
+    main()

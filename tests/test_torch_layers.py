@@ -133,6 +133,15 @@ class TestShapeLayers:
         out, _ = _run(Narrow(1, 2, -1), x)
         np.testing.assert_array_equal(out, [[3], [6]])
 
+    def test_narrow_negative_offset(self):
+        x = np.array([[1, 2, 3], [4, 5, 6]], np.float32)
+        out, _ = _run(Narrow(1, -2, 2), x)
+        np.testing.assert_array_equal(out, [[2, 3], [5, 6]])
+        out, _ = _run(Narrow(1, -1, -1), x)
+        np.testing.assert_array_equal(out, [[3], [6]])
+        with pytest.raises(IndexError, match="out of range"):
+            _run(Narrow(1, 2, 5), x)
+
     def test_squeeze(self):
         x = np.zeros((2, 1, 3, 4, 1), np.float32)
         out, _ = _run(Squeeze(1), x)
