@@ -7,7 +7,8 @@ Redis-stream streaming inference), plus the Python client
 """
 
 from analytics_zoo_tpu.deploy.inference import (  # noqa: F401
-    DynamicBatcher, InferenceModel, dequantize_pytree, quantize_pytree)
+    DynamicBatcher, InferenceModel, dequantize_pytree, imagenet_preprocess,
+    quantize_pytree)
 from analytics_zoo_tpu.deploy.serving import (  # noqa: F401
     ClusterServing, FileQueue, InputQueue, MemoryQueue, OutputQueue,
     RedisQueue, ServingConfig, decode_image, decode_tensor, encode_image,

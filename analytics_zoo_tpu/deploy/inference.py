@@ -37,6 +37,22 @@ __all__ = ["InferenceModel", "DynamicBatcher", "quantize_pytree",
            "dequantize_pytree"]
 
 
+def _as_tuple(x):
+    return tuple(x) if isinstance(x, (list, tuple)) else (x,)
+
+
+def imagenet_preprocess(scale: float = 1.0 / 127.5, offset: float = -1.0,
+                        dtype=jnp.bfloat16):
+    """On-device normalizer for uint8 image wire format: clients send
+    raw uint8 HWC images (4x smaller than float32 on the host→device
+    link); the chip casts + affine-normalizes inside the serving
+    program.  Default maps [0,255] → [-1,1]."""
+    def fn(x):
+        return x.astype(dtype) * scale + offset
+
+    return fn
+
+
 def _next_bucket(n: int, buckets: Sequence[int]) -> int:
     for b in buckets:
         if n <= b:
@@ -136,20 +152,31 @@ class InferenceModel:
 
     @classmethod
     def from_keras_net(cls, net, params, state=None, int8: bool = False,
+                       preprocess: Optional[Callable] = None,
                        **kw) -> "InferenceModel":
-        """Wrap a built KerasNet + weights as a serving model."""
+        """Wrap a built KerasNet + weights as a serving model.
+
+        ``preprocess``: optional jax fn run ON DEVICE inside the same
+        compiled program as the forward pass (fn(*raw) -> model input(s)).
+        Lets clients ship compact wire dtypes — e.g. uint8 images
+        normalized on-chip — so the host→device link carries 4x fewer
+        bytes than float32 (see ``deploy.imagenet_preprocess``)."""
         state = state or {}
         if int8:
             qparams = quantize_pytree(params)
 
             @jax.jit
             def fwd(*xs):
+                if preprocess is not None:
+                    xs = _as_tuple(preprocess(*xs))
                 p = dequantize_pytree(qparams)
                 out, _ = net.call(p, state, *xs, training=False)
                 return out
         else:
             @jax.jit
             def fwd(*xs):
+                if preprocess is not None:
+                    xs = _as_tuple(preprocess(*xs))
                 out, _ = net.call(params, state, *xs, training=False)
                 return out
 

@@ -58,12 +58,24 @@ def _bottleneck(x, filters, strides=1, downsample=False, name=""):
 
 
 def resnet50(class_num: int = 1000,
-             input_shape: Sequence[int] = (224, 224, 3)) -> Model:
+             input_shape: Sequence[int] = (224, 224, 3),
+             space_to_depth_stem: bool = True) -> Model:
     """ResNet-50 (bottleneck [3,4,6,3]).  Reference: examples/resnet/ and
-    ImageClassificationConfig 'resnet-50' entry."""
+    ImageClassificationConfig 'resnet-50' entry.
+
+    ``space_to_depth_stem`` computes the 7x7/s2 stem as a mathematically
+    identical 4x4/s1 conv over a space-to-depth input (same params, same
+    outputs — see SpaceToDepthStemConv) for MXU utilisation; disable to
+    run the literal 7x7 conv."""
+    from analytics_zoo_tpu.nn.layers.convolutional import SpaceToDepthStemConv
+
     inp = Input(shape=tuple(input_shape), name="input")
-    x = Convolution2D(64, 7, 7, subsample=(2, 2), border_mode="same",
-                      bias=False, name="stem_conv")(inp)
+    if space_to_depth_stem and input_shape[0] % 2 == 0 \
+            and input_shape[1] % 2 == 0:
+        x = SpaceToDepthStemConv(64, bias=False, name="stem_conv")(inp)
+    else:
+        x = Convolution2D(64, 7, 7, subsample=(2, 2), border_mode="same",
+                          bias=False, name="stem_conv")(inp)
     x = BatchNormalization(name="stem_bn")(x)
     x = Activation("relu")(x)
     x = MaxPooling2D((3, 3), strides=(2, 2), border_mode="same")(x)

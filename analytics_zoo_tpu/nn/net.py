@@ -172,7 +172,22 @@ class GraphNet:
                              f"{sorted(by_name)}")
         outs = [by_name[n] for n in names]
         sub = Model(self.model.inputs, outs[0] if single else outs)
-        return GraphNet(sub)
+        g = GraphNet(sub)
+        # carry trained weights into the sub-graph (reference newGraph
+        # reuses the SAME weighted graph): compile the sub lazily for
+        # inference and seed it with the source model's current params
+        src_est = getattr(self.model, "_estimator", None)
+        if src_est is not None and src_est.params is not None:
+            # sgd is stateless: no optimizer moments allocated for what is
+            # typically an inference-only feature extractor (re-compiling
+            # for fine-tuning keeps these weights — topology.compile)
+            sub.compile(optimizer="sgd", loss="mse")
+            import jax as _jax
+
+            params = _jax.device_get(src_est.params)
+            state = _jax.device_get(src_est.state or {})
+            sub.estimator.set_initial_weights(params, state)
+        return g
 
     # -- passthrough ------------------------------------------------------
     def compile(self, *a, **kw):

@@ -42,10 +42,23 @@ class KerasNet(Layer):
         """
         from analytics_zoo_tpu.train.estimator import Estimator
 
+        prev = self._estimator
         self._estimator = Estimator(self, optimizer=optimizer, loss=loss,
                                     metrics=metrics, sharding=sharding,
                                     aux_loss_weight=aux_loss_weight,
                                     grad_accum_steps=grad_accum_steps)
+        # re-compiling must NOT lose weights: carry the previous
+        # estimator's live params (or its still-pending initial weights —
+        # e.g. a sub-graph seeded by nn/net.py new_graph) forward
+        if prev is not None:
+            import jax as _jax
+
+            if prev.params is not None:
+                self._estimator.set_initial_weights(
+                    _jax.device_get(prev.params),
+                    _jax.device_get(prev.state or {}))
+            elif getattr(prev, "_initial_weights", None) is not None:
+                self._estimator.set_initial_weights(*prev._initial_weights)
         # apply settings made before compile()
         if getattr(self, "_tb_dir", None):
             self._estimator.set_tensorboard(self._tb_dir)

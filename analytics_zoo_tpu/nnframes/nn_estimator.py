@@ -240,11 +240,18 @@ class NNModel(_Params):
 
     def transform(self, df):
         df, xs = self._extract_features(df)
-        scores = self.estimator.predict(xs, batch_size=self.batch_size)
+        scores = np.asarray(self.estimator.predict(
+            xs, batch_size=self.batch_size))
         out = df.copy()
-        out[self.prediction_col] = self._postprocess_scores(
-            np.asarray(scores))
+        out[self.prediction_col] = self._postprocess_scores(scores)
+        for col, vals in self._extra_columns(scores).items():
+            out[col] = vals
         return out
+
+    def _extra_columns(self, scores: np.ndarray) -> dict:
+        """Additional output columns derived from the raw scores
+        (NNClassifierModel adds rawPrediction here)."""
+        return {}
 
     # -- persistence (reference NNModel.write/read) ------------------------
     def save(self, path: str) -> None:
@@ -313,3 +320,15 @@ class NNClassifierModel(NNModel):
         if not self.zero_based_label:
             cls = cls + 1
         return cls.astype(np.float64)                      # Spark-ML Double
+
+    def set_raw_prediction_col(self, v: str):
+        self.raw_prediction_col = v
+        return self
+
+    setRawPredictionCol = set_raw_prediction_col
+
+    def _extra_columns(self, scores: np.ndarray) -> dict:
+        """Spark ML classifier column parity: ``rawPrediction`` carries
+        the per-class score vector next to the argmaxed ``prediction``."""
+        col = getattr(self, "raw_prediction_col", "rawPrediction")
+        return {col: list(scores) if scores.ndim > 1 else scores}

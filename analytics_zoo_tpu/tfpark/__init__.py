@@ -13,7 +13,8 @@ from analytics_zoo_tpu.tfpark.estimator import (  # noqa: F401
     EstimatorSpec, ModeKeys, TFEstimator)
 from analytics_zoo_tpu.tfpark.gan import GANEstimator  # noqa: F401
 from analytics_zoo_tpu.tfpark.model import (  # noqa: F401
-    FunctionModel, KerasModel, TFNet, TFOptimizer, TorchCriterion,
+    FunctionModel, KerasModel, TFGraphOptimizer, TFNet, TFOptimizer,
+    TorchCriterion,
     TorchModel)
 from analytics_zoo_tpu.tfpark.text_estimators import (  # noqa: F401
     BERTNER, BERTSQuAD, BERTClassifier)

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 from typing import Any, Dict, List, Optional
 
+import jax.numpy as jnp
 import numpy as np
 
 from analytics_zoo_tpu.nn.topology import KerasNet
@@ -26,7 +27,8 @@ from analytics_zoo_tpu.tfpark.converter import (GraphProgram,
                                                 convert_keras_model)
 from analytics_zoo_tpu.tfpark.tf_dataset import TFDataset
 
-__all__ = ["FunctionModel", "KerasModel", "TFNet", "TFOptimizer",
+__all__ = ["FunctionModel", "KerasModel", "TFGraphOptimizer", "TFNet",
+           "TFOptimizer",
            "TorchModel"]
 
 
@@ -222,6 +224,143 @@ class TFOptimizer:
         if end_trigger is not None and hasattr(end_trigger, "max_epoch"):
             n_epochs = end_trigger.max_epoch
         return self.kmodel.fit(self.dataset, epochs=n_epochs)
+
+    @classmethod
+    def from_loss(cls, loss_fn, variables, optim_method=None, dataset=None,
+                  clip_norm=None, clip_value=None,
+                  metrics=None) -> "TFGraphOptimizer":
+        """Train an ARBITRARY TensorFlow graph — not just the Keras layer
+        vocabulary (reference tf_optimizer.py:479 ``from_loss``).
+
+        ``loss_fn(*batch_tensors) -> scalar`` is any TF computation
+        closing over ``variables`` (a list of ``tf.Variable`` or a
+        ``tf.Module``).  Gradients stay inside TF (GradientTape over the
+        user's own graph, like the reference kept grads in the TF
+        session); the update rule is a zoo/optax optimizer applied on
+        the JAX side, so schedules/clipping match native training.
+        """
+        return TFGraphOptimizer(loss_fn, variables,
+                                optim_method=optim_method, dataset=dataset,
+                                clip_norm=clip_norm, clip_value=clip_value,
+                                metrics=metrics)
+
+    @classmethod
+    def from_train_op(cls, train_op, dataset=None,
+                      metrics=None) -> "TFGraphOptimizer":
+        """Drive a graph that owns its OWN update step (reference
+        tf_optimizer.py:556): ``train_op(*batch_tensors)`` performs one
+        parameter update (e.g. ``optimizer.apply_gradients`` inside) and
+        returns the scalar loss."""
+        return TFGraphOptimizer(None, None, train_op=train_op,
+                                dataset=dataset, metrics=metrics)
+
+
+class TFGraphOptimizer:
+    """Training loop for arbitrary TF graphs (see ``TFOptimizer.from_loss``).
+
+    The TF side runs as one compiled ``tf.function`` per step on the host
+    (the reference ran the TF graph on CPU executors too); parameters are
+    mirrored as JAX arrays so the optimizer is the same optax rule native
+    models use, then assigned back to the variables after every step.
+    """
+
+    def __init__(self, loss_fn, variables, train_op=None, optim_method=None,
+                 dataset=None, clip_norm=None, clip_value=None, metrics=None):
+        import tensorflow as tf
+
+        self._tf = tf
+        self.dataset = dataset
+        self.metrics = metrics or {}
+        self.history: List[dict] = []
+        self._train_op = train_op
+        if train_op is not None:
+            self._step = tf.function(train_op)
+            return
+
+        if hasattr(variables, "trainable_variables"):   # tf.Module / layer
+            variables = list(variables.trainable_variables)
+        if not variables:
+            raise ValueError("from_loss needs a non-empty variable list")
+        self.variables = list(variables)
+        self.loss_fn = loss_fn
+
+        from analytics_zoo_tpu.train.optimizers import Adam
+
+        self.tx = optim_method if optim_method is not None else Adam(1e-3)
+        self._params = [jnp.asarray(v.numpy()) for v in self.variables]
+        self._opt_state = self.tx.init(self._params)
+        self._clip_norm, self._clip_value = clip_norm, clip_value
+
+        @tf.function
+        def tf_step(*batch):
+            with tf.GradientTape() as tape:
+                loss = loss_fn(*batch)
+            grads = tape.gradient(loss, self.variables)
+            return loss, grads
+
+        self._step = tf_step
+
+    # ------------------------------------------------------------------
+    def _one_update(self, batch) -> float:
+        import optax
+
+        if self._train_op is not None:
+            return float(np.asarray(self._step(*batch)))
+        loss, grads = self._step(*batch)
+        dead = [v.name for v, g in zip(self.variables, grads) if g is None]
+        if dead:
+            raise ValueError(
+                f"loss_fn produces no gradient for variable(s) {dead} — "
+                "they are not used in the loss; drop them from the "
+                "variable list")
+        gs = [jnp.asarray(np.asarray(g)) for g in grads]
+        if self._clip_value is not None:
+            c = float(self._clip_value)
+            gs = [jnp.clip(g, -c, c) for g in gs]
+        if self._clip_norm is not None:
+            norm = jnp.sqrt(sum(jnp.sum(g * g) for g in gs))
+            scale = jnp.minimum(1.0, self._clip_norm / (norm + 1e-12))
+            gs = [g * scale for g in gs]
+        updates, self._opt_state = self.tx.update(gs, self._opt_state,
+                                                  self._params)
+        self._params = optax.apply_updates(self._params, updates)
+        for v, p in zip(self.variables, self._params):
+            v.assign(np.asarray(p))
+        return float(np.asarray(loss))
+
+    def optimize(self, end_trigger=None, epochs: int = 1,
+                 batch_size: Optional[int] = None, shuffle: bool = True,
+                 seed: int = 0) -> List[dict]:
+        """Run epochs over the dataset; returns per-epoch history rows
+        (loss + any validation metrics)."""
+        if end_trigger is not None and hasattr(end_trigger, "max_epoch"):
+            epochs = end_trigger.max_epoch
+        ds = self.dataset
+        if ds is None:
+            raise ValueError("no dataset: pass one at construction")
+        if not isinstance(ds, TFDataset):
+            ds = TFDataset.from_ndarrays(ds,
+                                         batch_size=batch_size or 32)
+        b = batch_size or ds.batch_size
+        arrays = list(ds.features) + list(ds.labels)
+        n = arrays[0].shape[0]
+        if n < b:
+            raise ValueError(
+                f"dataset ({n} rows) smaller than batch_size ({b}): "
+                "no training step would run")
+        rs = np.random.RandomState(seed)
+        for _ in range(epochs):
+            perm = rs.permutation(n) if shuffle else np.arange(n)
+            losses = []
+            for s in range(n // b):
+                idx = perm[s * b:(s + 1) * b]
+                losses.append(self._one_update([a[idx] for a in arrays]))
+            rec = {"epoch": len(self.history) + 1,
+                   "loss": float(np.mean(losses))}
+            for name, fn in self.metrics.items():
+                rec[name] = float(np.asarray(fn(*arrays)))
+            self.history.append(rec)
+        return self.history
 
 
 # ---------------------------------------------------------------------------

@@ -219,7 +219,25 @@ class Estimator:
         self.params, self.state = self.model.init(init_rng, *shapes)
         pending = getattr(self, "_initial_weights", None)
         if pending is not None:
-            self.params, self.state = pending
+            # merge by layer name so a superset (e.g. the full model a
+            # sub-graph was cut from — nn/net.py new_graph) loads cleanly;
+            # layers NOT covered keep random init, which is almost always
+            # a bug on the user's side (renamed layer, wrong checkpoint) —
+            # say so loudly
+            pp, ps = pending
+            if isinstance(pp, dict) and isinstance(self.params, dict):
+                missing = sorted(set(self.params) - set(pp))
+                if missing:
+                    logger.warning(
+                        "initial weights cover %d/%d layers; these keep "
+                        "their RANDOM init: %s", len(pp), len(self.params),
+                        missing)
+                self.params = {k: pp.get(k, v)
+                               for k, v in self.params.items()}
+                self.state = {k: (ps or {}).get(k, v)
+                              for k, v in self.state.items()}
+            else:
+                self.params, self.state = pending
         # place params per strategy; state replicated (small BN buffers);
         # optimizer state takes the matching param shardings explicitly
         # (tx.init's zeros_like would otherwise constant-fold onto one dev).

@@ -103,19 +103,34 @@ def _sync(x) -> None:
 
 
 def _time_steps(step, carry, args, warmup, iters):
+    """Per-step device time via a two-point slope.
+
+    The tunnel's end-sync is a full host round trip (measured p50
+    ~110ms) — including it once in an N-step window inflates every step
+    by sync/N.  Timing two windows (N and 2N) and taking the slope
+    cancels the constant sync exactly while keeping the real pipelined
+    per-dispatch cost in the number (steps serialize through the donated
+    carry, so window time is genuinely N steps of device work)."""
     params, state, opt_state = carry
     for _ in range(warmup):
         params, state, opt_state, loss = step(params, state, opt_state,
                                               *args)
     _sync(loss)
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        # the carry serializes successive steps, so syncing the last
-        # loss transitively waits on every step in the loop
-        params, state, opt_state, loss = step(params, state, opt_state,
-                                              *args)
-    _sync(loss)
-    return time.perf_counter() - t0
+
+    def window(n):
+        nonlocal params, state, opt_state
+        t0 = time.perf_counter()
+        for _ in range(n):
+            params, state, opt_state, loss = step(params, state, opt_state,
+                                                  *args)
+        _sync(loss)
+        return time.perf_counter() - t0
+
+    t1 = window(iters)
+    t2 = window(2 * iters)
+    if t2 > t1:
+        return t2 - t1          # slope over `iters` steps
+    return t1                   # noise guard: fall back to the window
 
 
 # ---------------------------------------------------------------------------
@@ -560,16 +575,24 @@ def _timed_rounds(cases, rounds=3, iters_per_round=8):
     interleaving rounds (A B C A B C ...) exposes every case to the same
     drift and the per-case MIN estimates the least-contended time."""
     best = {name: float("inf") for name in cases}
+
+    def window(thunk, n):
+        r = thunk()
+        t0 = time.perf_counter()
+        for _ in range(n):
+            r = thunk()
+        _sync(r)
+        return time.perf_counter() - t0
+
     for _ in range(rounds):
         for name, thunk in cases.items():
-            r = thunk()
-            t0 = time.perf_counter()
-            for _ in range(iters_per_round):
-                r = thunk()
-            _sync(r)
-            best[name] = min(
-                best[name],
-                (time.perf_counter() - t0) / iters_per_round * 1e3)
+            # two-point slope cancels the constant end-sync round trip
+            # (~110ms on the tunnel) that would otherwise inflate every
+            # case by sync/n
+            t1 = window(thunk, iters_per_round)
+            t2 = window(thunk, 2 * iters_per_round)
+            per = (t2 - t1) if t2 > t1 else t1
+            best[name] = min(best[name], per / iters_per_round * 1e3)
     return {k: round(v, 3) for k, v in best.items()}
 
 
@@ -688,11 +711,19 @@ def bench_serving(n_requests=32, concurrency=8):
     reset_name_scope()
     net = mobilenet(class_num=1000)
     import jax
+
+    from analytics_zoo_tpu.deploy import imagenet_preprocess
+
     params, state = net.init(jax.random.PRNGKey(0))
+    # uint8 wire format: clients ship raw bytes, the chip normalizes
+    # in-program — 4x fewer host→device bytes than float32 (these
+    # numbers ride a ~10MB/s tunnel, so transfer dominates; on a real
+    # TPU host PCIe makes the same path ~1000x cheaper per byte)
     m = InferenceModel.from_keras_net(net, params, state,
+                                      preprocess=imagenet_preprocess(),
                                       batch_buckets=(1, 32))
     rs = np.random.RandomState(0)
-    img = rs.randn(1, 224, 224, 3).astype(np.float32)
+    img = rs.randint(0, 256, (1, 224, 224, 3)).astype(np.uint8)
 
     # single-request latency (p50/p99 over sequential calls)
     m.predict([img])                                  # compile bucket 1
@@ -703,7 +734,8 @@ def bench_serving(n_requests=32, concurrency=8):
         lats.append((time.perf_counter() - t0) * 1e3)
     lats.sort()
     out = {"latency_p50_ms": round(lats[len(lats) // 2], 2),
-           "latency_p99_ms": round(lats[-1], 2)}
+           "latency_p99_ms": round(lats[-1], 2),
+           "wire_format": "uint8+on-device normalize"}
 
     # concurrent throughput through the DynamicBatcher (requests from
     # many threads coalesce into one padded device batch)
@@ -958,8 +990,10 @@ def main():
     for L in (1024, 8192):
         if _remaining() > 60:
             try:
+                # short lengths are cheap per call: more iters per round
+                # or the tunnel's per-dispatch latency drowns the kernel
                 extra[f"attention_l{L}"] = bench_attention(
-                    accel, L=L, iters=12)
+                    accel, L=L, iters=48 if L <= 1024 else 12)
             except Exception as e:
                 extra[f"attention_l{L}_error"] = f"{type(e).__name__}: {e}"
 

@@ -1,0 +1,68 @@
+"""High-dimensional anomaly detection with an autoencoder (reference
+apps/anomaly-detection-hd/anomaly-detection-hd.ipynb): ionosphere-shaped
+tabular data -> min-max scale -> Dense autoencoder trained on
+reconstruction -> flag the rows with the largest reconstruction error.
+
+The reference trained a 2-layer autoencoder (compress rate 0.8, sigmoid
+output, binary_crossentropy) for 2500 epochs; the flow here is identical
+but sized for a CI smoke run.
+"""
+
+import argparse
+
+import numpy as np
+
+from analytics_zoo_tpu import init_zoo_context
+from analytics_zoo_tpu.nn import Input, Model
+from analytics_zoo_tpu.nn.layers.core import Dense
+
+
+def synthetic_ionosphere(n=351, d=34, outlier_rate=0.1, seed=0):
+    """ionosphere.arff-shaped data: inliers on a smooth low-dim manifold,
+    outliers scattered off it (labels only used for evaluation)."""
+    rs = np.random.RandomState(seed)
+    n_out = int(n * outlier_rate)
+    basis = rs.randn(4, d)
+    z = rs.randn(n - n_out, 4)
+    inliers = np.tanh(z @ basis) + 0.05 * rs.randn(n - n_out, d)
+    outliers = rs.uniform(-2, 2, (n_out, d))
+    x = np.concatenate([inliers, outliers]).astype(np.float32)
+    y = np.concatenate([np.zeros(n - n_out), np.ones(n_out)])
+    perm = rs.permutation(n)
+    return x[perm], y[perm].astype(np.int32)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=351)
+    ap.add_argument("--epochs", type=int, default=300)
+    ap.add_argument("--batch-size", type=int, default=100)
+    ap.add_argument("--compress-rate", type=float, default=0.8)
+    args = ap.parse_args()
+
+    init_zoo_context()
+    x, labels = synthetic_ionosphere(args.n)
+    # min-max scale to [0,1] (the notebook's MinMaxScaler + sigmoid output)
+    lo, hi = x.min(axis=0), x.max(axis=0)
+    x = (x - lo) / np.maximum(hi - lo, 1e-9)
+    d = x.shape[1]
+
+    inp = Input(shape=(d,))
+    encoded = Dense(int(args.compress_rate * d), activation="relu")(inp)
+    decoded = Dense(d, activation="sigmoid")(encoded)
+    autoencoder = Model(inp, decoded)
+    autoencoder.compile(optimizer="adam", loss="binary_crossentropy")
+    autoencoder.fit(x, x, batch_size=args.batch_size, epochs=args.epochs,
+                    verbose=False)
+
+    recon = autoencoder.predict(x, batch_size=args.batch_size)
+    err = np.mean((recon - x) ** 2, axis=1)
+    k = int(labels.sum())                      # flag as many as true outliers
+    flagged = np.argsort(-err)[:k]
+    hits = int(labels[flagged].sum())
+    print(f"outliers: {k}; flagged-by-error hits: {hits} "
+          f"(precision@k {hits / max(1, k):.2f})")
+
+
+if __name__ == "__main__":
+    main()

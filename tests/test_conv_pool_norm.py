@@ -301,3 +301,29 @@ class TestSequentialIntegration:
         model.fit(x, y, batch_size=16, nb_epoch=2, verbose=False)
         res = model.evaluate(x, y, batch_size=16)
         assert np.isfinite(res["loss"])
+
+
+def test_space_to_depth_stem_equals_plain_7x7(zoo_ctx):
+    """SpaceToDepthStemConv is bit-compatible with the 7x7/s2 SAME conv
+    it replaces — same (7,7,C,O) param, same outputs (MLPerf stem trick)."""
+    import jax
+    import jax.numpy as jnp
+
+    from analytics_zoo_tpu.nn.layers.convolutional import (
+        Convolution2D, SpaceToDepthStemConv)
+
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.randn(2, 32, 32, 3).astype(np.float32))
+    ref = Convolution2D(64, 7, 7, subsample=(2, 2), border_mode="same",
+                        bias=False)
+    s2d = SpaceToDepthStemConv(64, bias=False)
+    p = ref.build_params(jax.random.PRNGKey(0), (2, 32, 32, 3))
+    a, b = ref._convolve(p, x), s2d._convolve(p, x)
+    assert a.shape == b.shape == (2, 16, 16, 64)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                               rtol=1e-5, atol=1e-5)
+    # odd spatial sizes fall back to the literal conv
+    x_odd = jnp.asarray(rs.randn(1, 15, 15, 3).astype(np.float32))
+    np.testing.assert_allclose(np.asarray(ref._convolve(p, x_odd)),
+                               np.asarray(s2d._convolve(p, x_odd)),
+                               rtol=1e-5, atol=1e-5)

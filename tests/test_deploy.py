@@ -44,6 +44,23 @@ class TestInferenceModel:
         ref = net.predict(x[:10], batch_size=10)
         np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
 
+    def test_on_device_preprocess_uint8_wire(self):
+        """uint8 wire format + on-device normalize == float32 pipeline."""
+        import jax.numpy as jnp
+
+        from analytics_zoo_tpu.deploy import imagenet_preprocess
+
+        net, _ = _trained_net(in_dim=6)
+        raw = np.random.RandomState(1).randint(
+            0, 256, (8, 6)).astype(np.uint8)
+        m = InferenceModel.from_keras_net(
+            net, net.estimator.params, net.estimator.state,
+            preprocess=imagenet_preprocess(dtype=jnp.float32))
+        out = m.predict(raw)
+        ref = net.predict(
+            (raw.astype(np.float32) / 127.5 - 1.0), batch_size=8)
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
     def test_native_load_roundtrip(self, tmp_path):
         from analytics_zoo_tpu.models import NeuralCF
         from analytics_zoo_tpu.nn import reset_name_scope

@@ -426,3 +426,29 @@ class TestGraphNet:
     def test_new_graph_unknown_raises(self):
         with pytest.raises(ValueError, match="unknown"):
             GraphNet(self._model()).new_graph("nope")
+
+    def test_new_graph_carries_trained_weights(self, zoo_ctx):
+        """Cutting a sub-graph from a TRAINED model keeps its weights —
+        both for immediate predict and across a user re-compile
+        (reference newGraph reuses the same weighted graph)."""
+        model = self._model()
+        model.compile(optimizer="adam", loss="sparse_categorical_crossentropy")
+        rs = np.random.RandomState(0)
+        x = rs.randn(64, 6).astype(np.float32)
+        y = (x[:, 0] > 0).astype(np.int32)
+        model.fit(x, y, batch_size=32, nb_epoch=2, verbose=False)
+        trained = np.asarray(model.estimator.params["backbone1"]["kernel"])
+
+        gn = GraphNet(model).new_graph("backbone2")
+        feats = gn.predict(x[:8], batch_size=8)           # no compile needed
+        assert np.asarray(feats).shape == (8, 4)
+        np.testing.assert_allclose(
+            np.asarray(gn.model.estimator.params["backbone1"]["kernel"]),
+            trained, rtol=1e-6)
+
+        # a user re-compile (fine-tune flow) must not lose the weights
+        gn.model.compile(optimizer="adam", loss="mse")
+        gn.model.estimator._ensure_built([x[:8]])
+        np.testing.assert_allclose(
+            np.asarray(gn.model.estimator.params["backbone1"]["kernel"]),
+            trained, rtol=1e-6)

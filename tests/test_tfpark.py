@@ -337,3 +337,83 @@ class TestTorchModel:
         net = torch.nn.Sequential(torch.nn.LSTM(4, 4))
         with pytest.raises(UnsupportedLayerError):
             TorchModel(net)
+
+
+class TestTFGraphOptimizer:
+    """Arbitrary-TF-graph training: TFOptimizer.from_loss/from_train_op
+    (reference tf_optimizer.py:479,556) — a NON-Keras graph with custom
+    variables and a custom loss trains to decreasing loss."""
+
+    def _problem(self, tf, seed=0):
+        rs = np.random.RandomState(seed)
+        x = rs.randn(256, 4).astype(np.float32)
+        w_true = rs.randn(4, 1).astype(np.float32)
+        y = x @ w_true + 0.05 * rs.randn(256, 1).astype(np.float32)
+        w = tf.Variable(tf.zeros([4, 1]), name="w")
+        b = tf.Variable(tf.zeros([1]), name="b")
+
+        def loss_fn(xb, yb):
+            # deliberately not a Keras model: raw matmul + huber-ish loss
+            pred = tf.matmul(xb, w) + b
+            err = yb - pred
+            return tf.reduce_mean(tf.where(tf.abs(err) < 1.0,
+                                           0.5 * err * err,
+                                           tf.abs(err) - 0.5))
+
+        return x, y, w, b, loss_fn
+
+    def test_from_loss_trains(self):
+        tf = pytest.importorskip("tensorflow")
+        from analytics_zoo_tpu.tfpark import TFDataset, TFOptimizer
+        from analytics_zoo_tpu.train.optimizers import Adam
+
+        x, y, w, b, loss_fn = self._problem(tf)
+        opt = TFOptimizer.from_loss(
+            loss_fn, [w, b], optim_method=Adam(1e-1),
+            dataset=TFDataset.from_ndarrays((x, y), batch_size=64),
+            clip_norm=10.0)
+        hist = opt.optimize(epochs=8)
+        assert hist[-1]["loss"] < hist[0]["loss"] * 0.3, hist
+        # the updates really landed back in the TF variables
+        assert float(tf.reduce_max(tf.abs(w))) > 0.1
+
+    def test_from_loss_accepts_tf_module(self):
+        tf = pytest.importorskip("tensorflow")
+        from analytics_zoo_tpu.tfpark import TFDataset, TFOptimizer
+
+        class Lin(tf.Module):
+            def __init__(self):
+                super().__init__()
+                self.w = tf.Variable(tf.zeros([4, 1]))
+
+            def __call__(self, xb):
+                return tf.matmul(xb, self.w)
+
+        rs = np.random.RandomState(1)
+        x = rs.randn(128, 4).astype(np.float32)
+        y = (x @ rs.randn(4, 1)).astype(np.float32)
+        mod = Lin()
+        opt = TFOptimizer.from_loss(
+            lambda xb, yb: tf.reduce_mean((yb - mod(xb)) ** 2), mod,
+            dataset=TFDataset.from_ndarrays((x, y), batch_size=32))
+        hist = opt.optimize(epochs=5)
+        assert hist[-1]["loss"] < hist[0]["loss"]
+
+    def test_from_train_op(self):
+        tf = pytest.importorskip("tensorflow")
+        from analytics_zoo_tpu.tfpark import TFDataset, TFOptimizer
+
+        x, y, w, b, loss_fn = self._problem(tf, seed=2)
+        sgd = tf.keras.optimizers.SGD(0.1)
+
+        def train_op(xb, yb):
+            with tf.GradientTape() as tape:
+                loss = loss_fn(xb, yb)
+            sgd.apply_gradients(zip(tape.gradient(loss, [w, b]), [w, b]))
+            return loss
+
+        opt = TFOptimizer.from_train_op(
+            train_op, dataset=TFDataset.from_ndarrays((x, y),
+                                                      batch_size=64))
+        hist = opt.optimize(epochs=6)
+        assert hist[-1]["loss"] < hist[0]["loss"]
