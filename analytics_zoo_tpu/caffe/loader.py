@@ -221,6 +221,9 @@ _V1_TYPES = {
     "LRN": "LRN", "DROPOUT": "Dropout", "CONCAT": "Concat",
     "ELTWISE": "Eltwise", "FLATTEN": "Flatten", "SPLIT": "Split",
     "DATA": "Data", "ACCURACY": "Accuracy",
+    "POWER": "Power", "ABSVAL": "AbsVal", "BNLL": "BNLL", "EXP": "Exp",
+    "DECONVOLUTION": "Deconvolution", "SLICE": "Slice",
+    "INNERPRODUCT": "InnerProduct",
 }
 
 _SKIP_TYPES = {"Data", "ImageData", "HDF5Data", "DummyData", "MemoryData",
@@ -403,14 +406,172 @@ class _Translator:
     def eltwise(self, name, param, bottoms, top):
         op = str(_one(param, "operation", "SUM")).upper()
         coeffs = [float(c) for c in _many(param, "coeff")]
-        if coeffs and any(c != 1.0 for c in coeffs):
-            raise UnsupportedCaffeLayer("Eltwise with coeff != 1", name)
         onnx_op = {"SUM": "Sum", "1": "Sum", "PROD": "Mul", "0": "Mul",
                    "MAX": "Max", "2": "Max"}.get(op)
         if onnx_op is None:
             raise UnsupportedCaffeLayer(f"Eltwise operation={op}", name)
-        self.node(onnx_op, name, bottoms, [top])
+        ins = list(bottoms)
+        if coeffs and any(c != 1.0 for c in coeffs):
+            if onnx_op != "Sum":
+                raise UnsupportedCaffeLayer(
+                    f"Eltwise coeff with operation={op}", name)
+            if len(coeffs) != len(ins):     # caffe rejects this too
+                raise UnsupportedCaffeLayer(
+                    f"Eltwise: {len(coeffs)} coeffs for {len(ins)} "
+                    "bottoms", name)
+            scaled = []
+            for k, (b, c) in enumerate(zip(ins, coeffs)):
+                cn = self.add_init(f"{name}_coeff{k}",
+                                   np.asarray(c, np.float32))
+                out = self.uid(name)
+                self.node("Mul", f"{name}_scale{k}", [b, cn], [out])
+                scaled.append(out)
+            ins = scaled
+        self.node(onnx_op, name, ins, [top])
         self.shapes[top] = self.shapes[bottoms[0]]
+
+    def _affine(self, name, bottom, scale, shift):
+        """Emit y = scale*x + shift (skipping identity factors); returns
+        the tensor name holding the result."""
+        cur = bottom
+        if scale != 1.0:
+            c = self.add_init(f"{name}_scale", np.asarray(scale, np.float32))
+            out = self.uid(name)
+            self.node("Mul", f"{name}_mul", [cur, c], [out])
+            cur = out
+        if shift != 0.0:
+            c = self.add_init(f"{name}_shift", np.asarray(shift, np.float32))
+            out = self.uid(name)
+            self.node("Add", f"{name}_add", [cur, c], [out])
+            cur = out
+        return cur
+
+    def power(self, name, param, bottom, top):
+        """y = (shift + scale * x) ** power (caffe PowerLayer)."""
+        power = float(_one(param, "power", 1.0))
+        cur = self._affine(name, bottom, float(_one(param, "scale", 1.0)),
+                           float(_one(param, "shift", 0.0)))
+        if power != 1.0:
+            c = self.add_init(f"{name}_pow", np.asarray(power, np.float32))
+            self.node("Pow", name, [cur, c], [top])
+        else:
+            self.node("Identity", name, [cur], [top])
+        self.shapes[top] = self.shapes[bottom]
+
+    def exp_log(self, name, param, bottom, top, kind):
+        """Exp: y = base^(scale*x+shift); Log: y = log_base(scale*x+shift)
+        (base=-1 means e)."""
+        base = float(_one(param, "base", -1.0))
+        cur = self._affine(name, bottom, float(_one(param, "scale", 1.0)),
+                           float(_one(param, "shift", 0.0)))
+        ln_b = 1.0 if base <= 0 else float(np.log(base))
+        if kind == "Exp":
+            if ln_b != 1.0:
+                c = self.add_init(f"{name}_lnb", np.asarray(ln_b, np.float32))
+                out = self.uid(name)
+                self.node("Mul", f"{name}_lnb_mul", [cur, c], [out])
+                cur = out
+            self.node("Exp", name, [cur], [top])
+        else:
+            if ln_b != 1.0:
+                out = self.uid(name)
+                self.node("Log", f"{name}_ln", [cur], [out])
+                c = self.add_init(f"{name}_invlnb",
+                                  np.asarray(1.0 / ln_b, np.float32))
+                self.node("Mul", name, [out, c], [top])
+            else:
+                self.node("Log", name, [cur], [top])
+        self.shapes[top] = self.shapes[bottom]
+
+    def prelu(self, name, param, bottom, top):
+        blobs = self.weights.get(name, [])
+        if not blobs:
+            raise ValueError(f"PReLU layer {name!r} has no slope blob")
+        shape = self.shapes[bottom]
+        slope = blobs[0].reshape(-1).astype(np.float32)
+        if _one(param, "channel_shared", False) or slope.size == 1:
+            sl = slope.reshape(())
+        else:
+            sl = slope.reshape((1, slope.size) + (1,) * (len(shape) - 2))
+        s = self.add_init(f"{name}_slope", sl)
+        self.node("PRelu", name, [bottom, s], [top])
+        self.shapes[top] = shape
+
+    def bias(self, name, param, bottom, top):
+        """Bias layer: add a learned per-channel blob (ScaleLayer minus
+        the multiply)."""
+        blobs = self.weights.get(name, [])
+        if not blobs:
+            raise ValueError(f"Bias layer {name!r} has no blob")
+        shape = self.shapes[bottom]
+        c = blobs[0].size
+        b = self.add_init(f"{name}_b", blobs[0].reshape(
+            (1, c) + (1,) * (len(shape) - 2)).astype(np.float32))
+        self.node("Add", name, [bottom, b], [top])
+        self.shapes[top] = shape
+
+    def reshape(self, name, param, bottom, top):
+        dims = [int(d) for d in _many(_one(param, "shape", {}), "dim")]
+        if not dims:
+            raise UnsupportedCaffeLayer("Reshape without shape.dim", name)
+        shp = self.add_init(f"{name}_shape", np.asarray(dims, np.int64))
+        self.node("Reshape", name, [bottom, shp], [top])
+        src = self.shapes[bottom]
+        out = [src[i] if d == 0 else d for i, d in enumerate(dims)]
+        if -1 in out:
+            known = int(np.prod([d for d in out if d != -1]))
+            out[out.index(-1)] = int(np.prod(src)) // max(1, known)
+        self.shapes[top] = tuple(out)
+
+    def slice(self, name, param, bottom, tops):
+        axis = int(_one(param, "axis", _one(param, "slice_dim", 1)))
+        points = [int(p) for p in _many(param, "slice_point")]
+        src = self.shapes[bottom]
+        if points:
+            bounds = [0] + points + [src[axis]]
+            sizes = [bounds[i + 1] - bounds[i]
+                     for i in range(len(bounds) - 1)]
+        else:
+            n = len(tops)
+            if src[axis] % n:
+                raise UnsupportedCaffeLayer(
+                    f"Slice: dim {src[axis]} not divisible by {n}", name)
+            sizes = [src[axis] // n] * n
+        if len(sizes) != len(tops):
+            raise UnsupportedCaffeLayer(
+                f"Slice: {len(sizes)} pieces for {len(tops)} tops", name)
+        self.node("Split", name, [bottom], list(tops),
+                  axis=axis, split=sizes)
+        for t, s in zip(tops, sizes):
+            shp = list(src)
+            shp[axis] = s
+            self.shapes[t] = tuple(shp)
+
+    def deconvolution(self, name, param, bottom, top):
+        blobs = self.weights.get(name)
+        if not blobs:
+            raise ValueError(f"deconv layer {name!r} has no weights")
+        w = blobs[0]                    # caffe: (Cin, Cout, kH, kW)
+        kh, kw = _pair(param, "kernel_size", 0)
+        if kh == 0:
+            kh, kw = w.shape[2], w.shape[3]
+        ph, pw = _pair(param, "pad", 0)
+        sh, sw = _pair(param, "stride", 1)
+        if int(_one(param, "group", 1)) != 1:
+            raise UnsupportedCaffeLayer("Deconvolution group != 1", name)
+        if int(_one(param, "dilation", 1)) != 1:
+            raise UnsupportedCaffeLayer("Deconvolution dilation != 1", name)
+        ins = [bottom, self.add_init(f"{name}_W", w.astype(np.float32))]
+        if _one(param, "bias_term", True) and len(blobs) > 1:
+            ins.append(self.add_init(
+                f"{name}_b", blobs[1].reshape(-1).astype(np.float32)))
+        self.node("ConvTranspose", name, ins, [top],
+                  kernel_shape=[kh, kw], strides=[sh, sw],
+                  pads=[ph, pw, ph, pw])
+        b, c, h, wd = self.shapes[bottom]
+        self.shapes[top] = (b, w.shape[1],
+                            (h - 1) * sh + kh - 2 * ph,
+                            (wd - 1) * sw + kw - 2 * pw)
 
     def lrn(self, name, param, bottom, top):
         region = str(_one(param, "norm_region", "ACROSS_CHANNELS")).upper()
@@ -534,6 +695,37 @@ def load_caffe_parts(prototxt_text: str, caffemodel: bytes) -> OnnxProgram:
             for t in tops:
                 tr.node("Identity", f"{name}_{t}", [bottom], [t])
                 tr.shapes[t] = tr.shapes[bottom]
+        elif ltype == "Eltwise":
+            tr.eltwise(name, _one(ld, "eltwise_param", {}), bottoms, top)
+        elif ltype == "Power":
+            tr.power(name, _one(ld, "power_param", {}), bottom, top)
+        elif ltype == "Exp":
+            tr.exp_log(name, _one(ld, "exp_param", {}), bottom, top,
+                       kind="Exp")
+        elif ltype == "Log":
+            tr.exp_log(name, _one(ld, "log_param", {}), bottom, top,
+                       kind="Log")
+        elif ltype == "AbsVal":
+            tr.node("Abs", name, [bottom], [top])
+            tr.shapes[top] = tr.shapes[bottom]
+        elif ltype == "BNLL":
+            tr.node("Softplus", name, [bottom], [top])
+            tr.shapes[top] = tr.shapes[bottom]
+        elif ltype == "ELU":
+            alpha = float(_one(_one(ld, "elu_param", {}), "alpha", 1.0))
+            tr.node("Elu", name, [bottom], [top], alpha=alpha)
+            tr.shapes[top] = tr.shapes[bottom]
+        elif ltype == "PReLU":
+            tr.prelu(name, _one(ld, "prelu_param", {}), bottom, top)
+        elif ltype == "Bias":
+            tr.bias(name, _one(ld, "bias_param", {}), bottom, top)
+        elif ltype == "Reshape":
+            tr.reshape(name, _one(ld, "reshape_param", {}), bottom, top)
+        elif ltype == "Slice":
+            tr.slice(name, _one(ld, "slice_param", {}), bottom, tops)
+        elif ltype == "Deconvolution":
+            tr.deconvolution(name, _one(ld, "convolution_param", {}),
+                             bottom, top)
         else:
             raise UnsupportedCaffeLayer(ltype, name)
 

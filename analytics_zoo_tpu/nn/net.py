@@ -176,17 +176,15 @@ class GraphNet:
         # carry trained weights into the sub-graph (reference newGraph
         # reuses the SAME weighted graph): compile the sub lazily for
         # inference and seed it with the source model's current params
-        src_est = getattr(self.model, "_estimator", None)
-        if src_est is not None and src_est.params is not None:
+        from analytics_zoo_tpu.nn.topology import _carry_weights
+
+        carried = _carry_weights(getattr(self.model, "_estimator", None))
+        if carried is not None:
             # sgd is stateless: no optimizer moments allocated for what is
             # typically an inference-only feature extractor (re-compiling
             # for fine-tuning keeps these weights — topology.compile)
             sub.compile(optimizer="sgd", loss="mse")
-            import jax as _jax
-
-            params = _jax.device_get(src_est.params)
-            state = _jax.device_get(src_est.state or {})
-            sub.estimator.set_initial_weights(params, state)
+            sub.estimator.set_initial_weights(*carried)
         return g
 
     # -- passthrough ------------------------------------------------------

@@ -21,6 +21,17 @@ from analytics_zoo_tpu.nn.autograd import Variable, evaluate, topo_sort
 from analytics_zoo_tpu.nn.module import Layer, split_rng
 
 
+def _carry_weights(est):
+    """(params, state) worth carrying from an estimator: live params if
+    built, else its still-pending initial weights; None otherwise."""
+    if est is None:
+        return None
+    if est.params is not None:
+        return (jax.device_get(est.params), jax.device_get(est.state or {}))
+    pending = getattr(est, "_initial_weights", None)
+    return pending
+
+
 class KerasNet(Layer):
     """Shared compile/fit/evaluate/predict facade for Sequential and Model."""
 
@@ -49,21 +60,31 @@ class KerasNet(Layer):
                                     grad_accum_steps=grad_accum_steps)
         # re-compiling must NOT lose weights: carry the previous
         # estimator's live params (or its still-pending initial weights —
-        # e.g. a sub-graph seeded by nn/net.py new_graph) forward
-        if prev is not None:
-            import jax as _jax
-
-            if prev.params is not None:
-                self._estimator.set_initial_weights(
-                    _jax.device_get(prev.params),
-                    _jax.device_get(prev.state or {}))
-            elif getattr(prev, "_initial_weights", None) is not None:
-                self._estimator.set_initial_weights(*prev._initial_weights)
+        # e.g. a sub-graph seeded by nn/net.py new_graph) forward;
+        # weights staged via set_initial_weights before the first compile
+        # take priority
+        carried = _carry_weights(prev)
+        if getattr(self, "_pending_init", None) is not None:
+            carried = self._pending_init
+            self._pending_init = None
+        if carried is not None:
+            self._estimator.set_initial_weights(*carried)
         # apply settings made before compile()
         if getattr(self, "_tb_dir", None):
             self._estimator.set_tensorboard(self._tb_dir)
         if getattr(self, "_ckpt_dir", None):
             self._estimator.set_checkpoint(self._ckpt_dir)
+        return self
+
+    def set_initial_weights(self, params, state=None):
+        """Seed weights by layer name (e.g. layers shared with a trained
+        model — a new head over a cut backbone).  Works before or after
+        compile(); unknown layer names are ignored, uncovered layers warn
+        at build (estimator._ensure_built)."""
+        if self._estimator is not None:
+            self._estimator.set_initial_weights(params, state or {})
+        else:
+            self._pending_init = (params, state or {})
         return self
 
     @property

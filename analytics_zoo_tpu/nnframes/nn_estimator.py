@@ -171,6 +171,15 @@ class NNEstimator(_Params):
 
         opt = self.optimizer or Adam(lr=self.learning_rate)
         est = Estimator(self.model, optimizer=opt, loss=self.criterion)
+        # transfer-learning flows hand NNEstimator a model whose layers
+        # already carry weights (trained/loaded/staged) — seed them
+        # instead of random-initialising silently
+        from analytics_zoo_tpu.nn.topology import _carry_weights
+
+        carried = _carry_weights(getattr(self.model, "_estimator", None)) \
+            or getattr(self.model, "_pending_init", None)
+        if carried is not None:
+            est.set_initial_weights(*carried)
         if self.checkpoint_path:
             est.set_checkpoint(self.checkpoint_path)
         if self.tensorboard_dir:

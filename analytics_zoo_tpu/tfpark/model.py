@@ -313,7 +313,10 @@ class TFGraphOptimizer:
                 f"loss_fn produces no gradient for variable(s) {dead} — "
                 "they are not used in the loss; drop them from the "
                 "variable list")
-        gs = [jnp.asarray(np.asarray(g)) for g in grads]
+        tf = self._tf
+        # embedding_lookup/gather grads arrive as tf.IndexedSlices
+        gs = [jnp.asarray(np.asarray(tf.convert_to_tensor(g)))
+              for g in grads]
         if self._clip_value is not None:
             c = float(self._clip_value)
             gs = [jnp.clip(g, -c, c) for g in gs]

@@ -334,6 +334,12 @@ def bench_ncf_convergence(epochs=12, batch=2048, n_users=6040, n_items=3706,
     samples = len(tr_y) * epochs
     return {"hitrate_at_10": round(hr10, 4),
             "oracle_hitrate_at_10": round(oracle_hr10, 4),
+            # r4 measured ceiling for ANY learner on this data: MAP user
+            # estimation GIVEN the true item factors + generative link
+            # reaches 0.9625 from 50 positives/user — the 0.975 oracle
+            # needs exact latent knowledge no training set conveys
+            # (docs/PERFORMANCE.md "the 0.975 oracle is not reachable").
+            "practical_bound_hr10": 0.9625,
             "train_samples_per_sec": round(samples / train_s, 1),
             "train_samples": samples}
 

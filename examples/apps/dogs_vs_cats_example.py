@@ -113,6 +113,13 @@ def main():
         full = Model(net.model.inputs,
                      head(net.model.outputs[0]))
         full._frozen = net.model._frozen             # frozen set carries over
+        # seed the composed model with the PRETRAINED backbone weights
+        import jax
+
+        full.set_initial_weights(jax.device_get(pre.estimator.params),
+                                 jax.device_get(pre.estimator.state))
+        pretrained_w = np.asarray(
+            pre.estimator.params["feat1_conv"]["kernel"])
 
         clf = (NNClassifier(full)
                .setFeaturesCol("features")
@@ -120,9 +127,14 @@ def main():
                .setBatchSize(args.batch_size)
                .setMaxEpoch(args.epochs))
         fitted = clf.fit(train_df)
+        # the frozen pretrained backbone really survived fine-tuning
+        kept = np.allclose(
+            np.asarray(fitted.estimator.params["feat1_conv"]["kernel"]),
+            pretrained_w)
         pred = fitted.transform(val_df)
         acc = float((pred["prediction"].to_numpy()
                      == val_df["label"].to_numpy()).mean())
+        print(f"frozen pretrained backbone intact: {kept}")
         print(f"transfer-learning val accuracy: {acc:.3f} "
               f"({len(val_df)} images)")
 
