@@ -233,12 +233,24 @@ class SearchEngine:
 
     def __init__(self, search_space: Dict[str, Any], metric_mode: str = "min",
                  num_samples: int = 1, max_parallel: int = 1, seed: int = 42,
-                 search_alg: str = "random", backend: str = "thread",
+                 search_alg: Any = "random", backend: str = "thread",
                  n_startup: Optional[int] = None):
         """``search_alg``: "random" (i.i.d. sampling, grid dims expanded
-        exhaustively) or "tpe" (sequential model-based, search/tpe.py).
-        ``backend``: "thread" (default — trials are jitted programs that
-        release the GIL) or "process" (host-heavy picklable trainables).
+        exhaustively), "tpe" (sequential model-based, search/tpe.py), or
+        ANY object with ``propose(history) -> config`` (the pluggable
+        hook — history is a list of (raw_config, score) pairs).
+        ``backend``:
+          - "thread" (default): trials are jitted programs that release
+            the GIL;
+          - "process": host-heavy picklable trainables;
+          - "device": thread pool with each trial PINNED to a mesh
+            device round-robin (``jax.default_device``) — K trials run
+            on K devices concurrently, the TPU-native replacement for
+            the reference's Ray-actor scale-out
+            (RayTuneSearchEngine.py:28);
+          - "vmap": the whole population is ONE vmapped jitted program
+            (search/population.py) — the trainable must be a pure
+            jax-traceable ``fn(numeric_cfg, **shared) -> score``.
         ``n_startup``: random trials before TPE kicks in.
         """
         self.search_space = search_space
@@ -276,6 +288,10 @@ class SearchEngine:
             else float("inf")
         one = functools.partial(_run_one_trial, trainable, fail_score)
 
+        if self.backend == "vmap":
+            return self._run_vmap(trainable, configs, fail_score)
+        if self.backend == "device":
+            return self._run_device(one, configs)
         if self.max_parallel == 1 or len(configs) == 1:
             return [one(c) for c in configs]
         if self.backend == "process":
@@ -294,9 +310,68 @@ class SearchEngine:
         with cf.ThreadPoolExecutor(self.max_parallel) as pool:
             return list(pool.map(one, configs))
 
+    def _run_device(self, one, configs) -> List[TrialResult]:
+        """Round-robin trial→device placement over the mesh: K
+        concurrent trials occupy K devices (each trial's jitted programs
+        compile and run on its pinned device via jax.default_device)."""
+        import jax
+
+        from analytics_zoo_tpu.core.context import get_zoo_context
+
+        devices = list(get_zoo_context().mesh.devices.flat)
+        par = min(self.max_parallel, len(devices)) or 1
+
+        def pinned(i_cfg):
+            i, cfg = i_cfg
+            dev = devices[i % len(devices)]
+            with jax.default_device(dev):
+                r = one(cfg)
+            r.extra.setdefault("device", str(dev))
+            return r
+
+        if par == 1 or len(configs) == 1:
+            return [pinned(ic) for ic in enumerate(configs)]
+        with cf.ThreadPoolExecutor(par) as pool:
+            return list(pool.map(pinned, enumerate(configs)))
+
+    def _run_vmap(self, trainable, configs, fail_score) -> List[TrialResult]:
+        """Population-as-a-batch: every config in ONE vmapped program
+        (search/population.py).  Grid/structural keys must agree within
+        a batch; configs are grouped by their structural signature and
+        each group runs as one dispatch."""
+        from analytics_zoo_tpu.automl.search.population import (
+            is_numeric_hparam, vmapped_trials)
+
+        configs = [finalize_config(c) for c in configs]
+        # group by structural signature (same predicate split_config
+        # uses, so numpy scalars batch together instead of fragmenting)
+        groups: Dict[Any, List[int]] = {}
+        for i, c in enumerate(configs):
+            sig = tuple(sorted((k, str(v)) for k, v in c.items()
+                               if not is_numeric_hparam(v)))
+            groups.setdefault(sig, []).append(i)
+        results: List[Optional[TrialResult]] = [None] * len(configs)
+        for idxs in groups.values():
+            batch = [configs[i] for i in idxs]
+            try:
+                scores = vmapped_trials(trainable, batch)
+            except Exception as e:
+                logger.warning("vmapped batch failed (%s); scoring as "
+                               "failed", e)
+                for i in idxs:
+                    results[i] = TrialResult(configs[i], fail_score,
+                                             {"error": str(e)})
+                continue
+            for i, s in zip(idxs, scores):
+                results[i] = TrialResult(configs[i], float(s))
+        return list(results)
+
     def run(self, trainable: Callable[[Dict[str, Any]], Any]
             ) -> List[TrialResult]:
-        if self.search_alg in ("tpe", "bayes", "bayesopt"):
+        if hasattr(self.search_alg, "propose"):
+            self.results = self._run_tpe(trainable,
+                                         sampler=self.search_alg)
+        elif self.search_alg in ("tpe", "bayes", "bayesopt"):
             self.results = self._run_tpe(trainable)
         else:
             self.results = self._run_batch(trainable, self._configs())
@@ -305,7 +380,7 @@ class SearchEngine:
                         len(self.results), r.metric)
         return self.results
 
-    def _run_tpe(self, trainable) -> List[TrialResult]:
+    def _run_tpe(self, trainable, sampler=None) -> List[TrialResult]:
         """Sequential model-based search in rounds of ``max_parallel``:
         propose a batch from the TPE sampler, evaluate concurrently,
         feed the scores back.  Proposals are drawn sequentially from one
@@ -314,11 +389,12 @@ class SearchEngine:
         worker scheduling (within a batch, later proposals don't see
         batch-mates' scores — the standard batching tradeoff)."""
         budget = self._budget()
-        sampler = TPESampler(
-            self.search_space, mode=self.metric_mode,
-            n_startup=self.n_startup if self.n_startup is not None
-            else max(4, budget // 4),
-            seed=self.seed)
+        if sampler is None:
+            sampler = TPESampler(
+                self.search_space, mode=self.metric_mode,
+                n_startup=self.n_startup if self.n_startup is not None
+                else max(4, budget // 4),
+                seed=self.seed)
         results: List[TrialResult] = []
         history: List = []
         while len(results) < budget:

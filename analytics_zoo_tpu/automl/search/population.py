@@ -1,0 +1,106 @@
+"""Vmapped trial populations: N hyper-parameter configs as ONE program.
+
+The reference scaled tuning out with Ray actors over a cluster
+(RayTuneSearchEngine.py:28).  The TPU-native equivalent for numeric
+hyper-parameters is to make the POPULATION a batch dimension: stack the
+configs, ``jax.vmap`` the whole training function over them, and let
+XLA turn N tiny trainings into batched MXU work — one dispatch, no
+per-trial dispatch latency, and the mesh's data axis can shard the
+population (trials ride devices with zero orchestration).
+
+Constraints are the honest vmap ones: every config must share shapes
+(structural params — layer sizes, seq lens — are fixed per call;
+numeric params — lr, dropout, init scale, regularization — vary).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Sequence, Tuple
+
+import numpy as np
+
+
+def is_numeric_hparam(v: Any) -> bool:
+    """The ONE numeric-vs-structural predicate (shared with the engine's
+    vmap grouping so they cannot disagree).  bools are structural: a
+    traced bool breaks Python truth tests inside the trainable."""
+    return (isinstance(v, (int, float, np.floating, np.integer))
+            and not isinstance(v, (bool, np.bool_)))
+
+
+def split_config(configs: Sequence[Dict[str, Any]]):
+    """Split configs into (stacked numeric leaves, shared structural).
+
+    Numeric keys that vary across the population become stacked arrays
+    (ints stay integer dtype); keys whose value is identical stay
+    scalar/structural.  Raises if a non-numeric key differs (vmap cannot
+    trace shape-changing params).
+    """
+    keys = set()
+    for c in configs:
+        keys.update(c)
+    stacked: Dict[str, np.ndarray] = {}
+    shared: Dict[str, Any] = {}
+    for k in sorted(keys):
+        vals = [c.get(k) for c in configs]
+        same = all(v == vals[0] for v in vals[1:]) if len(vals) > 1 else True
+        if same:
+            shared[k] = vals[0]
+        elif all(is_numeric_hparam(v) for v in vals):
+            if all(isinstance(v, (int, np.integer)) for v in vals):
+                # keep integer semantics — but note a traced int cannot
+                # size a shape; structural ints must be constant
+                stacked[k] = np.asarray(vals, np.int32)
+            else:
+                stacked[k] = np.asarray(vals, np.float32)
+        else:
+            raise ValueError(
+                f"config key {k!r} varies across the population but is "
+                f"not numeric ({vals[:3]}...); structural params must be "
+                "constant within one vmapped batch — group configs by "
+                "structure first (see SearchEngine backend='vmap')")
+    return stacked, shared
+
+
+# one compiled program per (train_fn, stacked keys, shared config): the
+# jit wrapper must be REUSED or every batch re-traces and recompiles
+_JIT_CACHE: Dict[Tuple, Any] = {}
+
+
+def _compiled(train_fn, stacked_keys: Tuple[str, ...],
+              shared: Dict[str, Any]):
+    import jax
+
+    key = (id(train_fn), stacked_keys,
+           tuple(sorted((k, repr(v)) for k, v in shared.items())))
+    fn = _JIT_CACHE.get(key)
+    if fn is None:
+        def one(leaves):
+            return train_fn(leaves, **shared)
+
+        fn = jax.jit(jax.vmap(one))
+        _JIT_CACHE[key] = fn
+    return fn
+
+
+def vmapped_trials(train_fn: Callable[..., Any],
+                   configs: Sequence[Dict[str, Any]],
+                   ) -> List[float]:
+    """Run ``train_fn(numeric_cfg_dict, **shared) -> scalar score`` for
+    every config as one vmapped jitted call; returns per-trial scores.
+
+    ``train_fn`` must be a pure jax-traceable function of the numeric
+    config leaves (each a scalar inside the trace).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    stacked, shared = split_config(list(configs))
+    if not stacked:
+        # degenerate population: one trace, N identical results
+        score = jax.jit(lambda: jnp.asarray(train_fn({}, **shared)))()
+        return [float(score)] * len(configs)
+
+    fn = _compiled(train_fn, tuple(sorted(stacked)), shared)
+    scores = fn(dict(stacked))
+    return [float(s) for s in np.asarray(scores)]

@@ -230,18 +230,24 @@ def _run_block_stack(block, n_block, blocks_params, x, training, rng,
     if pipe is not None:
         from analytics_zoo_tpu.parallel.pipeline import pipeline_apply
 
-        if mask is not None:
-            raise ValueError(
-                "pipeline parallelism supports single-activation stages "
-                "only — an attention mask cannot ride the ppermute ring; "
-                "drop the mask input or use sharding='dp'/'tp'")
+        if mask is None:
+            def stage(p, h):
+                return block.forward(p, h, training=False, rng=None)
 
-        def stage(p, h):
-            return block.forward(p, h, training=False, rng=None)
+            return pipeline_apply(stage, blocks_params, x, pipe.mesh,
+                                  pipe.axis, pipe.n_microbatches,
+                                  pipe.remat, batch_axis=pipe.batch_axis)
 
-        return pipeline_apply(stage, blocks_params, x, pipe.mesh,
+        # masked pp: the mask is an aux side input — it never rides the
+        # ppermute ring; every stage indexes the microbatch matching the
+        # activation it holds (parallel/pipeline.py pipeline_spmd)
+        def stage_m(p, h, m):
+            return block.forward(p, h, m, training=False, rng=None)
+
+        return pipeline_apply(stage_m, blocks_params, x, pipe.mesh,
                               pipe.axis, pipe.n_microbatches,
-                              pipe.remat, batch_axis=pipe.batch_axis)
+                              pipe.remat, batch_axis=pipe.batch_axis,
+                              aux=mask)
 
     def apply(p, h, r):
         args = (h,) if mask is None else (h, mask)

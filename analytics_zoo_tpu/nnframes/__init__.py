@@ -18,6 +18,8 @@ pipelines port 1:1.
 from analytics_zoo_tpu.nnframes.nn_estimator import (NNClassifier,
                                                      NNClassifierModel,
                                                      NNEstimator, NNModel)
+from analytics_zoo_tpu.nnframes.pipeline import (  # noqa: F401
+    Pipeline, PipelineModel)
 from analytics_zoo_tpu.nnframes.nn_image_reader import (NNImageReader,
                                                         NNImageSchema)
 

@@ -1,0 +1,74 @@
+"""Spark-ML-shaped Pipeline over DataFrames (pandas in, pandas out).
+
+Reference capability: NNEstimator/NNClassifier participating in
+``pyspark.ml.Pipeline`` stages (apps/dogs-vs-cats, image-similarity —
+``Pipeline(stages=[...]).fit(df)``).  The shim keeps the Spark ML
+contract — estimator stages are ``fit`` into transformer models in
+order, each transformer feeding the next stage's input — so reference
+pipeline code ports by changing only the import.
+
+A stage is anything with either ``fit(df) -> transformer`` (estimator)
+or ``transform(df) -> df`` (transformer).  Plain-callable stages
+(``df -> df``) are wrapped as transformers for feature-prep lambdas.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Sequence
+
+
+class _FnTransformer:
+    """A bare ``df -> df`` callable as a pipeline transformer."""
+
+    def __init__(self, fn: Callable):
+        self.fn = fn
+
+    def transform(self, df):
+        return self.fn(df)
+
+
+class PipelineModel:
+    """Fitted pipeline: transformers applied in order
+    (pyspark.ml.PipelineModel contract)."""
+
+    def __init__(self, stages: Sequence[Any]):
+        self.stages = list(stages)
+
+    def transform(self, df):
+        for s in self.stages:
+            df = s.transform(df)
+        return df
+
+
+class Pipeline:
+    """Ordered stages; ``fit`` trains estimator stages in sequence on
+    the progressively-transformed DataFrame (pyspark.ml.Pipeline
+    contract)."""
+
+    def __init__(self, stages: Sequence[Any]):
+        self.stages = list(stages)
+
+    def fit(self, df) -> PipelineModel:
+        fitted: List[Any] = []
+        cur = df
+        last = len(self.stages) - 1
+        for i, s in enumerate(self.stages):
+            if callable(s) and not hasattr(s, "fit") \
+                    and not hasattr(s, "transform"):
+                s = _FnTransformer(s)
+            if hasattr(s, "fit"):
+                model = s.fit(cur)
+                fitted.append(model)
+                # pyspark.ml contract: only transform when a LATER stage
+                # needs the output (skips a full inference pass over the
+                # training set for the canonical NN-last layout)
+                if i != last:
+                    cur = model.transform(cur)
+            elif hasattr(s, "transform"):
+                fitted.append(s)
+                if i != last:
+                    cur = s.transform(cur)
+            else:
+                raise TypeError(
+                    f"pipeline stage {s!r} has neither fit nor transform")
+        return PipelineModel(fitted)

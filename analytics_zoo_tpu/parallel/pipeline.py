@@ -51,7 +51,7 @@ StageFn = Callable[[Any, jax.Array], jax.Array]
 
 def pipeline_spmd(stage_fn: StageFn, stage_params, x, axis_name: str,
                   n_microbatches: int, remat: bool = False,
-                  vary_axes=None):
+                  vary_axes=None, aux=None):
     """Per-device body — call inside shard_map/pjit with ``axis_name``.
 
     ``stage_params``: this device's stage slice, leading dim 1 (the shard
@@ -62,6 +62,12 @@ def pipeline_spmd(stage_fn: StageFn, stage_params, x, axis_name: str,
     final psum.  ``vary_axes``: all shard_map axes the scan carries are
     device-varying over — pass ``(pipe, data)`` when composing with a
     data axis (defaults to ``(axis_name,)``).
+    ``aux``: optional pytree of per-row side inputs (leading dim B —
+    attention masks, segment ids) consumed by EVERY stage alongside its
+    activation.  Aux never rides the ppermute ring: it is replicated
+    over the pipe axis, and stage ``s`` at tick ``t`` indexes microbatch
+    ``t - s`` directly (the one whose activation it holds), so
+    ``stage_fn(params, x, aux)`` sees matched pairs.
     """
     S = lax.psum(1, axis_name)
     s = lax.axis_index(axis_name)
@@ -73,6 +79,8 @@ def pipeline_spmd(stage_fn: StageFn, stage_params, x, axis_name: str,
     if B % M:
         raise ValueError(f"batch {B} not divisible by n_microbatches {M}")
     mb = x.reshape((M, B // M) + x.shape[1:])
+    aux_mb = jax.tree_util.tree_map(
+        lambda a: a.reshape((M, B // M) + a.shape[1:]), aux)
 
     perm = [(i, (i + 1) % S) for i in range(S)]
     vary = vary_axes or (axis_name,)
@@ -86,7 +94,15 @@ def pipeline_spmd(stage_fn: StageFn, stage_params, x, axis_name: str,
         inj = lax.dynamic_index_in_dim(mb, jnp.clip(t, 0, M - 1), 0,
                                        keepdims=False)
         state = jnp.where(s == 0, inj, state)
-        out = fn(local, state)
+        if aux is None:
+            out = fn(local, state)
+        else:
+            # the microbatch whose activation this stage holds at tick t
+            ai = jnp.clip(t - s, 0, M - 1)
+            aux_t = jax.tree_util.tree_map(
+                lambda a: lax.dynamic_index_in_dim(a, ai, 0,
+                                                   keepdims=False), aux_mb)
+            out = fn(local, state, aux_t)
         # last stage emits microbatch t-(S-1) once the pipeline is full
         oi = t - (S - 1)
         upd = lax.dynamic_update_index_in_dim(
@@ -107,17 +123,20 @@ def pipeline_spmd(stage_fn: StageFn, stage_params, x, axis_name: str,
 
 def pipeline_apply(stage_fn: StageFn, stacked_params, x, mesh: Mesh,
                    axis_name: str = "pipe", n_microbatches: int = 4,
-                   remat: bool = False, batch_axis: str = None):
+                   remat: bool = False, batch_axis: str = None, aux=None):
     """Run a homogeneous stage stack as a pipeline over ``mesh[axis_name]``.
 
     ``stacked_params``: pytree whose leaves have leading dim
     ``n_stages == mesh axis size`` (stage i's weights at index i).
-    ``x``: (B, ...) batch.  Shape-preserving ``stage_fn(params, x) -> x``.
+    ``x``: (B, ...) batch.  Shape-preserving ``stage_fn(params, x) -> x``
+    — or ``stage_fn(params, x, aux_microbatch)`` when ``aux`` is given.
 
     ``batch_axis``: compose pp×dp — shard the batch dim over this mesh
     axis; each data group runs its own pipeline over its pipe ring (the
     per-group microbatch count is still ``n_microbatches``, so the local
     B/dp must divide by it).
+    ``aux``: pytree of (B, ...) side inputs (attention masks etc.) every
+    stage reads alongside its activation — see ``pipeline_spmd``.
     """
     sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
     if axis_name not in sizes:
@@ -139,9 +158,15 @@ def pipeline_apply(stage_fn: StageFn, stacked_params, x, mesh: Mesh,
                              axis_name=axis_name,
                              n_microbatches=n_microbatches, remat=remat,
                              vary_axes=vary)
-    fn = shard_map(lambda ps, xs: body(ps, xs), mesh=mesh,
-                   in_specs=(param_specs, x_spec), out_specs=x_spec)
-    return fn(stacked_params, x)
+    if aux is None:
+        fn = shard_map(lambda ps, xs: body(ps, xs), mesh=mesh,
+                       in_specs=(param_specs, x_spec), out_specs=x_spec)
+        return fn(stacked_params, x)
+    aux_specs = jax.tree_util.tree_map(lambda a: x_spec, aux)
+    fn = shard_map(lambda ps, xs, au: body(ps, xs, aux=au), mesh=mesh,
+                   in_specs=(param_specs, x_spec, aux_specs),
+                   out_specs=x_spec)
+    return fn(stacked_params, x, aux)
 
 
 def stack_stage_params(params_list):

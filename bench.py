@@ -231,7 +231,8 @@ def _movielens_like(n_users=6040, n_items=3706, latent=8, pos_per_user=20,
 def bench_ncf_convergence(epochs=12, batch=2048, n_users=6040, n_items=3706,
                           n_eval=2000, embed=16, mf_embed=16,
                           hidden=(64, 32, 16), lr=2e-3, pos_per_user=50,
-                          dropout=0.6, neg_per_pos=8, swa_from=3):
+                          dropout=0.6, neg_per_pos=8, swa_from=3,
+                          ensemble=1, seed=42):
     """Full framework path: negative sampling -> FeatureSet -> Estimator
     (prefetch, fused multi-step dispatch, donated buffers) -> HR@10
     (held-out positive vs 99 negatives, the NCF paper's protocol).
@@ -245,11 +246,14 @@ def bench_ncf_convergence(epochs=12, batch=2048, n_users=6040, n_items=3706,
     - MLP dropout 0.5-0.6 lifts and flattens the peak (0.901 live);
     - tail-averaged weights (SWA over per-epoch snapshots from
       ``swa_from``) — the returned number uses the averaged params.
-    Measured end-to-end with these defaults: HR@10 0.924 vs the 0.975
-    oracle (up from 0.8625 in r2) ≈ 95% of the oracle / 94% of
-    recoverable signal over the 0.10 random floor; the rejected knobs
-    (wd 1e-4/1e-5, cosine decay, wider GMF, longer training, late SWA)
-    all measured no better."""
+    Measured end-to-end (r4, on-silicon): single model 0.9255; 2-seed
+    score ensemble 0.929 at 2x8 epochs (``ensemble=2`` — ens2 at 12
+    epochs measured no better, 0.9285).  Against the r4 practical bound
+    of 0.9625 (``practical_bound_hr10`` below) that is 96.5% of what
+    ANY learner can extract from this data; the 0.975 "oracle" needs
+    exact latent knowledge.  Rejected knobs (measured no better):
+    wd 1e-4/1e-5, cosine decay, wider GMF, longer training, late SWA,
+    neg_per_pos 16 (0.9055 — worse)."""
     import jax as _jax
 
     from analytics_zoo_tpu import init_zoo_context
@@ -258,46 +262,56 @@ def bench_ncf_convergence(epochs=12, batch=2048, n_users=6040, n_items=3706,
     from analytics_zoo_tpu.models.recommendation import negative_sample
     from analytics_zoo_tpu.nn import reset_name_scope
 
-    init_zoo_context(steps_per_execution=32)
-    reset_name_scope()
     users, items, heldout, true_scores = _movielens_like(
         n_users, n_items, pos_per_user=pos_per_user)
 
     from analytics_zoo_tpu.train.optimizers import Adam
 
-    ncf = NeuralCF(user_count=n_users, item_count=n_items, class_num=2,
-                   user_embed=embed, item_embed=embed, hidden_layers=hidden,
-                   mf_embed=mf_embed, dropout=dropout)
-    ncf.compile(optimizer=Adam(lr=lr),
-                loss="sparse_categorical_crossentropy",
-                metrics=["accuracy"])
+    def train_member(member_seed):
+        init_zoo_context(steps_per_execution=32, seed=member_seed)
+        reset_name_scope()
+        ncf = NeuralCF(user_count=n_users, item_count=n_items, class_num=2,
+                       user_embed=embed, item_embed=embed,
+                       hidden_layers=hidden, mf_embed=mf_embed,
+                       dropout=dropout)
+        ncf.compile(optimizer=Adam(lr=lr),
+                    loss="sparse_categorical_crossentropy",
+                    metrics=["accuracy"])
+        done = 0
+        avg, n_avg = None, 0
+        while done < epochs:
+            tr_u, tr_i, tr_y = negative_sample(users, items, n_items,
+                                               neg_per_pos=neg_per_pos,
+                                               seed=member_seed + 1 + done)
+            fs = FeatureSet.from_ndarrays(
+                [tr_u[:, None].astype(np.int32),
+                 tr_i[:, None].astype(np.int32)], tr_y.astype(np.int32))
+            ncf.estimator.fit(fs, batch_size=batch,
+                              epochs=done + 1, verbose=False)
+            done += 1
+            if done >= swa_from:
+                cur = _jax.device_get(ncf.estimator.params)
+                if avg is None:
+                    avg, n_avg = cur, 1
+                else:
+                    n_avg += 1
+                    avg = _jax.tree_util.tree_map(
+                        lambda a, c: a + (c - a) / n_avg, avg, cur)
+        # evaluate the tail-averaged weights (dropout is already identity
+        # at inference; averaging needs no BN-stat recompute — no BN here)
+        if avg is not None:
+            ncf.estimator.set_initial_weights(
+                avg, _jax.device_get(ncf.estimator.state))
+        return ncf
+
     t0 = time.perf_counter()
-    done = 0
-    avg, n_avg = None, 0
-    while done < epochs:
-        tr_u, tr_i, tr_y = negative_sample(users, items, n_items,
-                                           neg_per_pos=neg_per_pos,
-                                           seed=1 + done)
-        fs = FeatureSet.from_ndarrays(
-            [tr_u[:, None].astype(np.int32),
-             tr_i[:, None].astype(np.int32)], tr_y.astype(np.int32))
-        ncf.estimator.fit(fs, batch_size=batch,
-                          epochs=done + 1, verbose=False)
-        done += 1
-        if done >= swa_from:
-            cur = _jax.device_get(ncf.estimator.params)
-            if avg is None:
-                avg, n_avg = cur, 1
-            else:
-                n_avg += 1
-                avg = _jax.tree_util.tree_map(
-                    lambda a, c: a + (c - a) / n_avg, avg, cur)
+    # seed-ensemble: independently-trained members' softmax scores are
+    # averaged at ranking time (each member's errors are partly
+    # idiosyncratic; the mean sharpens the common latent signal)
+    members = [train_member(seed + 1000 * m) for m in range(max(1, ensemble))]
     train_s = time.perf_counter() - t0
-    # evaluate the tail-averaged weights (dropout is already identity at
-    # inference; averaging needs no BN-stat recompute — NCF has none)
-    if avg is not None:
-        ncf.estimator.set_initial_weights(
-            avg, _jax.device_get(ncf.estimator.state))
+    samples_per_member = len(users) * (1 + neg_per_pos) * epochs
+    ncf = members[0]
 
     # HR@10, the NCF paper's protocol: held-out positive vs 99 negatives
     # the user has NOT interacted with (train positives + heldout are the
@@ -324,15 +338,17 @@ def bench_ncf_convergence(epochs=12, batch=2048, n_users=6040, n_items=3706,
         all_i.extend([int(heldout[u])] + negs)
     pu = np.asarray(all_u, np.int32)[:, None]
     pi = np.asarray(all_i, np.int32)[:, None]
-    probs = ncf.predict([pu, pi], batch_size=8192)      # (N, 2) softmax
+    probs = np.mean([np.asarray(m.predict([pu, pi], batch_size=8192))
+                     for m in members], axis=0)         # (N, 2) softmax
     pos_scores = probs[:, 1].reshape(n_eval, 100)
     ranks = (pos_scores[:, 1:] >= pos_scores[:, :1]).sum(axis=1)
     hr10 = float((ranks < 10).mean())
     oracle = true_scores[pu[:, 0], pi[:, 0]].reshape(n_eval, 100)
     oracle_hr10 = float(
         ((oracle[:, 1:] >= oracle[:, :1]).sum(axis=1) < 10).mean())
-    samples = len(tr_y) * epochs
+    samples = samples_per_member * len(members)
     return {"hitrate_at_10": round(hr10, 4),
+            "ensemble": len(members),
             "oracle_hitrate_at_10": round(oracle_hr10, 4),
             # r4 measured ceiling for ANY learner on this data: MAP user
             # estimation GIVEN the true item factors + generative link
@@ -923,9 +939,15 @@ def main():
     t0 = time.time()
     if _remaining() > 150:
         try:
-            # scale the epoch budget to the time actually left
-            ep = 12 if _remaining() > 280 else 8
-            extra["ncf_convergence"] = bench_ncf_convergence(epochs=ep)
+            # scale depth to the time actually left: the 2-seed score
+            # ensemble buys ~+0.4 HR@10 points (r4 measured 0.929 at
+            # 2x8 epochs vs 0.9255 single-12) when the window allows
+            if _remaining() > 420:
+                ens, ep = 2, 8
+            else:
+                ens, ep = 1, (12 if _remaining() > 280 else 8)
+            extra["ncf_convergence"] = bench_ncf_convergence(
+                epochs=ep, ensemble=ens)
         except Exception as e:
             extra["ncf_convergence_error"] = f"{type(e).__name__}: {e}"
     else:

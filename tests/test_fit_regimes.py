@@ -300,10 +300,11 @@ def test_bert_stacked_matches_loop(zoo_ctx):
                                rtol=2e-5, atol=2e-6)
 
 
-def test_bert_stacked_rng_branch_and_pp_mask_guard(zoo_ctx):
+def test_bert_stacked_rng_branch_and_pp_masked_parity(zoo_ctx):
     """The rng-threaded scan branch computes the same function at
-    dropout 0, and a masked BERT under an active pipeline regime is
-    rejected loudly (masks cannot ride the ppermute ring)."""
+    dropout 0, and a MASKED BERT under an active pipeline regime
+    matches the plain forward — the mask goes in as a per-microbatch
+    aux side input (it never rides the ppermute ring)."""
     import jax.numpy as jnp
 
     from analytics_zoo_tpu import init_zoo_context
@@ -313,16 +314,17 @@ def test_bert_stacked_rng_branch_and_pp_mask_guard(zoo_ctx):
                                                  parallel_mode)
 
     rs = np.random.RandomState(0)
-    ids = rs.randint(0, 50, (2, 12)).astype(np.int32)
+    ids = rs.randint(0, 50, (8, 12)).astype(np.int32)
     seg = np.zeros_like(ids)
-    mask = np.ones((2, 12), np.float32)
+    mask = np.ones((8, 12), np.float32)
+    mask[:, 9:] = 0.0                      # real padding, affects output
 
     reset_name_scope()
-    stk = BERT(vocab=50, hidden_size=16, n_block=3, nhead=2,
+    stk = BERT(vocab=50, hidden_size=16, n_block=4, nhead=2,
                intermediate_size=32, max_position_len=32,
                hidden_drop=0.0, attn_drop=0.0, stacked=True)
     p = stk.build_params(jax.random.PRNGKey(0), ids.shape)
-    seq_norng, _ = stk.forward(p, ids, seg, None, mask)
+    seq_norng, pool_norng = stk.forward(p, ids, seg, None, mask)
     seq_rng, _ = stk.forward(p, ids, seg, None, mask, training=True,
                              rng=jax.random.PRNGKey(7))
     np.testing.assert_allclose(np.asarray(seq_norng),
@@ -331,8 +333,14 @@ def test_bert_stacked_rng_branch_and_pp_mask_guard(zoo_ctx):
     ctx = init_zoo_context(mesh_shape=(2, 4), axis_names=("data", "pipe"))
     try:
         with parallel_mode(pipe=PipelineMode(ctx.mesh, "pipe",
+                                             n_microbatches=2,
                                              batch_axis="data")):
-            with pytest.raises(ValueError, match="mask"):
-                stk.forward(p, ids, seg, None, mask)
+            seq_pp, pool_pp = stk.forward(p, ids, seg, None, mask)
+        np.testing.assert_allclose(np.asarray(seq_pp),
+                                   np.asarray(seq_norng),
+                                   rtol=2e-5, atol=2e-5)
+        np.testing.assert_allclose(np.asarray(pool_pp),
+                                   np.asarray(pool_norng),
+                                   rtol=2e-5, atol=2e-5)
     finally:
         init_zoo_context()

@@ -17,6 +17,8 @@ Semantics notes (deliberate divergences from Keras *3*, not bugs):
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.heavy
+
 tf = pytest.importorskip("tensorflow")
 kl = tf.keras.layers
 

@@ -146,3 +146,50 @@ class TestNNImageReader:
         assert df.iloc[0]["data"].shape == (8, 9, 3)
         # origin column keeps provenance
         assert df.iloc[0]["origin"].endswith("a.jpg")
+
+
+class TestPipeline:
+    """Spark-ML Pipeline contract over NNFrames stages (reference apps
+    drove NNEstimator inside pyspark.ml.Pipeline)."""
+
+    def test_pipeline_fit_transform_chain(self):
+        import pandas as pd
+
+        from analytics_zoo_tpu.nn import Sequential
+        from analytics_zoo_tpu.nn.layers.core import Dense
+        from analytics_zoo_tpu.nn.topology import Sequential as Seq
+        from analytics_zoo_tpu.nnframes import NNClassifier, Pipeline
+
+        rs = np.random.RandomState(0)
+        x = rs.randn(256, 6).astype(np.float32)
+        y = (x[:, 0] + x[:, 1] > 0).astype(np.int32)
+        df = pd.DataFrame({"raw": list(x), "label": y})
+
+        # stage 1: a feature-prep transformer (standardize); stage 2: NN
+        class Standardize:
+            def fit(self, df):
+                arr = np.stack(df["raw"].to_numpy())
+                self.mu, self.sd = arr.mean(0), arr.std(0) + 1e-9
+                return self
+
+            def transform(self, df):
+                out = df.copy()
+                out["features"] = [
+                    (np.asarray(v) - self.mu) / self.sd
+                    for v in df["raw"]]
+                return out
+
+        net = Seq()
+        net.add(Dense(16, activation="relu", input_shape=(6,)))
+        net.add(Dense(2, activation="softmax"))
+        from analytics_zoo_tpu.train.optimizers import Adam
+
+        clf = (NNClassifier(net, optimizer=Adam(1e-2))
+               .setFeaturesCol("features")
+               .setLabelCol("label").setBatchSize(64).setMaxEpoch(20))
+
+        model = Pipeline([Standardize(), clf]).fit(df)
+        pred = model.transform(df)
+        acc = float((pred["prediction"].to_numpy() == y).mean())
+        assert acc > 0.9, acc
+        assert "rawPrediction" in pred.columns
