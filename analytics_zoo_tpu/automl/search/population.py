@@ -29,78 +29,102 @@ def is_numeric_hparam(v: Any) -> bool:
 
 
 def split_config(configs: Sequence[Dict[str, Any]]):
-    """Split configs into (stacked numeric leaves, shared structural).
+    """Split configs into (stacked, const_numeric, structural).
 
-    Numeric keys that vary across the population become stacked arrays
-    (ints stay integer dtype); keys whose value is identical stay
-    scalar/structural.  Raises if a non-numeric key differs (vmap cannot
+    The calling convention is VALUE-INDEPENDENT: every numeric key
+    always reaches the trainable inside its cfg dict (varying ones as
+    stacked/vmapped leaves — ints keep integer dtype — constant ones as
+    plain python constants), and ``**structural`` carries only
+    non-numeric keys.  Raises if a non-numeric key differs (vmap cannot
     trace shape-changing params).
     """
     keys = set()
     for c in configs:
         keys.update(c)
     stacked: Dict[str, np.ndarray] = {}
-    shared: Dict[str, Any] = {}
+    const_num: Dict[str, Any] = {}
+    structural: Dict[str, Any] = {}
     for k in sorted(keys):
         vals = [c.get(k) for c in configs]
         same = all(v == vals[0] for v in vals[1:]) if len(vals) > 1 else True
-        if same:
-            shared[k] = vals[0]
-        elif all(is_numeric_hparam(v) for v in vals):
-            if all(isinstance(v, (int, np.integer)) for v in vals):
+        if all(is_numeric_hparam(v) for v in vals):
+            if same:
+                const_num[k] = vals[0]
+            elif all(isinstance(v, (int, np.integer)) for v in vals):
                 # keep integer semantics — but note a traced int cannot
                 # size a shape; structural ints must be constant
                 stacked[k] = np.asarray(vals, np.int32)
             else:
                 stacked[k] = np.asarray(vals, np.float32)
+        elif same:
+            structural[k] = vals[0]
         else:
             raise ValueError(
                 f"config key {k!r} varies across the population but is "
                 f"not numeric ({vals[:3]}...); structural params must be "
                 "constant within one vmapped batch — group configs by "
                 "structure first (see SearchEngine backend='vmap')")
-    return stacked, shared
+    return stacked, const_num, structural
 
 
-# one compiled program per (train_fn, stacked keys, shared config): the
-# jit wrapper must be REUSED or every batch re-traces and recompiles
-_JIT_CACHE: Dict[Tuple, Any] = {}
+# one compiled program per (train_fn, stacked keys, constants): the jit
+# wrapper must be REUSED or every batch re-traces and recompiles.
+# BOUNDED (LRU): each entry pins the trainable's closure + executable,
+# so unbounded growth would leak in long-lived tuning services.
+_JIT_CACHE: "OrderedDict[Tuple, Any]" = None  # type: ignore[assignment]
+_JIT_CACHE_MAX = 32
 
 
 def _compiled(train_fn, stacked_keys: Tuple[str, ...],
-              shared: Dict[str, Any]):
+              const_num: Dict[str, Any], structural: Dict[str, Any]):
+    import collections
+
     import jax
 
+    global _JIT_CACHE
+    if _JIT_CACHE is None:
+        _JIT_CACHE = collections.OrderedDict()
     key = (id(train_fn), stacked_keys,
-           tuple(sorted((k, repr(v)) for k, v in shared.items())))
+           tuple(sorted((k, repr(v)) for k, v in const_num.items())),
+           tuple(sorted((k, repr(v)) for k, v in structural.items())))
     fn = _JIT_CACHE.get(key)
     if fn is None:
         def one(leaves):
-            return train_fn(leaves, **shared)
+            cfg = dict(const_num)
+            cfg.update(leaves)
+            return train_fn(cfg, **structural)
 
         fn = jax.jit(jax.vmap(one))
         _JIT_CACHE[key] = fn
+        while len(_JIT_CACHE) > _JIT_CACHE_MAX:
+            _JIT_CACHE.popitem(last=False)
+    else:
+        _JIT_CACHE.move_to_end(key)
     return fn
 
 
 def vmapped_trials(train_fn: Callable[..., Any],
                    configs: Sequence[Dict[str, Any]],
                    ) -> List[float]:
-    """Run ``train_fn(numeric_cfg_dict, **shared) -> scalar score`` for
+    """Run ``train_fn(cfg_dict, **structural) -> scalar score`` for
     every config as one vmapped jitted call; returns per-trial scores.
 
-    ``train_fn`` must be a pure jax-traceable function of the numeric
-    config leaves (each a scalar inside the trace).
+    ``cfg_dict`` always carries EVERY numeric key (varying ones as
+    traced scalars, batch-constant ones as python constants);
+    ``**structural`` carries the non-numeric keys.  ``train_fn`` must
+    be pure and jax-traceable in the varying leaves.
     """
     import jax
     import jax.numpy as jnp
 
-    stacked, shared = split_config(list(configs))
+    stacked, const_num, structural = split_config(list(configs))
     if not stacked:
         # degenerate population: one trace, N identical results
-        score = jax.jit(lambda: jnp.asarray(train_fn({}, **shared)))()
+        score = jax.jit(lambda: jnp.asarray(
+            train_fn(dict(const_num), **structural)))()
         return [float(score)] * len(configs)
 
-    fn = _compiled(train_fn, tuple(sorted(stacked)), shared)
+    fn = _compiled(train_fn, tuple(sorted(stacked)), const_num,
+                   structural)
     scores = fn(dict(stacked))
     return [float(s) for s in np.asarray(scores)]

@@ -51,7 +51,12 @@ class Pipeline:
     def fit(self, df) -> PipelineModel:
         fitted: List[Any] = []
         cur = df
-        last = len(self.stages) - 1
+        # pyspark.ml contract: during fit, transforms run only up to the
+        # LAST ESTIMATOR (later stages never feed another fit, so their
+        # transforms — including full NN inference over the training
+        # set — are skipped)
+        last_est = max((i for i, s in enumerate(self.stages)
+                        if hasattr(s, "fit")), default=-1)
         for i, s in enumerate(self.stages):
             if callable(s) and not hasattr(s, "fit") \
                     and not hasattr(s, "transform"):
@@ -59,14 +64,11 @@ class Pipeline:
             if hasattr(s, "fit"):
                 model = s.fit(cur)
                 fitted.append(model)
-                # pyspark.ml contract: only transform when a LATER stage
-                # needs the output (skips a full inference pass over the
-                # training set for the canonical NN-last layout)
-                if i != last:
+                if i < last_est:
                     cur = model.transform(cur)
             elif hasattr(s, "transform"):
                 fitted.append(s)
-                if i != last:
+                if i < last_est:
                     cur = s.transform(cur)
             else:
                 raise TypeError(

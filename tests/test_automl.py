@@ -540,13 +540,17 @@ class TestDeviceParallelTrials:
         from analytics_zoo_tpu.automl.search import SearchEngine, Uniform
 
         init_zoo_context(mesh_shape=(8,), axis_names=("data",))
-        space = {"lr": Uniform(1e-3, 1e-1)}
-        eng = SearchEngine(space, metric_mode="min", num_samples=6,
-                           max_parallel=4, backend="device", seed=0)
-        res = eng.run(lambda cfg: float(self._mlp_score(cfg, steps=10)))
-        assert len(res) == 6
-        devs = {r.extra.get("device") for r in res}
-        assert len(devs) >= 4, devs          # spread over >=4 devices
+        try:
+            space = {"lr": Uniform(1e-3, 1e-1)}
+            eng = SearchEngine(space, metric_mode="min", num_samples=6,
+                               max_parallel=4, backend="device", seed=0)
+            res = eng.run(
+                lambda cfg: float(self._mlp_score(cfg, steps=10)))
+            assert len(res) == 6
+            devs = {r.extra.get("device") for r in res}
+            assert len(devs) >= 4, devs      # spread over >=4 devices
+        finally:
+            init_zoo_context()               # restore the default mesh
 
     def test_pluggable_search_alg_object(self):
         from analytics_zoo_tpu.automl.search import SearchEngine, Uniform
@@ -575,3 +579,24 @@ class TestDeviceParallelTrials:
         # scores were fed back between proposals (sequential mode)
         assert sampler.history_len_at_propose == [0, 1, 2, 3]
         assert eng.best().config["lr"] == 0.02
+
+    def test_vmap_constant_numeric_stays_in_cfg(self):
+        """Batch-constant numeric keys still arrive in the trainable's
+        cfg dict (the calling convention is value-independent)."""
+        from analytics_zoo_tpu.automl.search import (GridSearch,
+                                                     SearchEngine, Uniform)
+
+        seen = {}
+
+        def trainable(cfg, **structural):
+            seen.update({k: True for k in cfg})
+            assert "lr" in cfg and "scale" in cfg, cfg
+            return cfg["lr"] * 0 + cfg["scale"]
+
+        eng = SearchEngine({"lr": GridSearch([0.01]),           # constant
+                            "scale": Uniform(0.1, 0.9)},        # varies
+                           metric_mode="min", num_samples=4,
+                           backend="vmap", seed=1)
+        res = eng.run(trainable)
+        assert all("error" not in r.extra for r in res), res[0].extra
+        assert seen == {"lr": True, "scale": True}

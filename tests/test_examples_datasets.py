@@ -223,3 +223,8 @@ class TestFlagshipApps:
         out = _run_example("apps/image_similarity_example.py",
                            "--gallery", "256", timeout=600)
         assert "class purity" in out
+
+    def test_multi_backend_inference_app(self):
+        out = _run_example("inference/multi_backend_inference_example.py",
+                           timeout=600)
+        assert "served 5 backends" in out or "served 4 backends" in out
