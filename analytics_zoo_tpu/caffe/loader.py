@@ -499,7 +499,15 @@ class _Translator:
 
     def bias(self, name, param, bottom, top):
         """Bias layer: add a learned per-channel blob (ScaleLayer minus
-        the multiply)."""
+        the multiply).  Only the caffe defaults (axis=1, num_axes=1 — a
+        per-channel broadcast) are supported; anything else must fail
+        loud rather than import a silently-wrong broadcast."""
+        axis = int(_one(param, "axis", 1))
+        num_axes = int(_one(param, "num_axes", 1))
+        if axis != 1 or num_axes != 1:
+            raise UnsupportedCaffeLayer(
+                f"Bias with axis={axis} num_axes={num_axes} (only the "
+                "per-channel default axis=1/num_axes=1 is supported)", name)
         blobs = self.weights.get(name, [])
         if not blobs:
             raise ValueError(f"Bias layer {name!r} has no blob")
@@ -511,6 +519,14 @@ class _Translator:
         self.shapes[top] = shape
 
     def reshape(self, name, param, bottom, top):
+        # only the full-shape default (axis=0, num_axes=-1) is supported;
+        # partial-range reshapes would import silently wrong otherwise
+        axis = int(_one(param, "axis", 0))
+        num_axes = int(_one(param, "num_axes", -1))
+        if axis != 0 or num_axes != -1:
+            raise UnsupportedCaffeLayer(
+                f"Reshape with axis={axis} num_axes={num_axes} (only the "
+                "whole-shape default axis=0/num_axes=-1 is supported)", name)
         dims = [int(d) for d in _many(_one(param, "shape", {}), "dim")]
         if not dims:
             raise UnsupportedCaffeLayer("Reshape without shape.dim", name)

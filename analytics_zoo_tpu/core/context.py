@@ -22,6 +22,11 @@ from analytics_zoo_tpu.core.config import ZooConfig
 logger = logging.getLogger("analytics_zoo_tpu")
 
 _GLOBAL_CONTEXT: Optional["ZooContext"] = None
+# coordination args of the live jax.distributed cluster (None = never
+# initialised through this module; _EXTERNAL_CLUSTER = initialised by a
+# launcher outside this module, so no args to compare against)
+_EXTERNAL_CLUSTER = ("<external>",)
+_DISTRIBUTED_ARGS: Optional[tuple] = None
 
 
 @dataclass
@@ -105,21 +110,47 @@ def init_zoo_context(
         # On TPU pods the three coordination args are discovered from the
         # environment; on CPU/GPU clusters (or tests) they are explicit.
         # NOTE: must run before anything touches the XLA backend (even
-        # jax.process_count()), hence the try-based idempotency guard.
-        try:
-            # None values mean auto-discover (TPU pod metadata / env vars)
-            jax.distributed.initialize(
-                coordinator_address=coordinator_address,
-                num_processes=num_processes, process_id=process_id)
-        except RuntimeError as e:
-            if "once" not in str(e):
-                raise
-            # Already initialised: keep the live cluster, but surface it —
-            # if the caller passed different coordination args they are
-            # NOT applied.
+        # jax.process_count()), so initialisation state is tracked here
+        # explicitly rather than by string-matching the RuntimeError
+        # (whose message changes across JAX versions).
+        global _DISTRIBUTED_ARGS
+        args = (coordinator_address, num_processes, process_id)
+        if _DISTRIBUTED_ARGS is None and _distributed_client_live():
+            # initialised outside this module (e.g. directly by the
+            # launcher): adopt the live cluster; the caller's args were
+            # never applied, so there is nothing to compare against later
             logger.warning(
-                "jax.distributed already initialised; ignoring multihost "
-                "coordination args (%s)", e)
+                "jax.distributed was initialised outside init_zoo_context;"
+                " multihost coordination args are ignored")
+            _DISTRIBUTED_ARGS = _EXTERNAL_CLUSTER
+        elif _DISTRIBUTED_ARGS is None:
+            try:
+                jax.distributed.initialize(
+                    coordinator_address=coordinator_address,
+                    num_processes=num_processes, process_id=process_id)
+                _DISTRIBUTED_ARGS = args
+            except RuntimeError:
+                # safety net for when the liveness probe's private API
+                # drifts: an already-initialised cluster must stay a
+                # benign adopt, never a startup crash
+                if not _distributed_client_live():
+                    raise
+                logger.warning(
+                    "jax.distributed already initialised; multihost "
+                    "coordination args are ignored")
+                _DISTRIBUTED_ARGS = _EXTERNAL_CLUSTER
+        elif _DISTRIBUTED_ARGS is _EXTERNAL_CLUSTER:
+            logger.warning(
+                "jax.distributed cluster was initialised externally; "
+                "multihost coordination args are ignored")
+        elif args != _DISTRIBUTED_ARGS:
+            # Re-init with DIFFERENT coordination args cannot be honored —
+            # the live cluster keeps its topology; silently dropping the
+            # new args would hide a real misconfiguration.
+            raise RuntimeError(
+                "jax.distributed already initialised with "
+                f"{_DISTRIBUTED_ARGS}; cannot re-initialise with {args}. "
+                "Restart the process to change cluster coordination.")
 
     if mesh_shape is not None:
         config = config.replace(mesh_shape=tuple(mesh_shape))
@@ -138,6 +169,16 @@ def init_zoo_context(
         mesh.axis_names,
     )
     return _GLOBAL_CONTEXT
+
+
+def _distributed_client_live() -> bool:
+    """True when a jax.distributed client already exists in this process
+    (initialised by a launcher before init_zoo_context ran)."""
+    try:
+        from jax._src.distributed import global_state
+        return global_state.client is not None
+    except Exception:       # private API moved: assume not initialised
+        return False
 
 
 def make_mesh(devices, mesh_shape, axis_names) -> "jax.sharding.Mesh":

@@ -5,6 +5,7 @@ from analytics_zoo_tpu.models.recommendation import (  # noqa: F401
     SessionRecommender,
     WideAndDeep,
     negative_sample,
+    presample_implicit_epochs,
 )
 from analytics_zoo_tpu.models.text import (  # noqa: F401
     KNRM,

@@ -16,6 +16,7 @@ round trip the reference did with moveWeightsOutOfTF
 
 from __future__ import annotations
 
+import math
 from typing import Any, Dict, List, Optional
 
 import jax.numpy as jnp
@@ -335,7 +336,8 @@ class TFGraphOptimizer:
                  batch_size: Optional[int] = None, shuffle: bool = True,
                  seed: int = 0) -> List[dict]:
         """Run epochs over the dataset; returns per-epoch history rows
-        (loss + any validation metrics)."""
+        (loss + any train-set metrics — the ``metrics`` fns are evaluated
+        on the full TRAINING arrays after each epoch)."""
         if end_trigger is not None and hasattr(end_trigger, "max_epoch"):
             epochs = end_trigger.max_epoch
         ds = self.dataset
@@ -355,11 +357,13 @@ class TFGraphOptimizer:
         for _ in range(epochs):
             perm = rs.permutation(n) if shuffle else np.arange(n)
             losses = []
-            for s in range(n // b):
-                idx = perm[s * b:(s + 1) * b]
+            for s in range(int(math.ceil(n / b))):
+                idx = perm[s * b:(s + 1) * b]   # tail batch may be short
                 losses.append(self._one_update([a[idx] for a in arrays]))
             rec = {"epoch": len(self.history) + 1,
                    "loss": float(np.mean(losses))}
+            # train-set metrics: evaluated on the full TRAINING arrays
+            # after the epoch (not a held-out validation set)
             for name, fn in self.metrics.items():
                 rec[name] = float(np.asarray(fn(*arrays)))
             self.history.append(rec)

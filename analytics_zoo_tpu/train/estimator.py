@@ -582,29 +582,50 @@ class Estimator:
         rem = steps_per_epoch - n_chunks * K
         epoch = self.finished_epochs
         rng_np = np.random.RandomState(cfg.seed)
-        y_arr = np.asarray(y)
+        # Device-resident mode: when the caller hands in jax.Arrays, every
+        # epoch's shuffle permutation, gather, and (K, B) reshape happen ON
+        # DEVICE — an epoch moves zero bytes host→device.  This is the hot
+        # path for data that fits HBM (e.g. the NCF north-star convergence
+        # run pre-samples all epochs on device and trains from the
+        # resident arrays).
+        # (multi-controller is excluded: _put_sharded must pull chunks to
+        # host for make_array_from_process_local_data there, which would
+        # make device inputs a device→host→device round trip per batch)
+        device_resident = (all(isinstance(a, jax.Array) for a in xs)
+                           and isinstance(y, jax.Array)
+                           and self.ctx.process_count == 1)
+        y_arr = y if device_resident else np.asarray(y)
 
         while epoch < epochs:
             batches = None
             try:
                 t0 = time.time()
-                perm = rng_np.permutation(n) if shuffle else np.arange(n)
+                if not shuffle:
+                    perm = None         # contiguous slices in both modes
+                elif device_resident:
+                    perm = jax.random.permutation(
+                        jax.random.PRNGKey(cfg.seed + 7919 * epoch), n)
+                else:
+                    perm = rng_np.permutation(n)
                 losses = []
 
                 def gen(perm=perm):
                     ofs = 0
                     for _ in range(n_chunks):
-                        idx = perm[ofs:ofs + K * eff_batch]
+                        sl = (slice(ofs, ofs + K * eff_batch)
+                              if perm is None
+                              else perm[ofs:ofs + K * eff_batch])
                         ofs += K * eff_batch
                         yield ("K",
-                               [a[idx].reshape((K, eff_batch) + a.shape[1:])
+                               [a[sl].reshape((K, eff_batch) + a.shape[1:])
                                 for a in xs],
-                               y_arr[idx].reshape(
+                               y_arr[sl].reshape(
                                    (K, eff_batch) + y_arr.shape[1:]))
                     for _ in range(rem):
-                        idx = perm[ofs:ofs + eff_batch]
+                        sl = (slice(ofs, ofs + eff_batch) if perm is None
+                              else perm[ofs:ofs + eff_batch])
                         ofs += eff_batch
-                        yield ("1", [a[idx] for a in xs], y_arr[idx])
+                        yield ("1", [a[sl] for a in xs], y_arr[sl])
 
                 def prep(item):
                     kind, bx, by = item
@@ -868,9 +889,16 @@ class Estimator:
         n = xs[0].shape[0]
         d = self._data_div
         eff_batch = int(math.ceil(max(batch_size, d) / d)) * d
-        # multi-controller: the replicated global output stacks every
-        # process's rows in process order — ours start at this offset
+        # Multi-controller: the replicated global output interleaves every
+        # process's rows at the global indices its addressable devices own
+        # under the data sharding.  create_device_mesh permutes devices for
+        # ICI topology, so those rows are NOT necessarily a contiguous
+        # process-major slice — derive the index set from the sharding.
         multiproc = self.ctx.process_count > 1
+        # every batch is padded to eff_batch rows, so the index map is the
+        # same for all of them — compute it once
+        row_idx = (self._local_row_indices(
+            eff_batch * self.ctx.process_count) if multiproc else None)
         outs: Optional[List[List[np.ndarray]]] = None
         for s in range(int(math.ceil(n / eff_batch))):
             sl = slice(s * eff_batch, min((s + 1) * eff_batch, n))
@@ -883,10 +911,27 @@ class Estimator:
                 preds = [preds]
             if outs is None:
                 outs = [[] for _ in preds]
-            row0 = jax.process_index() * bx_p[0].shape[0] if multiproc else 0
             for o, p in zip(outs, preds):
-                o.append(np.asarray(p)[row0:row0 + real])
+                p = np.asarray(p)
+                if row_idx is not None:
+                    p = p[row_idx]
+                o.append(p[:real])
         return [np.concatenate(o, axis=0) for o in outs]
+
+    def _local_row_indices(self, global_rows: int) -> np.ndarray:
+        """Ascending global row indices owned by THIS process's devices
+        under the data sharding.  ``make_array_from_process_local_data``
+        lays a process's local rows into exactly these positions (local
+        order ↔ ascending global shard index), so gathering them back
+        recovers the local batch — including padding at the tail —
+        regardless of how ``create_device_mesh`` permuted the devices."""
+        shard = self.ctx.data_sharding()
+        idx_map = shard.addressable_devices_indices_map((global_rows,))
+        spans = {(s[0].start or 0,
+                  global_rows if s[0].stop is None else s[0].stop)
+                 for s in idx_map.values()}   # dedup: tp/pp replicas share rows
+        return np.concatenate(
+            [np.arange(a, b) for a, b in sorted(spans)])
 
     # ------------------------------------------------------------------
     # checkpoint plumbing
