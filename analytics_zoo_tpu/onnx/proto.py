@@ -83,6 +83,20 @@ def _signed(v: int) -> int:
     return v - (1 << 64) if v >= (1 << 63) else v
 
 
+def _varints(wtype: int, val) -> List[int]:
+    """Repeated-varint field values: proto3 serializers PACK repeated
+    ints (wire type 2, the default for onnx files produced by protoc /
+    the onnx package), while proto2-era writers emit one varint per
+    element — accept both."""
+    if wtype == 2:
+        out, pos = [], 0
+        while pos < len(val):
+            v, pos = _read_varint(val, pos)
+            out.append(_signed(v))
+        return out
+    return [_signed(val)]
+
+
 # -- message dataclasses -----------------------------------------------------
 
 _DTYPES = {1: np.float32, 2: np.uint8, 3: np.int8, 5: np.int16,
@@ -148,8 +162,8 @@ def _decode_tensor(buf: bytes) -> Tensor:
     ints: List[int] = []
     raw = b""
     for fnum, wtype, val in _fields(buf):
-        if fnum == 1:
-            dims.append(_signed(val))
+        if fnum == 1:            # dims (packed by proto3 serializers)
+            dims.extend(_varints(wtype, val))
         elif fnum == 2:
             t.data_type = val
         elif fnum == 4:          # packed float_data
@@ -157,13 +171,7 @@ def _decode_tensor(buf: bytes) -> Tensor:
                 if wtype == 2 else floats.append(
                     struct.unpack("<f", val)[0])
         elif fnum in (5, 7):     # int32_data / int64_data (packed varints)
-            if wtype == 2:
-                pos = 0
-                while pos < len(val):
-                    v, pos = _read_varint(val, pos)
-                    ints.append(_signed(v))
-            else:
-                ints.append(_signed(val))
+            ints.extend(_varints(wtype, val))
         elif fnum == 8:
             t.name = val.decode()
         elif fnum == 9:
@@ -211,13 +219,7 @@ def _decode_attr(buf: bytes) -> Attribute:
             else:
                 floats.append(struct.unpack("<f", val)[0])
         elif fnum == 8:
-            if wtype == 2:
-                pos = 0
-                while pos < len(val):
-                    v, pos = _read_varint(val, pos)
-                    ints.append(_signed(v))
-            else:
-                ints.append(_signed(val))
+            ints.extend(_varints(wtype, val))
         elif fnum == 9:
             strings.append(val)
         elif fnum == 20:

@@ -1,0 +1,92 @@
+"""Generate a real-wire ONNX fixture: weights + expected outputs from a
+seeded torch module; serialization by protoc-generated google.protobuf
+code (independent of the repo's hand-rolled codec)."""
+import numpy as np
+import torch
+import torch.nn as nn
+import onnx_subset_pb2 as P
+
+torch.manual_seed(7)
+model = nn.Sequential(
+    nn.Conv2d(3, 8, 3, padding=1), nn.ReLU(), nn.MaxPool2d(2),
+    nn.Flatten(), nn.Linear(8 * 4 * 4, 10))
+model.eval()
+x = torch.randn(2, 3, 8, 8)
+with torch.no_grad():
+    expected = model(x).numpy()
+
+FLOAT = 1
+
+
+def tensor(name, arr):
+    t = P.TensorProto()
+    t.name = name
+    t.dims.extend(arr.shape)
+    t.data_type = FLOAT
+    t.raw_data = np.ascontiguousarray(arr, np.float32).tobytes()
+    return t
+
+
+def vinfo(name, shape):
+    v = P.ValueInfoProto()
+    v.name = name
+    v.type.tensor_type.elem_type = FLOAT
+    for d in shape:
+        dim = v.type.tensor_type.shape.dim.add()
+        dim.dim_value = d
+    return v
+
+
+def node(op, inputs, outputs, name, **attrs):
+    n = P.NodeProto()
+    n.op_type = op
+    n.name = name
+    n.input.extend(inputs)
+    n.output.extend(outputs)
+    for k, v in attrs.items():
+        a = n.attribute.add()
+        a.name = k
+        if isinstance(v, int):
+            a.type = 2          # INT
+            a.i = v
+        elif isinstance(v, float):
+            a.type = 1          # FLOAT
+            a.f = v
+        elif isinstance(v, (list, tuple)):
+            a.type = 7          # INTS
+            a.ints.extend(v)
+    return n
+
+m = P.ModelProto()
+m.ir_version = 7
+m.producer_name = "protoc-fixture-gen"
+m.producer_version = "1.0"
+op = m.opset_import.add()
+op.domain = ""
+op.version = 13
+g = m.graph
+g.name = "tiny_convnet"
+g.input.extend([vinfo("input", (2, 3, 8, 8))])
+g.output.extend([vinfo("output", (2, 10))])
+sd = model.state_dict()
+g.initializer.extend([
+    tensor("conv_w", sd["0.weight"].numpy()),
+    tensor("conv_b", sd["0.bias"].numpy()),
+    tensor("fc_w", sd["4.weight"].numpy()),    # (10, 128) -> transB
+    tensor("fc_b", sd["4.bias"].numpy()),
+])
+g.node.extend([
+    node("Conv", ["input", "conv_w", "conv_b"], ["c1"], "conv1",
+         kernel_shape=[3, 3], pads=[1, 1, 1, 1], strides=[1, 1]),
+    node("Relu", ["c1"], ["r1"], "relu1"),
+    node("MaxPool", ["r1"], ["p1"], "pool1",
+         kernel_shape=[2, 2], strides=[2, 2]),
+    node("Flatten", ["p1"], ["f1"], "flatten1", axis=1),
+    node("Gemm", ["f1", "fc_w", "fc_b"], ["output"], "fc1",
+         alpha=1.0, beta=1.0, transB=1),
+])
+with open("tiny_convnet.onnx", "wb") as f:
+    f.write(m.SerializeToString())
+np.savez("tiny_convnet_golden.npz", x=x.numpy(), expected=expected)
+print("wrote", len(m.SerializeToString()), "bytes; expected",
+      expected.shape, float(expected.mean()))
