@@ -23,6 +23,11 @@ import numpy as np
 
 
 def _to_pandas(df):
+    from analytics_zoo_tpu.nnframes.spark import (is_spark_df,
+                                                  spark_df_to_pandas)
+
+    if is_spark_df(df):                 # real pyspark.sql.DataFrame
+        return spark_df_to_pandas(df)
     if hasattr(df, "to_pandas"):        # pyarrow.Table, polars, ...
         return df.to_pandas()
     return df
@@ -34,7 +39,10 @@ def _col_to_array(col, dtype=None) -> np.ndarray:
     feature/common/Preprocessing.scala)."""
     vals = col.to_numpy() if hasattr(col, "to_numpy") else np.asarray(col)
     if vals.dtype == object:
-        vals = np.stack([np.asarray(v) for v in vals])
+        # pyspark.ml.linalg vectors expose toArray (MLlibVectorToTensor)
+        vals = np.stack([np.asarray(v.toArray(), np.float32)
+                         if hasattr(v, "toArray") else np.asarray(v)
+                         for v in vals])
     if dtype is not None:
         vals = vals.astype(dtype)
     return vals
@@ -248,6 +256,10 @@ class NNModel(_Params):
         return list(scores) if scores.ndim > 1 else scores
 
     def transform(self, df):
+        from analytics_zoo_tpu.nnframes.spark import (is_spark_df,
+                                                      pandas_to_spark_df)
+
+        spark_session = df.sparkSession if is_spark_df(df) else None
         df, xs = self._extract_features(df)
         scores = np.asarray(self.estimator.predict(
             xs, batch_size=self.batch_size))
@@ -255,6 +267,8 @@ class NNModel(_Params):
         out[self.prediction_col] = self._postprocess_scores(scores)
         for col, vals in self._extra_columns(scores).items():
             out[col] = vals
+        if spark_session is not None:   # a Spark stage must return Spark
+            return pandas_to_spark_df(out, spark_session)
         return out
 
     def _extra_columns(self, scores: np.ndarray) -> dict:

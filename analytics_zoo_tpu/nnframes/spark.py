@@ -1,0 +1,124 @@
+"""Real pyspark interop for NNFrames (optional import).
+
+Reference behavior being matched: ``NNEstimator.fit`` accepts a
+``pyspark.sql.DataFrame`` (NNEstimator.scala:198,414) and the fitted
+``NNModel`` works as a stage inside a real ``pyspark.ml.Pipeline``
+(nnframes guide "Use NNEstimator in a Spark ML Pipeline").
+
+Environment note: this container has NO pyspark wheel and zero network
+egress, so these paths cannot execute in CI here — they are exercised by
+``tests/test_nnframes_pyspark.py`` which ``importorskip``s pyspark and
+runs a reference-shaped ``Pipeline(stages=[...]).fit(df)`` under
+``local[2]`` wherever pyspark exists.  Everything that does not need a
+live SparkSession (column lowering of pyspark.ml Vector rows, the
+pandas round-trip helpers) is tested unconditionally.
+
+Design: collection, not re-implementation — the Spark driver collects
+the DataFrame through Arrow (``toPandas``), the TPU mesh trains, and
+``transform`` hands a DataFrame back to the session it came from.  The
+reference moved data the same direction (executors feed the BigDL
+optimizer's parameter-synchronised task set); here the heavy lifting is
+SPMD on the device mesh, so Spark's role is ingest/egress, which a
+collect covers up to driver memory.  For beyond-driver-memory sets,
+``FeatureSet.from_npy_files`` (DISK_AND_DRAM) is the supported path.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import numpy as np
+
+
+def is_spark_df(df) -> bool:
+    """True for a live pyspark.sql.DataFrame (duck-typed so the module
+    imports without pyspark installed)."""
+    return (hasattr(df, "toPandas") and hasattr(df, "sparkSession")
+            and hasattr(df, "schema"))
+
+
+def spark_df_to_pandas(df):
+    """Collect a pyspark DataFrame to pandas on the driver, lowering
+    pyspark.ml.linalg Vector cells to plain ndarrays so the NNFrames
+    column extraction (nn_estimator._col_to_array) sees dense data."""
+    pdf = df.toPandas()
+    for c in pdf.columns:
+        if len(pdf) and hasattr(pdf[c].iloc[0], "toArray"):
+            pdf[c] = [np.asarray(v.toArray(), np.float32) for v in pdf[c]]
+    return pdf
+
+
+def pandas_to_spark_df(pdf, session, template_df=None):
+    """Ship a pandas result back into the caller's SparkSession.
+    ndarray cells become plain python lists (Spark has no ndarray
+    encoder); scalars pass through."""
+    out = pdf.copy()
+    for c in out.columns:
+        if len(out) and isinstance(out[c].iloc[0], np.ndarray):
+            out[c] = [v.tolist() for v in out[c]]
+        elif out[c].dtype == np.float32:
+            out[c] = out[c].astype(np.float64)
+    return session.createDataFrame(out)
+
+
+def as_spark_ml_stage(stage):
+    """Wrap an NNFrames stage as a REAL pyspark.ml stage.
+
+    ``pyspark.ml.Pipeline.fit`` type-checks every stage against
+    ``pyspark.ml.base.Estimator``/``Transformer``, so the shim subclasses
+    them for real (requires pyspark importable).  The wrapped estimator's
+    ``_fit`` trains on the TPU mesh and returns a wrapped transformer,
+    which Spark then calls ``_transform`` on — both directions collect /
+    re-create DataFrames at the driver boundary.
+    """
+    from pyspark.ml.base import Estimator as SparkEstimator
+    from pyspark.ml.base import Transformer as SparkTransformer
+
+    if hasattr(stage, "fit"):               # NNEstimator / NNClassifier
+
+        class _ZooSparkEstimator(SparkEstimator):
+            def __init__(self, inner):
+                super().__init__()
+                self._inner = inner
+
+            def _fit(self, dataset):
+                model = self._inner.fit(dataset)
+                return as_spark_ml_stage(model)
+
+            def copy(self, extra=None):
+                return _ZooSparkEstimator(self._inner.copy())
+
+        return _ZooSparkEstimator(stage)
+
+    class _ZooSparkModel(SparkTransformer):
+        def __init__(self, inner):
+            super().__init__()
+            self._inner = inner
+
+        def _transform(self, dataset):
+            return self._inner.transform(dataset)
+
+        def copy(self, extra=None):
+            return _ZooSparkModel(self._inner.copy())
+
+    return _ZooSparkModel(stage)
+
+
+def init_spark_on_local(cores: int = 2, conf: Optional[dict] = None,
+                        app_name: str = "analytics-zoo-tpu") -> Any:
+    """Parity for the reference ``init_spark_on_local``
+    (pyzoo/zoo/common/nncontext.py:23-44): builds a local[cores]
+    SparkSession with Arrow enabled, AND initialises the zoo context so
+    the same script drives Spark ingest + TPU training."""
+    from pyspark.sql import SparkSession
+
+    from analytics_zoo_tpu import init_zoo_context
+
+    builder = (SparkSession.builder.master(f"local[{int(cores)}]")
+               .appName(app_name)
+               .config("spark.sql.execution.arrow.pyspark.enabled", "true"))
+    for k, v in (conf or {}).items():
+        builder = builder.config(k, v)
+    session = builder.getOrCreate()
+    init_zoo_context()
+    return session
