@@ -1,0 +1,5 @@
+from analytics_zoo_tpu.bigdl.loader import (  # noqa: F401
+    BigDLModule,
+    import_weights_by_name,
+    load_bigdl_weights,
+)
