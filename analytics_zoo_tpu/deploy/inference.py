@@ -162,6 +162,28 @@ class InferenceModel:
         normalized on-chip — so the host→device link carries 4x fewer
         bytes than float32 (see ``deploy.imagenet_preprocess``)."""
         state = state or {}
+
+        def _match_compute_dtype(p, s, xs):
+            """A preprocess emitting bf16 (e.g. imagenet_preprocess's
+            uint8→bf16 wire path) selects bf16 INFERENCE: float params
+            AND state (BN stats) cast to the input dtype in-program (XLA
+            folds the casts), outputs return as float32 for the client."""
+            from analytics_zoo_tpu.train.estimator import _cast_floats
+
+            floats = [x.dtype for x in xs
+                      if jnp.issubdtype(x.dtype, jnp.floating)]
+            cd = jnp.result_type(*floats) if floats else jnp.float32
+            if cd != jnp.float32:
+                p = _cast_floats(p, cd)
+                s = _cast_floats(s, cd)
+            return p, s
+
+        def _f32_out(out):
+            cast = (lambda o: o.astype(jnp.float32)
+                    if jnp.issubdtype(o.dtype, jnp.floating) else o)
+            return ([cast(o) for o in out]
+                    if isinstance(out, (list, tuple)) else cast(out))
+
         if int8:
             qparams = quantize_pytree(params)
 
@@ -169,16 +191,18 @@ class InferenceModel:
             def fwd(*xs):
                 if preprocess is not None:
                     xs = _as_tuple(preprocess(*xs))
-                p = dequantize_pytree(qparams)
-                out, _ = net.call(p, state, *xs, training=False)
-                return out
+                p, s2 = _match_compute_dtype(dequantize_pytree(qparams),
+                                             state, xs)
+                out, _ = net.call(p, s2, *xs, training=False)
+                return _f32_out(out)
         else:
             @jax.jit
             def fwd(*xs):
                 if preprocess is not None:
                     xs = _as_tuple(preprocess(*xs))
-                out, _ = net.call(params, state, *xs, training=False)
-                return out
+                p, s2 = _match_compute_dtype(params, state, xs)
+                out, _ = net.call(p, s2, *xs, training=False)
+                return _f32_out(out)
 
         def forward(inputs: List[np.ndarray]):
             return fwd(*[jnp.asarray(x) for x in inputs])

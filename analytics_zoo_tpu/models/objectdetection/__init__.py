@@ -25,3 +25,7 @@ from analytics_zoo_tpu.models.objectdetection.evaluation import (  # noqa: F401
     PascalVocEvaluator,
     average_precision,
 )
+from analytics_zoo_tpu.models.objectdetection.visualizer import (  # noqa: F401
+    draw_detections,
+    save_detection_images,
+)

@@ -1,0 +1,107 @@
+"""Model-inference services app — the multi-model serving tour
+(reference apps/model-inference-examples: recommendation-inference and
+text-classification-inference services built on InferenceModel, each
+loading a trained artifact and answering requests).
+
+Two services run in one process here:
+1. recommendation: an NCF trained on MovieLens-shaped interactions, then
+   served through ``InferenceModel`` answering top-k item requests.
+2. text classification: a TextClassifier + the TextSet vocabulary, then
+   served for raw-string requests (tokenize -> idx -> predict in the
+   service).
+
+TPU-first notes: both services share the chip; each model compiles one
+bucketed predict program, and requests batch through it (the flink/java
+services in the reference did the same through the JVM InferenceModel).
+"""
+
+import argparse
+
+import numpy as np
+
+from analytics_zoo_tpu import init_zoo_context
+from analytics_zoo_tpu.data.datasets import generate_text_classification
+from analytics_zoo_tpu.data.text import TextSet
+from analytics_zoo_tpu.deploy import InferenceModel
+from analytics_zoo_tpu.models import NeuralCF
+from analytics_zoo_tpu.models.text import TextClassifier
+
+
+def build_recommendation_service(n_users=200, n_items=120, epochs=3):
+    rs = np.random.RandomState(0)
+    zu, zi = rs.randn(n_users + 1, 6), rs.randn(n_items + 1, 6)
+    u = rs.randint(1, n_users + 1, 4000).astype(np.int32)
+    i = rs.randint(1, n_items + 1, 4000).astype(np.int32)
+    y = ((zu[u] * zi[i]).sum(-1) > 0).astype(np.int32)
+    ncf = NeuralCF(user_count=n_users, item_count=n_items, class_num=2,
+                   user_embed=8, item_embed=8, hidden_layers=(16, 8),
+                   mf_embed=8)
+    ncf.compile(optimizer="adam", loss="sparse_categorical_crossentropy")
+    ncf.fit([u[:, None], i[:, None]], y, batch_size=256, nb_epoch=epochs)
+    import jax
+
+    model = InferenceModel.from_keras_net(
+        ncf.model, jax.device_get(ncf.estimator.params),
+        jax.device_get(ncf.estimator.state), batch_buckets=(32, 256))
+
+    def recommend(user_id: int, k: int = 5):
+        items = np.arange(1, n_items + 1, dtype=np.int32)
+        users = np.full_like(items, user_id)
+        scores = np.asarray(model.predict(
+            [users[:, None], items[:, None]]))[:, 1]
+        top = np.argsort(-scores)[:k]
+        return [(int(items[j]), round(float(scores[j]), 3)) for j in top]
+
+    return recommend
+
+
+def build_text_service(epochs=4, seq_len=32):
+    texts, labels = generate_text_classification(n_classes=3, per_class=80)
+    ts = (TextSet.from_texts(texts, labels).tokenize().normalize()
+          .word2idx(max_words_num=4000).shape_sequence(seq_len))
+    x, y = ts.to_arrays()
+    clf = TextClassifier(class_num=3, token_length=16,
+                         sequence_length=seq_len, encoder="cnn",
+                         encoder_output_dim=32, max_words_num=4000)
+    clf.compile(optimizer="adam", loss="sparse_categorical_crossentropy",
+                metrics=["accuracy"])
+    clf.fit(x, y.astype(np.int32), batch_size=64, nb_epoch=epochs)
+    import jax
+
+    model = InferenceModel.from_keras_net(
+        clf.model, jax.device_get(clf.estimator.params),
+        jax.device_get(clf.estimator.state), batch_buckets=(8, 64))
+    word_index = ts.word_index
+
+    def classify(raw_texts):
+        feats = (TextSet.from_texts(list(raw_texts)).tokenize().normalize()
+                 .word2idx(existing_map=word_index)
+                 .shape_sequence(seq_len))
+        xs, _ = feats.to_arrays()
+        probs = np.asarray(model.predict([xs]))
+        return probs.argmax(-1).tolist(), probs.max(-1).round(3).tolist()
+
+    return classify, texts
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=3)
+    args = ap.parse_args()
+
+    init_zoo_context()
+    print("== recommendation-inference service ==")
+    recommend = build_recommendation_service(epochs=args.epochs)
+    for user in (7, 42, 99):
+        print(f"  top-5 for user {user}: {recommend(user)}")
+
+    print("== text-classification-inference service ==")
+    classify, corpus = build_text_service(epochs=args.epochs + 1)
+    sample = corpus[:4]
+    classes, confidence = classify(sample)
+    for t, c, p in zip(sample, classes, confidence):
+        print(f"  [{c} @{p}] {t[:48]}...")
+
+
+if __name__ == "__main__":
+    main()

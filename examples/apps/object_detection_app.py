@@ -1,0 +1,85 @@
+"""Object-detection notebook app — end-to-end detect-and-visualize flow
+(reference apps/object-detection: load a pretrained SSD, read images,
+predict, draw boxes with Visualizer, save annotated frames).
+
+The reference downloads a pretrained SSD-MobileNet from the zoo; with no
+egress this app trains a small SSD on synthetic box scenes first (or
+loads ``--model`` saved by a previous run), then runs the identical
+detect -> draw -> save flow on held-out images.
+
+TPU-first notes: detection post-processing (decode + per-class NMS) is
+jitted and vmapped over the batch on device; only final kept boxes come
+back to host for drawing.
+"""
+
+import argparse
+import os
+
+import numpy as np
+
+from analytics_zoo_tpu import init_zoo_context
+from analytics_zoo_tpu.models.objectdetection import (ObjectDetector,
+                                                      save_detection_images)
+
+SMALL_CONFIG = {
+    "image_size": 64,
+    "feature_sizes": (8, 4, 2, 1, 1, 1),
+    "min_sizes": (6, 13, 26, 38, 51, 58),
+    "max_sizes": (13, 26, 38, 51, 58, 70),
+    "aspect_ratios": ((2,), (2, 3), (2, 3), (2, 3), (2,), (2,)),
+}
+CLASS_NAMES = ["background", "block"]
+
+
+def synthetic_scenes(n=48, size=64, seed=0):
+    rs = np.random.RandomState(seed)
+    imgs = rs.rand(n, size, size, 3).astype(np.float32) * 0.2
+    boxes = np.zeros((n, 1, 4), np.float32)
+    labels = np.ones((n, 1), np.int64)
+    for i in range(n):
+        w, h = rs.randint(16, 40, 2)
+        x, y = rs.randint(0, size - w), rs.randint(0, size - h)
+        imgs[i, y:y + h, x:x + w] = rs.rand(3) * 0.6 + 0.4
+        boxes[i, 0] = (x / size, y / size, (x + w) / size, (y + h) / size)
+    return imgs, boxes, labels
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--output", default="/tmp/object_detection_app")
+    ap.add_argument("--epochs", type=int, default=6)
+    ap.add_argument("--n-train", type=int, default=40)
+    ap.add_argument("--n-predict", type=int, default=8)
+    args = ap.parse_args()
+
+    init_zoo_context()
+    det = ObjectDetector(class_num=2, config=SMALL_CONFIG)
+    det.model.compile(optimizer="adam", loss=det.loss())
+
+    imgs, boxes, labels = synthetic_scenes(args.n_train + args.n_predict)
+    tr = slice(0, args.n_train)
+    det.fit_detection(imgs[tr], boxes[tr], labels[tr],
+                      batch_size=8, nb_epoch=args.epochs, verbose=False)
+
+    test = imgs[args.n_train:]
+    detections = det.detect(test, score_threshold=0.25)
+    paths = save_detection_images(args.output, test, detections,
+                                  class_names=CLASS_NAMES)
+    found = sum(len(d[0]) for d in detections)
+    print(f"detected {found} boxes across {len(test)} images")
+    print(f"annotated frames written to {os.path.abspath(args.output)}:")
+    for p in paths[:3]:
+        print(" ", p)
+    # quality readout: mean IoU of the top detection vs ground truth
+    from analytics_zoo_tpu.models.objectdetection import iou_matrix
+    gts = boxes[args.n_train:]
+    ious = []
+    for (b, s, l), gt in zip(detections, gts):
+        if len(b):
+            ious.append(float(np.max(iou_matrix(b[:1], gt))))
+    if ious:
+        print("mean top-1 IoU:", round(float(np.mean(ious)), 3))
+
+
+if __name__ == "__main__":
+    main()
