@@ -28,11 +28,12 @@ from analytics_zoo_tpu.nn.layers.pooling import (
 
 
 def _conv_bn(x, filters, k, strides=1, activation="relu", name=None,
-             border_mode="same"):
+             border_mode="same", bn_stats_fraction=1.0):
     x = Convolution2D(filters, k, k, subsample=(strides, strides),
                       border_mode=border_mode, bias=False,
                       name=None if name is None else f"{name}_conv")(x)
-    x = BatchNormalization(name=None if name is None else f"{name}_bn")(x)
+    x = BatchNormalization(name=None if name is None else f"{name}_bn",
+                           stats_fraction=bn_stats_fraction)(x)
     if activation:
         x = Activation(activation)(x)
     return x
@@ -40,26 +41,33 @@ def _conv_bn(x, filters, k, strides=1, activation="relu", name=None,
 
 # ---------------------------------------------------------------- ResNet --
 
-def _bottleneck(x, filters, strides=1, downsample=False, name=""):
+def _bottleneck(x, filters, strides=1, downsample=False, name="",
+                bn_stats_fraction=1.0):
     shortcut = x
     if downsample:
         shortcut = Convolution2D(filters * 4, 1, 1,
                                  subsample=(strides, strides),
                                  border_mode="same", bias=False,
                                  name=f"{name}_proj")(x)
-        shortcut = BatchNormalization(name=f"{name}_proj_bn")(shortcut)
-    y = _conv_bn(x, filters, 1, strides=strides, name=f"{name}_a")
-    y = _conv_bn(y, filters, 3, name=f"{name}_b")
+        shortcut = BatchNormalization(
+            name=f"{name}_proj_bn",
+            stats_fraction=bn_stats_fraction)(shortcut)
+    y = _conv_bn(x, filters, 1, strides=strides, name=f"{name}_a",
+                 bn_stats_fraction=bn_stats_fraction)
+    y = _conv_bn(y, filters, 3, name=f"{name}_b",
+                 bn_stats_fraction=bn_stats_fraction)
     y = Convolution2D(filters * 4, 1, 1, border_mode="same", bias=False,
                       name=f"{name}_c_conv")(y)
-    y = BatchNormalization(name=f"{name}_c_bn")(y)
+    y = BatchNormalization(name=f"{name}_c_bn",
+                           stats_fraction=bn_stats_fraction)(y)
     out = merge([y, shortcut], mode="sum")
     return Activation("relu")(out)
 
 
 def resnet50(class_num: int = 1000,
              input_shape: Sequence[int] = (224, 224, 3),
-             space_to_depth_stem: bool = True) -> Model:
+             space_to_depth_stem: bool = True,
+             bn_stats_fraction: float = 1.0) -> Model:
     """ResNet-50 (bottleneck [3,4,6,3]).  Reference: examples/resnet/ and
     ImageClassificationConfig 'resnet-50' entry.
 
@@ -76,7 +84,8 @@ def resnet50(class_num: int = 1000,
     else:
         x = Convolution2D(64, 7, 7, subsample=(2, 2), border_mode="same",
                           bias=False, name="stem_conv")(inp)
-    x = BatchNormalization(name="stem_bn")(x)
+    x = BatchNormalization(name="stem_bn",
+                           stats_fraction=bn_stats_fraction)(x)
     x = Activation("relu")(x)
     x = MaxPooling2D((3, 3), strides=(2, 2), border_mode="same")(x)
     for stage, (blocks, filters) in enumerate(
@@ -84,7 +93,8 @@ def resnet50(class_num: int = 1000,
         for b in range(blocks):
             strides = 2 if (b == 0 and stage > 0) else 1
             x = _bottleneck(x, filters, strides=strides, downsample=(b == 0),
-                            name=f"s{stage}b{b}")
+                            name=f"s{stage}b{b}",
+                            bn_stats_fraction=bn_stats_fraction)
     x = GlobalAveragePooling2D()(x)
     x = Dense(class_num, name="fc")(x)
     return Model(inp, x, name="resnet50")

@@ -31,20 +31,70 @@ stand-in for "BigDL-on-CPU on this machine" given BigDL targets CPU and
 publishes no absolute numbers (BASELINE.md).
 """
 
+import contextlib as _contextlib
 import json
 import os
 import time
 
 import numpy as np
 
+# Persistent XLA compilation cache (set BEFORE jax import anywhere):
+# bench programs deserialize instead of recompiling on reruns — measured
+# r5: 14.7s -> 8.8s for one flash fori-program; across the ~20 bench
+# programs this buys the accuracy legs their window.  The cache dir is
+# gitignored (binary executables, ~100MB/entry) but persists on the
+# bench host between the interactive population run and the driver run.
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"))
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "2")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
+
 # Wall-clock budget: optional extras are skipped once exceeded so the
 # primary metric always prints within the driver's window.
 _T0 = time.time()
-_BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "460"))
+# r2 evidence bounds the driver's window: its artifact captured a run
+# that spent 0.8*460s in preflight retries plus a <=240s CPU fallback
+# (~600s wall).  r5 adds a watchdog (below) that GUARANTEES the JSON
+# line prints with whatever sections completed, so the budget can sit
+# at the generous end without risking an empty artifact.
+_BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "640"))
 
 
 def _remaining() -> float:
     return _BUDGET_S - (time.time() - _T0)
+
+
+class _Watchdog:
+    """Prints the (partially filled) report and exits if the run outlives
+    the budget by ``grace`` seconds — a wedged section or an impatient
+    driver can no longer produce an EMPTY artifact (r4's worst failure
+    mode was one section wedging the whole report)."""
+
+    def __init__(self, report: dict, grace: float = 45.0):
+        import threading
+
+        self.report = report
+        self._lock = threading.Lock()
+        self._printed = False
+        t = threading.Thread(target=self._arm, args=(grace,), daemon=True)
+        t.start()
+
+    def _arm(self, grace):
+        delay = max(1.0, _BUDGET_S + grace - (time.time() - _T0))
+        time.sleep(delay)
+        if self.emit(tag="watchdog"):
+            os._exit(0)
+
+    def emit(self, tag: str = "") -> bool:
+        with self._lock:
+            if self._printed:
+                return False
+            self._printed = True
+            if tag:
+                self.report["extra"]["emitted_by"] = tag
+            print(json.dumps(self.report), flush=True)
+            return True
 
 
 # ---------------------------------------------------------------------------
@@ -163,10 +213,6 @@ def bench_ncf(device, batch=8192, warmup=1, iters=5, k_steps=64,
                    user_embed=20, item_embed=20, hidden_layers=(40, 20, 10),
                    mf_embed=20)
     model = ncf.model
-    rs = np.random.RandomState(0)
-    users = rs.randint(1, 6041, (k_steps, batch, 1)).astype(np.int32)
-    items = rs.randint(1, 3707, (k_steps, batch, 1)).astype(np.int32)
-    labels = rs.randint(0, 5, (k_steps, batch)).astype(np.int32)
 
     with jax.default_device(device):
         params, state = model.init(jax.random.PRNGKey(0))
@@ -188,9 +234,22 @@ def bench_ncf(device, batch=8192, warmup=1, iters=5, k_steps=64,
             return params, state, opt_state, losses[-1]
 
         fused = jax.jit(fused, donate_argnums=(0, 1, 2))
-        xs = [jax.device_put(jnp.asarray(users), device),
-              jax.device_put(jnp.asarray(items), device)]
-        y = jax.device_put(jnp.asarray(labels), device)
+        # synthetic id stream generated ON DEVICE — the 100MB host
+        # superbatch upload the old bench paid (~10s on the tunnel) told
+        # us nothing about the training engine being measured
+        @jax.jit
+        def gen(key):
+            ku, ki, ky = jax.random.split(key, 3)
+            return (jax.random.randint(ku, (k_steps, batch, 1), 1, 6041,
+                                       jnp.int32),
+                    jax.random.randint(ki, (k_steps, batch, 1), 1, 3707,
+                                       jnp.int32),
+                    jax.random.randint(ky, (k_steps, batch), 0, 5,
+                                       jnp.int32))
+
+        users, items, labels = gen(jax.random.PRNGKey(0))
+        xs = [users, items]
+        y = labels
         carry = (jax.device_put(params, device),
                  jax.device_put(state, device),
                  jax.device_put(opt_state, device))
@@ -232,85 +291,112 @@ def bench_ncf_convergence(epochs=12, batch=2048, n_users=6040, n_items=3706,
                           n_eval=2000, embed=16, mf_embed=16,
                           hidden=(64, 32, 16), lr=2e-3, pos_per_user=50,
                           dropout=0.6, neg_per_pos=8, swa_from=3,
-                          ensemble=1, seed=42):
-    """Full framework path: negative sampling -> FeatureSet -> Estimator
-    (prefetch, fused multi-step dispatch, donated buffers) -> HR@10
-    (held-out positive vs 99 negatives, the NCF paper's protocol).
+                          ensemble=1, seed=42, k_steps=128,
+                          cpu_baseline_epochs=3):
+    """The north star in ONE run: matched-accuracy convergence whose own
+    sustained samples/sec is compared against a CPU run of the SAME code
+    path (BASELINE.json: >=10x CPU at matched accuracy).
 
-    Recipe (r3 CPU sweep on this exact set — every knob measured):
-    - fresh negatives EVERY epoch (the paper's per-epoch sampling),
-      8 per positive (0.893 vs 0.887 at 4);
-    - MODEST factors (embed 16): embed 64 memorizes (0.887 peak, 0.772
-      by epoch 32), and the live trajectory always peaks ~epoch 6 then
-      declines;
-    - MLP dropout 0.5-0.6 lifts and flattens the peak (0.901 live);
-    - tail-averaged weights (SWA over per-epoch snapshots from
-      ``swa_from``) — the returned number uses the averaged params.
-    Measured end-to-end (r4, on-silicon): single model 0.9255; 2-seed
-    score ensemble 0.929 at 2x8 epochs (``ensemble=2`` — ens2 at 12
-    epochs measured no better, 0.9285).  Against the r4 practical bound
-    of 0.9625 (``practical_bound_hr10`` below) that is 96.5% of what
-    ANY learner can extract from this data; the 0.975 "oracle" needs
-    exact latent knowledge.  Rejected knobs (measured no better):
-    wd 1e-4/1e-5, cosine decay, wider GMF, longer training, late SWA,
-    neg_per_pos 16 (0.9055 — worse)."""
+    The data path is fully device-resident: ALL epochs' negatives are
+    sampled on-chip in one jitted program
+    (``presample_implicit_epochs``), and ``Estimator.fit`` consumes
+    epoch slices of the resident arrays directly — the epoch loop moves
+    zero bytes host→device (r4's 120x gap between the fused microbench
+    and the convergence run was host numpy sampling + per-epoch
+    FeatureSet rebuild; both are gone).
+
+    Recipe (r3 CPU sweep; r4 on-silicon): fresh negatives EVERY epoch, 8
+    per positive; MODEST factors (embed 16 — embed 64 memorizes); MLP
+    dropout 0.6; tail-averaged weights (SWA from ``swa_from``).
+    Measured r4: single model 0.9255, 2-seed ensemble 0.929, against a
+    practical bound of 0.9625 (MAP with true item factors; the 0.975
+    "oracle" needs exact latent knowledge no training set conveys).
+    Rejected knobs (measured no better): wd 1e-4/1e-5, cosine decay,
+    wider GMF, longer training, late SWA, neg_per_pos 16.
+
+    The CPU baseline runs ``cpu_baseline_epochs`` of the identical
+    recipe on the host CPU backend (same Estimator, same presampler,
+    same shapes — bit-identical programs, r4-proven) and reports its
+    sustained post-compile throughput; set 0 to skip."""
     import jax as _jax
 
     from analytics_zoo_tpu import init_zoo_context
-    from analytics_zoo_tpu.data.featureset import FeatureSet
-    from analytics_zoo_tpu.models import NeuralCF
-    from analytics_zoo_tpu.models.recommendation import negative_sample
+    from analytics_zoo_tpu.models import NeuralCF, presample_implicit_epochs
     from analytics_zoo_tpu.nn import reset_name_scope
+    from analytics_zoo_tpu.train.optimizers import Adam
 
     users, items, heldout, true_scores = _movielens_like(
         n_users, n_items, pos_per_user=pos_per_user)
 
-    from analytics_zoo_tpu.train.optimizers import Adam
+    def train_member(member_seed, n_epochs, platform=None,
+                     stream_frac=1.0):
+        """One full convergence run; returns (model, history).
 
-    def train_member(member_seed):
-        init_zoo_context(steps_per_execution=32, seed=member_seed)
+        ``stream_frac < 1`` trains on a leading slice of each epoch's
+        stream — the per-chunk program (shapes, K, batch) is identical,
+        only the chunk count drops, so per-sample throughput is the same
+        measurement at a fraction of the wall cost (used to keep the CPU
+        leg affordable: its dropout threefry makes CPU ~40k samples/s)."""
+        init_zoo_context(steps_per_execution=k_steps, seed=member_seed,
+                         platform=platform)
         reset_name_scope()
-        ncf = NeuralCF(user_count=n_users, item_count=n_items, class_num=2,
-                       user_embed=embed, item_embed=embed,
-                       hidden_layers=hidden, mf_embed=mf_embed,
-                       dropout=dropout)
-        ncf.compile(optimizer=Adam(lr=lr),
-                    loss="sparse_categorical_crossentropy",
-                    metrics=["accuracy"])
-        done = 0
-        avg, n_avg = None, 0
-        while done < epochs:
-            tr_u, tr_i, tr_y = negative_sample(users, items, n_items,
-                                               neg_per_pos=neg_per_pos,
-                                               seed=member_seed + 1 + done)
-            fs = FeatureSet.from_ndarrays(
-                [tr_u[:, None].astype(np.int32),
-                 tr_i[:, None].astype(np.int32)], tr_y.astype(np.int32))
-            ncf.estimator.fit(fs, batch_size=batch,
-                              epochs=done + 1, verbose=False)
-            done += 1
-            if done >= swa_from:
-                cur = _jax.device_get(ncf.estimator.params)
-                if avg is None:
-                    avg, n_avg = cur, 1
-                else:
-                    n_avg += 1
-                    avg = _jax.tree_util.tree_map(
-                        lambda a, c: a + (c - a) / n_avg, avg, cur)
-        # evaluate the tail-averaged weights (dropout is already identity
-        # at inference; averaging needs no BN-stat recompute — no BN here)
-        if avg is not None:
-            ncf.estimator.set_initial_weights(
-                avg, _jax.device_get(ncf.estimator.state))
-        return ncf
+        dev = _jax.local_devices(backend=platform)[0] if platform else None
+        ctxmgr = (_jax.default_device(dev) if dev is not None
+                  else _contextlib.nullcontext())
+        with ctxmgr:
+            if stream_frac < 1.0:       # slice the positives up front so
+                n_keep = max(batch, int(len(users) * stream_frac))
+                use_u, use_i = users[:n_keep], items[:n_keep]
+            else:                       # the presample cost shrinks too
+                use_u, use_i = users, items
+            tr_u, tr_i, tr_y = presample_implicit_epochs(
+                use_u, use_i, n_items, epochs=n_epochs,
+                neg_per_pos=neg_per_pos, seed=member_seed + 1,
+                trim_multiple=batch, user_count=n_users)
+            ncf = NeuralCF(user_count=n_users, item_count=n_items,
+                           class_num=2, user_embed=embed, item_embed=embed,
+                           hidden_layers=hidden, mf_embed=mf_embed,
+                           dropout=dropout)
+            ncf.compile(optimizer=Adam(lr=lr),
+                        loss="sparse_categorical_crossentropy",
+                        metrics=["accuracy"])
+            avg, n_avg = None, 0
+            for done in range(n_epochs):
+                # epoch slices stay on device; the stream is pre-shuffled
+                # per epoch by the presampler, so shuffle=False
+                ncf.estimator.fit(
+                    [tr_u[done][:, None], tr_i[done][:, None]], tr_y[done],
+                    batch_size=batch, epochs=done + 1, shuffle=False,
+                    verbose=False)
+                if done + 1 >= swa_from:
+                    cur = _jax.device_get(ncf.estimator.params)
+                    if avg is None:
+                        avg, n_avg = cur, 1
+                    else:
+                        n_avg += 1
+                        avg = _jax.tree_util.tree_map(
+                            lambda a, c: a + (c - a) / n_avg, avg, cur)
+            # evaluate the tail-averaged weights (dropout is identity at
+            # inference; no BN here, so no stat recompute)
+            if avg is not None:
+                ncf.estimator.set_initial_weights(
+                    avg, _jax.device_get(ncf.estimator.state))
+            return ncf, ncf.estimator.history
 
     t0 = time.perf_counter()
     # seed-ensemble: independently-trained members' softmax scores are
     # averaged at ranking time (each member's errors are partly
     # idiosyncratic; the mean sharpens the common latent signal)
-    members = [train_member(seed + 1000 * m) for m in range(max(1, ensemble))]
+    trained = [train_member(seed + 1000 * m, epochs)
+               for m in range(max(1, ensemble))]
     train_s = time.perf_counter() - t0
-    samples_per_member = len(users) * (1 + neg_per_pos) * epochs
+    members = [t[0] for t in trained]
+    # sustained = post-compile per-epoch throughput (epoch 1 carries the
+    # XLA compiles); epochs 2+ are steady state
+    epoch_tputs = [r["throughput"] for _, h in trained for r in h[1:]]
+    sustained = float(np.median(epoch_tputs)) if epoch_tputs else 0.0
+    samples_per_member = (len(users) * (1 + neg_per_pos) // batch) \
+        * batch * epochs
 
     # HR@10, the NCF paper's protocol: held-out positive vs 99 negatives
     # the user has NOT interacted with (train positives + heldout are the
@@ -346,24 +432,67 @@ def bench_ncf_convergence(epochs=12, batch=2048, n_users=6040, n_items=3706,
     oracle_hr10 = float(
         ((oracle[:, 1:] >= oracle[:, :1]).sum(axis=1) < 10).mean())
     samples = samples_per_member * len(members)
-    return {"hitrate_at_10": round(hr10, 4),
-            "ensemble": len(members),
-            "oracle_hitrate_at_10": round(oracle_hr10, 4),
-            # r4 measured ceiling for ANY learner on this data: MAP user
-            # estimation GIVEN the true item factors + generative link
-            # reaches 0.9625 from 50 positives/user — the 0.975 oracle
-            # needs exact latent knowledge no training set conveys
-            # (docs/PERFORMANCE.md "the 0.975 oracle is not reachable").
-            "practical_bound_hr10": 0.9625,
-            "train_samples_per_sec": round(samples / train_s, 1),
-            "train_samples": samples}
+    out = {"hitrate_at_10": round(hr10, 4),
+           "ensemble": len(members),
+           "oracle_hitrate_at_10": round(oracle_hr10, 4),
+           # r4 measured ceiling for ANY learner on this data: MAP user
+           # estimation GIVEN the true item factors + generative link
+           # reaches 0.9625 from 50 positives/user — the 0.975 oracle
+           # needs exact latent knowledge no training set conveys
+           # (docs/PERFORMANCE.md "the 0.975 oracle is not reachable").
+           "practical_bound_hr10": 0.9625,
+           "tpu_convergence_samples_per_sec": round(sustained, 1),
+           "tpu_end_to_end_samples_per_sec": round(samples / train_s, 1),
+           "train_samples": samples,
+           "train_wall_s": round(train_s, 1)}
+    if cpu_baseline_epochs > 0:
+        try:
+            t0 = time.perf_counter()
+            # quarter-stream slice: identical per-chunk program, so the
+            # per-sample rate is the same measurement at 1/4 the wall
+            cpu_frac = 0.25
+            _, cpu_hist = train_member(seed, cpu_baseline_epochs,
+                                       platform="cpu",
+                                       stream_frac=cpu_frac)
+            cpu_wall = time.perf_counter() - t0
+            cpu_tputs = [r["throughput"] for r in cpu_hist[1:]]
+            # fallback (single-epoch history): wall-clock rate of the
+            # quarter-stream run — scale the per-epoch sample count by
+            # the SAME fraction the run actually trained on
+            cpu_sustained = (float(np.median(cpu_tputs)) if cpu_tputs
+                             else samples_per_member * cpu_frac
+                             / epochs * cpu_baseline_epochs / cpu_wall)
+            out["cpu_convergence_samples_per_sec"] = round(cpu_sustained, 1)
+            out["cpu_baseline_epochs"] = cpu_baseline_epochs
+            out["cpu_stream_frac"] = 0.25
+            if cpu_sustained > 0:
+                out["convergence_speedup_vs_cpu"] = round(
+                    sustained / cpu_sustained, 2)
+        except Exception as e:          # noqa: BLE001 — record, don't zero
+            out["cpu_convergence_error"] = f"{type(e).__name__}: {e}"
+    return out
 
 
 # ---------------------------------------------------------------------------
 # ResNet-50 (BASELINE config #2)
 # ---------------------------------------------------------------------------
 
-def bench_resnet50(device, batch=256, warmup=1, iters=4):
+def bench_resnet50(device, batch=256, n1=4, rounds=2,
+                   bn_stats_fraction=1.0):
+    """ResNet-50 bf16 train step: ONE compiled program, launch-amortized
+    and transport-safe by construction.
+
+    Supersedes the r4 plain/fused pair: r4's fused leg shipped a
+    (K, B, 224, 224, 3) float32 superbatch = 2.47GB in ONE buffer, which
+    wedged the tunnel and recorded 43.86 imgs/s as the round's official
+    number (docs/PERFORMANCE.md:33-35 documents the >~2GB hazard).  Now
+    ONE uint8 batch (38.5MB, the serving wire format — normalize fuses
+    into conv1) is uploaded; a fori_loop with RUNTIME trip count runs
+    n and 2n optimizer steps through the same executable, and the slope
+    cancels dispatch+sync exactly (per-step launch latency amortizes
+    like steps_per_execution in production).  Parameter updates chain
+    every iteration, so the dispatch-memoizing tunnel runtime (r5
+    finding) cannot fake the number."""
     import jax
     import jax.numpy as jnp
 
@@ -374,46 +503,11 @@ def bench_resnet50(device, batch=256, warmup=1, iters=4):
     from analytics_zoo_tpu.train.optimizers import Adam
 
     reset_name_scope()
-    model = resnet50(class_num=1000)   # logits head (fc, no softmax)
+    model = resnet50(class_num=1000,   # logits head (fc, no softmax)
+                     bn_stats_fraction=bn_stats_fraction)
     rs = np.random.RandomState(0)
-    x = rs.randn(batch, 224, 224, 3).astype(np.float32)
+    x_u8 = rs.randint(0, 256, (batch, 224, 224, 3)).astype(np.uint8)
     y = rs.randint(0, 1000, batch).astype(np.int32)
-
-    with jax.default_device(device):
-        params, state = model.init(jax.random.PRNGKey(0))
-        tx = Adam(lr=1e-3)
-        opt_state = tx.init(params)
-        step = jax.jit(
-            build_step(model, tx, sparse_categorical_crossentropy_with_logits,
-                       compute_dtype=jnp.bfloat16),
-            donate_argnums=(0, 1, 2))
-        xs = [jax.device_put(jnp.asarray(x), device)]
-        yd = jax.device_put(jnp.asarray(y), device)
-        carry = (jax.device_put(params, device),
-                 jax.device_put(state, device),
-                 jax.device_put(opt_state, device))
-        dt = _time_steps(step, carry, (xs, yd), warmup, iters)
-    return batch * iters / dt
-
-
-def bench_resnet50_fused(device, batch=256, k_steps=4, iters=3):
-    """ResNet-50 with K train steps fused into one dispatch (lax.scan
-    over a stacked superbatch) — removes the per-step launch latency the
-    plain bench pays (~2.5-8ms of ~160ms/step on the tunnel)."""
-    import jax
-    import jax.numpy as jnp
-
-    from analytics_zoo_tpu.models.image.imageclassification import resnet50
-    from analytics_zoo_tpu.nn import reset_name_scope
-    from analytics_zoo_tpu.nn.objectives import (
-        sparse_categorical_crossentropy_with_logits)
-    from analytics_zoo_tpu.train.optimizers import Adam
-
-    reset_name_scope()
-    model = resnet50(class_num=1000)
-    rs = np.random.RandomState(0)
-    x = rs.randn(k_steps, batch, 224, 224, 3).astype(np.float32)
-    y = rs.randint(0, 1000, (k_steps, batch)).astype(np.int32)
 
     with jax.default_device(device):
         params, state = model.init(jax.random.PRNGKey(0))
@@ -423,28 +517,42 @@ def bench_resnet50_fused(device, batch=256, k_steps=4, iters=3):
                           sparse_categorical_crossentropy_with_logits,
                           compute_dtype=jnp.bfloat16)
 
-        def fused(params, state, opt_state, xk, yk):
-            def body(carry, bt):
-                p, s, o = carry
-                bx, by = bt
-                p, s, o, loss = step(p, s, o, [bx], by)
-                return (p, s, o), loss
+        @jax.jit
+        def many(carry, xu8, yb, n):
+            xb = [xu8.astype(jnp.float32) / 255.0]
 
-            (params, state, opt_state), losses = jax.lax.scan(
-                body, (params, state, opt_state), (xk, yk))
-            return params, state, opt_state, losses[-1]
+            def body(_, c):
+                p, s, o = c
+                p, s, o, _loss = step(p, s, o, xb, yb)
+                return (p, s, o)
 
-        fused = jax.jit(fused, donate_argnums=(0, 1, 2))
-        xd = jax.device_put(jnp.asarray(x), device)
+            return jax.lax.fori_loop(0, n, body, carry)
+
+        xd = jax.device_put(jnp.asarray(x_u8), device)
         yd = jax.device_put(jnp.asarray(y), device)
         carry = (jax.device_put(params, device),
                  jax.device_put(state, device),
                  jax.device_put(opt_state, device))
-        dt = _time_steps(fused, carry, (xd, yd), 1, iters)
-    return batch * k_steps * iters / dt
+        _sync(many(carry, xd, yd, 1))          # compile + warm
+
+        def t(n):
+            t0 = time.perf_counter()
+            _sync(many(carry, xd, yd, n))
+            return time.perf_counter() - t0
+
+        # distinct trip counts per dispatch (memoization-proof) +
+        # least-squares slope, as in _measure_scan
+        pts = [((r + 2) * n1, t((r + 2) * n1))
+               for r in range(max(2, rounds))]
+        ns = np.asarray([p[0] for p in pts], np.float64)
+        ts = np.asarray([p[1] for p in pts], np.float64)
+        denom = ((ns - ns.mean()) ** 2).sum()
+        slope = ((ns - ns.mean()) * (ts - ts.mean())).sum() / denom
+        per_step = max(slope, 1e-12)
+    return batch / per_step
 
 
-def bench_resnet_accuracy(device, n=2048, size=64, epochs=8, batch=256):
+def bench_resnet_accuracy(device, n=1792, size=64, epochs=5, batch=256):
     """Accuracy evidence for BASELINE config #2: train a ResNet on a
     cats-vs-dogs-shaped binary set to convergence through the full
     Estimator path.  The synthetic classes differ by a localized texture
@@ -548,7 +656,7 @@ def bench_wide_and_deep(device, batch=8192, k_steps=32, iters=3,
     return batch * k_steps * iters / dt
 
 
-def bench_nnframes(n=200_000, epochs=2, batch=8192):
+def bench_nnframes(n=120_000, epochs=2, batch=8192):
     """NNFrames end-to-end rows/sec (BASELINE config #3): DataFrame →
     NNEstimator.fit → NNModel.transform, including the pandas column
     extraction — the whole Spark-ML-shaped pipeline, not just the jitted
@@ -588,57 +696,137 @@ def bench_nnframes(n=200_000, epochs=2, batch=8192):
 # Attention: Pallas flash kernel on silicon vs XLA blockwise fallback
 # ---------------------------------------------------------------------------
 
-def _timed_rounds(cases, rounds=3, iters_per_round=8):
-    """Time each compiled thunk as min-of-``rounds`` interleaved rounds.
+def _scan_time_ms(fn, carry0, K=16, rounds=3, probe=True):
+    """TRUE per-call device time: K data-DEPENDENT applications fused in
+    ONE dispatch via lax.scan, slope over (K, 2K) dispatches.
 
-    The tunnel's dispatch latency drifts 2-3x over tens of seconds, so
-    back-to-back case timing biases whichever ran during a bad window;
-    interleaving rounds (A B C A B C ...) exposes every case to the same
-    drift and the per-case MIN estimates the least-contended time."""
-    best = {name: float("inf") for name in cases}
+    This replaced the repeated-thunk timer after r5 discovered the
+    tunnel runtime MEMOIZES identical-input dispatches (10 calls of
+    f(x) with the same buffer returned in ~0 device time, which is how
+    r4's flash/int8 "wins" were minted).  Here every iteration's input
+    is derived from the previous output (no memoization possible), the
+    K iterations ride one dispatch (the ~20ms per-dispatch tunnel floor
+    amortizes out), and the two-point slope cancels dispatch+sync
+    exactly.  ``fn(carry) -> array_like_carry``."""
+    many = _make_scan_program(fn)
+    _sync(many(carry0, K))              # compile + warm (one program)
+    return _measure_scan(many, carry0, K, rounds, probe)
 
-    def window(thunk, n):
-        r = thunk()
+
+def _make_scan_program(fn):
+    """ONE compile per case: the trip count is a RUNTIME argument
+    (fori_loop lowers to while_loop), so the K and 2K windows share the
+    same executable — compiling two scan programs per case blew a 536s
+    attention section in the first r5 validation run."""
+    import jax
+
+    @jax.jit
+    def many(c0, n):
+        def body(_, c):
+            out = fn(c)
+            return 0.5 * c + 0.5 * out.astype(c.dtype)
+        return jax.lax.fori_loop(0, n, body, c0)
+
+    return many
+
+
+def _measure_scan(many, carry0, K, rounds, probe=True):
+    """Slope measurement of an already-warmed scan program.
+
+    EVERY timed dispatch uses a DISTINCT trip count (K, 2K, 3K, ...) so
+    no two dispatches are byte-identical — the memoizing tunnel runtime
+    (see module notes) can never serve a cached result into the fit.
+    The least-squares slope over the (n, t) points cancels the constant
+    dispatch+sync cost exactly like the two-point version did."""
+    def t(n):
         t0 = time.perf_counter()
-        for _ in range(n):
-            r = thunk()
-        _sync(r)
+        _sync(many(carry0, n))
         return time.perf_counter() - t0
 
-    for _ in range(rounds):
-        for name, thunk in cases.items():
-            # two-point slope cancels the constant end-sync round trip
-            # (~110ms on the tunnel) that would otherwise inflate every
-            # case by sync/n
-            t1 = window(thunk, iters_per_round)
-            t2 = window(thunk, 2 * iters_per_round)
-            per = (t2 - t1) if t2 > t1 else t1
-            best[name] = min(best[name], per / iters_per_round * 1e3)
-    return {k: round(v, 3) for k, v in best.items()}
+    # auto-scale K until the window dwarfs transport jitter (~±10ms on
+    # the tunnel); each probe n is distinct, so probes can't be cached
+    while probe and K < 4096 and t(K + K // 4) < 0.08:
+        K *= 4
+    pts = []
+    for r in range(max(2, rounds + 1)):
+        n = (r + 1) * K
+        pts.append((n, t(n)))
+    ns = np.asarray([p[0] for p in pts], np.float64)
+    ts = np.asarray([p[1] for p in pts], np.float64)
+    denom = ((ns - ns.mean()) ** 2).sum()
+    slope = ((ns - ns.mean()) * (ts - ts.mean())).sum() / denom
+    return max(slope, 1e-12) * 1e3
 
 
-def bench_attention(device, B=4, H=8, L=2048, D=64, iters=30,
-                    include_stock=True):
+def _warm_parallel(cases, threads=6):
+    """Compile+warm scan programs CONCURRENTLY (XLA compilation releases
+    the GIL; measured r5: 3 flash-kernel programs compile in 33.6s
+    threaded vs 82.0s serial).  ``cases``: iterable of (many, carry0).
+    Errors are captured per-case and returned, not raised."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    errs = {}
+
+    def one(idx_case):
+        idx, (many, carry0) = idx_case
+        try:
+            _sync(many(carry0, 1))
+        except Exception as e:          # noqa: BLE001 — per-case report
+            errs[idx] = e
+    with ThreadPoolExecutor(threads) as ex:
+        list(ex.map(one, enumerate(cases)))
+    return errs
+
+
+def bench_attention(device, B=4, H=8, L=2048, D=64, K=None,
+                    include_stock=True, include_bwd=True,
+                    include_blockwise=True, blockwise_bwd=False,
+                    rounds=3):
     """Hand-written Pallas flash kernel vs the XLA blockwise fallback vs
     the STOCK jax.experimental.pallas.ops.tpu flash kernel — the
-    adopt-or-beat comparison (VERDICT r2 weak #5)."""
+    adopt-or-beat comparison (VERDICT r2 weak #5), measured with the
+    memoization-proof scan-fused timer (r5 true-time methodology: data
+    dependence between iterations, one dispatch per window).
+    ``include_bwd=False`` halves the compile bill for the secondary
+    context lengths so all three lengths always fit the bench window."""
     import jax
     import jax.numpy as jnp
 
     from analytics_zoo_tpu.ops.attention import blockwise_attention
     from analytics_zoo_tpu.ops.flash_attention import flash_attention
 
+    if K is None:
+        K = 4 if L >= 8192 else 16
     rs = np.random.RandomState(0)
     mk = lambda: jax.device_put(
         jnp.asarray(rs.randn(B, H, L, D).astype(np.float32)), device)
     q, k, v = mk(), mk(), mk()
 
     out = {}
-    cases = {}
+    built = _build_attention_cases(out, q, k, v, D, K, rounds,
+                                   include_stock, include_bwd,
+                                   include_blockwise, blockwise_bwd)
+    errs = _warm_parallel([(m, c) for _, m, c, _, _ in built])
+    _finish_attention_cases(out, built, errs)
+    return out
+
+
+def _build_attention_cases(out, q, k, v, D, K, rounds, include_stock,
+                           include_bwd, include_blockwise, blockwise_bwd):
+    """Construct (key, many, carry, K, rounds) scan cases for one
+    (q, k, v) shape — compilation deferred so a suite can warm every
+    length's programs concurrently."""
+    import jax
+    import jax.numpy as jnp
+
+    from analytics_zoo_tpu.ops.attention import blockwise_attention
+    from analytics_zoo_tpu.ops.flash_attention import flash_attention
+
     pairs = [("flash", lambda q, k, v: flash_attention(
-                  q, k, v, causal=True)),
-             ("blockwise", lambda q, k, v: blockwise_attention(
-                 q, k, v, causal=True))]
+                  q, k, v, causal=True))]
+    if include_blockwise:
+        pairs.append(("blockwise", lambda q, k, v: blockwise_attention(
+            q, k, v, causal=True)))
     if include_stock:
         try:
             from jax.experimental.pallas.ops.tpu.flash_attention import (
@@ -649,19 +837,27 @@ def bench_attention(device, B=4, H=8, L=2048, D=64, iters=30,
                                                       sm_scale=sm)))
         except Exception as e:
             out["stock_pallas_error"] = type(e).__name__
+    built = []
     for name, fn in pairs:
+        built.append((f"{name}_ms", _make_scan_program(
+            lambda c, fn=fn: fn(c, k, v)), q, K, rounds))
+        if include_bwd and (name != "blockwise" or blockwise_bwd):
+            grad_q = jax.grad(lambda a, b, c, fn=fn: jnp.sum(fn(a, b, c)))
+            built.append((f"{name}_fwdbwd_ms", _make_scan_program(
+                lambda c, g=grad_q: g(c, k, v)), q, max(2, K // 2),
+                rounds))
+    return built
+
+
+def _finish_attention_cases(out, built, errs):
+    for idx, (key, many, carry, K, rounds) in enumerate(built):
+        if idx in errs:                 # pallas unavailable / OOM etc.
+            out[key.replace("_ms", "_error")] = type(errs[idx]).__name__
+            continue
         try:
-            f = jax.jit(fn)
-            _sync(f(q, k, v))                       # compile
-            cases[f"{name}_ms"] = (lambda f=f: f(q, k, v))
-            fb = jax.jit(jax.grad(
-                lambda a, b, c: jnp.sum(fn(a, b, c)), argnums=(0, 1, 2)))
-            _sync(fb(q, k, v))                      # compile bwd kernels
-            cases[f"{name}_fwdbwd_ms"] = (lambda fb=fb: fb(q, k, v))
-        except Exception as e:          # pallas unavailable on this backend
-            out[f"{name}_error"] = type(e).__name__
-    out.update(_timed_rounds(cases, rounds=3,
-                             iters_per_round=max(2, iters // 3)))
+            out[key] = round(_measure_scan(many, carry, K, rounds), 3)
+        except Exception as e:          # noqa: BLE001
+            out[key.replace("_ms", "_error")] = type(e).__name__
     if "flash_ms" in out and "blockwise_ms" in out:
         out["flash_speedup"] = round(out["blockwise_ms"] / out["flash_ms"], 2)
     if "flash_fwdbwd_ms" in out and "blockwise_fwdbwd_ms" in out:
@@ -670,7 +866,40 @@ def bench_attention(device, B=4, H=8, L=2048, D=64, iters=30,
     if "flash_ms" in out and "stock_pallas_ms" in out:
         out["flash_vs_stock"] = round(
             out["stock_pallas_ms"] / out["flash_ms"], 2)
-    return out
+
+
+def bench_attention_suite(device, specs):
+    """All context lengths in one pass: BUILD every case, warm ALL
+    programs concurrently (threaded XLA compile, ~2.4x wall), then
+    measure serially on the quiet device.  ``specs``: [(L, kw), ...]."""
+    import jax
+    import jax.numpy as jnp
+
+    rs = np.random.RandomState(0)
+    per_len = []
+    all_cases = []
+    for L, kw in specs:
+        B, H, D = kw.pop("B", 4), kw.pop("H", 8), kw.pop("D", 64)
+        K = kw.pop("K", 4 if L >= 8192 else 16)
+        mk = lambda: jax.device_put(
+            jnp.asarray(rs.randn(B, H, L, D).astype(np.float32)), device)
+        q, k, v = mk(), mk(), mk()
+        out = {}
+        built = _build_attention_cases(
+            out, q, k, v, D, K, kw.pop("rounds", 2),
+            kw.pop("include_stock", True), kw.pop("include_bwd", True),
+            kw.pop("include_blockwise", True),
+            kw.pop("blockwise_bwd", False))
+        per_len.append((L, out, built, len(all_cases)))
+        all_cases.extend((m, c) for _, m, c, _, _ in built)
+    errs = _warm_parallel(all_cases)
+    results = {}
+    for L, out, built, ofs in per_len:
+        local_errs = {i - ofs: e for i, e in errs.items()
+                      if ofs <= i < ofs + len(built)}
+        _finish_attention_cases(out, built, local_errs)
+        results[f"attention_l{L}"] = out
+    return results
 
 
 # ---------------------------------------------------------------------------
@@ -678,7 +907,11 @@ def bench_attention(device, B=4, H=8, L=2048, D=64, iters=30,
 # wp-bigdl.md:192, realised on the MXU's native int8 path)
 # ---------------------------------------------------------------------------
 
-def bench_int8(device, n=8192, iters=12):
+def bench_int8(device, n=4096, K=128):
+    """int8 MXU matmul vs bf16/f32 with the memoization-proof scan-fused
+    timer (see _scan_time_ms).  n=4096 keeps the upload at 64MB on the
+    ~10MB/s tunnel; true device times at this size are ~0.4-0.9ms so the
+    K-fused windows dwarf transport jitter."""
     import jax
     import jax.numpy as jnp
 
@@ -692,24 +925,28 @@ def bench_int8(device, n=8192, iters=12):
     wq = jax.device_put(wq, device)
     wscale = jax.device_put(jnp.asarray(wscale).reshape(-1), device)
     wd = jax.device_put(jnp.asarray(w), device)
+    wbf = jax.device_put(jnp.asarray(w).astype(jnp.bfloat16), device)
     xscale = float(np.abs(rs.randn(10000)).max() / 127)
 
     out = {}
-    cases = {
-        "f32": jax.jit(lambda a, b: a @ b),
-        "bf16": jax.jit(lambda a, b: (a.astype(jnp.bfloat16)
-                                      @ b.astype(jnp.bfloat16))),
-        "int8": jax.jit(lambda a, q: int8_dot(a, q, wscale,
-                                              x_scale=xscale)),
-    }
-    thunks = {}
-    for name, f in cases.items():
-        arg = wq if name == "int8" else wd
-        _sync(f(x, arg))                            # compile
-        thunks[f"{name}_ms"] = (lambda f=f, arg=arg: f(x, arg))
-    out.update(_timed_rounds(thunks, rounds=3,
-                             iters_per_round=max(2, iters // 3)))
-    out["int8_vs_f32_speedup"] = round(out["f32_ms"] / out["int8_ms"], 2)
+    progs = {"f32_ms": _make_scan_program(lambda c: c @ wd),
+             "bf16_ms": _make_scan_program(
+                 lambda c: c.astype(jnp.bfloat16) @ wbf),
+             "int8_ms": _make_scan_program(
+                 lambda c: int8_dot(c, wq, wscale, x_scale=xscale))}
+    errs = _warm_parallel([(m, x) for m in progs.values()], threads=3)
+    for idx, (key, many) in enumerate(progs.items()):
+        if idx in errs:
+            out[key.replace("_ms", "_error")] = type(errs[idx]).__name__
+            continue
+        out[key] = round(_measure_scan(many, x, K, rounds=2,
+                                       probe=False), 3)
+    if "f32_ms" in out and "int8_ms" in out:
+        out["int8_vs_f32_speedup"] = round(out["f32_ms"] / out["int8_ms"],
+                                           2)
+    if "bf16_ms" in out and "int8_ms" in out:
+        out["int8_vs_bf16_speedup"] = round(
+            out["bf16_ms"] / out["int8_ms"], 2)
     return out
 
 
@@ -744,14 +981,27 @@ def bench_serving(n_requests=32, concurrency=8):
                                       preprocess=imagenet_preprocess(),
                                       batch_buckets=(1, 32))
     rs = np.random.RandomState(0)
-    img = rs.randint(0, 256, (1, 224, 224, 3)).astype(np.uint8)
+    # DISTINCT image per request: the tunnel runtime memoizes
+    # identical-input dispatches (r5 finding), so re-sending one buffer
+    # measures the cache, not the model
+    imgs = [rs.randint(0, 256, (1, 224, 224, 3)).astype(np.uint8)
+            for _ in range(12)]
+    img = imgs[0]
+
+    # warm BOTH shape buckets concurrently (threaded XLA compile)
+    from concurrent.futures import ThreadPoolExecutor
+
+    with ThreadPoolExecutor(2) as ex:
+        futs = [ex.submit(m.predict, [img]),
+                ex.submit(m.predict, [np.repeat(img, 32, axis=0)])]
+        for f in futs:
+            f.result()
 
     # single-request latency (p50/p99 over sequential calls)
-    m.predict([img])                                  # compile bucket 1
     lats = []
-    for _ in range(10):
+    for i in range(10):
         t0 = time.perf_counter()
-        m.predict([img])
+        m.predict([imgs[1 + (i % 11)]])
         lats.append((time.perf_counter() - t0) * 1e3)
     lats.sort()
     out = {"latency_p50_ms": round(lats[len(lats) // 2], 2),
@@ -762,13 +1012,16 @@ def bench_serving(n_requests=32, concurrency=8):
     # many threads coalesce into one padded device batch)
     batcher = DynamicBatcher(m, max_batch=32, max_latency_ms=5.0)
     try:
-        batcher.predict([img])                     # compile bucket 32
+        batcher.predict([img])                     # bucket 32 pre-warmed
         done = []
         lock = threading.Lock()
 
         def client(k):
+            crs = np.random.RandomState(100 + k)
             for _ in range(n_requests // concurrency):
-                r = batcher.predict([img])
+                fresh = crs.randint(0, 256, (1, 224, 224, 3)).astype(
+                    np.uint8)
+                r = batcher.predict([fresh])
                 with lock:
                     done.append(r)
 
@@ -895,9 +1148,77 @@ def main():
     on_tpu = accel.platform != "cpu"
     extra = {}
     section_s = {}
+    extra["section_seconds"] = section_s
+    report = {"metric": "ncf_movielens1m_train_samples_per_sec_per_chip",
+              "value": 0.0, "unit": "samples/sec/chip",
+              "vs_baseline": None, "extra": extra}
+    watchdog = _Watchdog(report)
 
     def _mark(name, t0):
+        import sys
         section_s[name] = round(time.time() - t0, 1)
+        print(f"[bench] {name}: {section_s[name]}s "
+              f"(elapsed {time.time() - _T0:.0f}s of {_BUDGET_S:.0f})",
+              file=sys.stderr, flush=True)
+
+    # --- ORDERING (r4 verdict #1): the cheap case-comparisons run FIRST
+    # and unconditionally, so the driver artifact can never again drop
+    # flash-vs-stock / int8 / serving / WND / nnframes to "time budget".
+    # The expensive tail (headline, resnet, convergence, accuracy) then
+    # spends what remains, cheapest-informative first.
+
+    # Pallas flash attention on silicon: hand-written vs blockwise vs the
+    # stock pallas kernel, across context lengths (VERDICT r2 #10).
+    # L=2048 carries fwd+bwd; the secondary lengths time fwd only (half
+    # the compiles) so all three ALWAYS land.
+    t0 = time.time()
+    # compile bill governs this section (~20s per program on this
+    # chip), so every length's programs warm CONCURRENTLY (threaded XLA
+    # compile) before serial measurement.  The pinning is flash-vs-STOCK
+    # (r2 ask) at three lengths; L2048 adds fwd+bwd.  The blockwise-XLA
+    # fallback is exercised by tests and the L8192 doc numbers.
+    try:
+        extra.update(bench_attention_suite(accel, [
+            (2048, dict(include_blockwise=False)),
+            (1024, dict(include_bwd=False, include_blockwise=False)),
+            (8192, dict(include_bwd=False, include_blockwise=False)),
+        ]))
+    except Exception as e:
+        extra["attention_error"] = f"{type(e).__name__}: {e}"
+    _mark("attention", t0)
+
+    # int8 MXU matmul vs f32/bf16 (the int8 inference claim)
+    t0 = time.time()
+    try:
+        extra["matmul_4096"] = bench_int8(accel)
+    except Exception as e:
+        extra["int8_error"] = f"{type(e).__name__}: {e}"
+    _mark("int8", t0)
+
+    # BASELINE config #5: serving latency + batched throughput
+    t0 = time.time()
+    try:
+        extra["serving_mobilenet"] = bench_serving()
+    except Exception as e:
+        extra["serving_error"] = f"{type(e).__name__}: {e}"
+    _mark("serving", t0)
+
+    # BASELINE config #4: WideAndDeep throughput
+    t0 = time.time()
+    try:
+        extra["wide_and_deep_samples_per_sec"] = round(
+            bench_wide_and_deep(accel), 1)
+    except Exception as e:
+        extra["wide_and_deep_error"] = f"{type(e).__name__}: {e}"
+    _mark("wide_and_deep", t0)
+
+    # BASELINE config #3: NNFrames DataFrame pipeline rows/sec
+    t0 = time.time()
+    try:
+        extra["nnframes"] = bench_nnframes()
+    except Exception as e:
+        extra["nnframes_error"] = f"{type(e).__name__}: {e}"
+    _mark("nnframes", t0)
 
     # headline: NCF throughput, bf16 (MXU) with f32 quoted alongside.
     # batch/k chosen by on-chip sweep (65536x128 fused: 19M vs 8.2M at
@@ -905,10 +1226,10 @@ def main():
     t0 = time.time()
     hb, hk = (65536, 128) if on_tpu else (8192, 8)
     extra["headline_config"] = {"batch": hb, "k_steps": hk}
-    value_f32 = bench_ncf(accel, batch=hb, k_steps=hk, iters=3)
+    value_f32 = bench_ncf(accel, batch=hb, k_steps=hk, iters=2)
     extra["ncf_f32_samples_per_sec"] = round(value_f32, 1)
     if on_tpu:
-        value_bf16 = bench_ncf(accel, batch=hb, k_steps=hk, iters=3,
+        value_bf16 = bench_ncf(accel, batch=hb, k_steps=hk, iters=2,
                                compute_dtype="bfloat16")
         extra["ncf_bf16_samples_per_sec"] = round(value_bf16, 1)
         value = max(value_bf16, value_f32)
@@ -917,8 +1238,9 @@ def main():
     else:
         value = value_f32
         extra["dtype"] = "float32"
-
+    report["value"] = round(value, 1)    # watchdog snapshot carries it
     _mark("ncf_headline", t0)
+
     vs_baseline = None
     t0 = time.time()
     try:
@@ -930,136 +1252,72 @@ def main():
         if cpu_tput > 0:
             vs_baseline = value / cpu_tput
             extra["cpu_baseline_samples_per_sec"] = round(cpu_tput, 1)
+            report["vs_baseline"] = round(vs_baseline, 3)
     except Exception:
         pass
-
     _mark("cpu_baseline", t0)
-    # north-star evidence: convergence + accuracy through the full path
-    t0 = time.time()
-    if _remaining() > 150:
-        try:
-            # scale depth to the time actually left: the 2-seed score
-            # ensemble buys ~+0.4 HR@10 points (r4 measured 0.929 at
-            # 2x8 epochs vs 0.9255 single-12) when the window allows
-            if _remaining() > 420:
-                ens, ep = 2, 8
-            else:
-                ens, ep = 1, (12 if _remaining() > 280 else 8)
-            extra["ncf_convergence"] = bench_ncf_convergence(
-                epochs=ep, ensemble=ens)
-        except Exception as e:
-            extra["ncf_convergence_error"] = f"{type(e).__name__}: {e}"
-    else:
-        extra["ncf_convergence_skipped"] = "time budget"
 
-    _mark("ncf_convergence", t0)
-    # BASELINE config #2: ResNet-50 imgs/sec (bf16 train step; the
-    # K-fused variant amortizes launch latency — MFU evidence)
+    # BASELINE config #2: ResNet-50 imgs/sec — one sound launch-amortized
+    # measurement (see bench_resnet50: supersedes the r4 plain/fused
+    # pair whose fused leg wedged the tunnel with a 2.47GB upload).
+    # Primary leg = ghost-BN stats_fraction=0.25 (the r4 verdict's BN
+    # bandwidth-wall attack: quarter-batch statistics cut the stats-pass
+    # HBM traffic; accuracy parity in tests/test_ghost_bn.py) — r5
+    # on-silicon: 2539 imgs/s vs 2433 full-BN (and 2743 at frac=0.125).
     t0 = time.time()
-    if _remaining() > 120:
+    if _remaining() > 90:
         try:
-            extra["resnet50_imgs_per_sec_per_chip"] = round(
-                bench_resnet50(accel), 2)
+            tput = round(bench_resnet50(accel, bn_stats_fraction=0.25), 2)
+            extra["resnet50_imgs_per_sec_per_chip"] = tput
+            extra["resnet50_bn_stats_fraction"] = 0.25
+            extra["resnet50_method"] = ("4/8-step fori slope, uint8 feed "
+                                        "(launch-amortized; no superbatch)")
         except Exception as e:
             extra["resnet50_error"] = f"{type(e).__name__}: {e}"
     else:
         extra["resnet50_skipped"] = "time budget"
-    if on_tpu and _remaining() > 100:
+    if _remaining() > 330:      # full-BN comparison leg on underrun
         try:
-            extra["resnet50_fused_k4_imgs_per_sec"] = round(
-                bench_resnet50_fused(accel), 2)
+            extra["resnet50_full_bn_imgs_per_sec"] = round(
+                bench_resnet50(accel, bn_stats_fraction=1.0), 2)
         except Exception as e:
-            extra["resnet50_fused_error"] = f"{type(e).__name__}: {e}"
-
+            extra["resnet50_full_bn_error"] = f"{type(e).__name__}: {e}"
     _mark("resnet50", t0)
-    # All five BASELINE configs carry a measurement BEFORE the
-    # adopt-or-beat extras: #4 WideAndDeep, #3 NNFrames, #5 Serving run
-    # next (cheap), then attention/int8, and the costly config #2
-    # accuracy leg takes whatever window is left.
-    # BASELINE config #4: WideAndDeep throughput
+
+    # north-star evidence in ONE run: matched-accuracy convergence with
+    # device-resident data + the CPU leg of the SAME code path.  Runs
+    # BEFORE the resnet accuracy leg (it is the BASELINE.json headline
+    # evidence).  Depth adapts to the window: the 2-seed score ensemble
+    # buys ~+0.4 HR@10 points (r4: 0.929 at 2x8 vs 0.9255 single-12)
     t0 = time.time()
-    if _remaining() > 60:
+    if _remaining() > 100:
         try:
-            extra["wide_and_deep_samples_per_sec"] = round(
-                bench_wide_and_deep(accel), 1)
+            if _remaining() > 280:
+                ens, ep = 2, 8
+            else:
+                ens, ep = 1, (12 if _remaining() > 140 else 8)
+            extra["ncf_convergence"] = bench_ncf_convergence(
+                epochs=ep, ensemble=ens,
+                cpu_baseline_epochs=2 if on_tpu else 0)
         except Exception as e:
-            extra["wide_and_deep_error"] = f"{type(e).__name__}: {e}"
+            extra["ncf_convergence_error"] = f"{type(e).__name__}: {e}"
     else:
-        extra["wide_and_deep_skipped"] = "time budget"
+        extra["ncf_convergence_skipped"] = "time budget"
+    _mark("ncf_convergence", t0)
 
-    _mark("wide_and_deep", t0)
-    # BASELINE config #3: NNFrames DataFrame pipeline rows/sec
+    # config #2 accuracy leg: cats-vs-dogs-shaped convergence
     t0 = time.time()
-    if _remaining() > 45:
-        try:
-            extra["nnframes"] = bench_nnframes()
-        except Exception as e:
-            extra["nnframes_error"] = f"{type(e).__name__}: {e}"
-    else:
-        extra["nnframes_skipped"] = "time budget"
-
-    _mark("nnframes", t0)
-    # BASELINE config #5: serving latency + batched throughput
-    t0 = time.time()
-    if _remaining() > 90:
-        try:
-            extra["serving_mobilenet"] = bench_serving()
-        except Exception as e:
-            extra["serving_error"] = f"{type(e).__name__}: {e}"
-    else:
-        extra["serving_skipped"] = "time budget"
-
-    _mark("serving", t0)
-    # Pallas flash attention on silicon: hand-written vs blockwise vs the
-    # stock pallas kernel, across context lengths (VERDICT r2 #10)
-    t0 = time.time()
-    if _remaining() > 45:
-        try:
-            extra["attention_l2048"] = bench_attention(accel)
-        except Exception as e:
-            extra["attention_error"] = f"{type(e).__name__}: {e}"
-    for L in (1024, 8192):
-        if _remaining() > 60:
-            try:
-                # short lengths are cheap per call: more iters per round
-                # or the tunnel's per-dispatch latency drowns the kernel
-                extra[f"attention_l{L}"] = bench_attention(
-                    accel, L=L, iters=48 if L <= 1024 else 12)
-            except Exception as e:
-                extra[f"attention_l{L}_error"] = f"{type(e).__name__}: {e}"
-
-    _mark("attention", t0)
-    # int8 MXU matmul vs f32/bf16 (the int8 inference claim)
-    t0 = time.time()
-    if _remaining() > 30:
-        try:
-            extra["matmul_4096"] = bench_int8(accel)
-        except Exception as e:
-            extra["int8_error"] = f"{type(e).__name__}: {e}"
-    else:
-        extra["int8_skipped"] = "time budget"
-
-    _mark("int8", t0)
-    # config #2 accuracy leg: cats-vs-dogs-shaped convergence — the most
-    # expensive optional section, so it spends the leftover window
-    t0 = time.time()
-    if _remaining() > 150:
+    if _remaining() > 40:
         try:
             extra["resnet_accuracy"] = bench_resnet_accuracy(accel)
         except Exception as e:
             extra["resnet_accuracy_error"] = f"{type(e).__name__}: {e}"
     else:
         extra["resnet_accuracy_skipped"] = "time budget"
-
     _mark("resnet_accuracy", t0)
-    extra["section_seconds"] = section_s
-    print(json.dumps({
-        "metric": "ncf_movielens1m_train_samples_per_sec_per_chip",
-        "value": round(value, 1),
-        "unit": "samples/sec/chip",
-        "vs_baseline": round(vs_baseline, 3) if vs_baseline else None,
-        "extra": extra,
-    }))
+    report["value"] = round(value, 1)
+    report["vs_baseline"] = round(vs_baseline, 3) if vs_baseline else None
+    watchdog.emit()
 
 
 if __name__ == "__main__":

@@ -55,6 +55,10 @@ def main():
           .shape_sequence(args.max_len))
     x, y = ts.to_arrays()
     y = y.astype(np.int32)
+    # the generator emits texts grouped by class — shuffle before the
+    # split or the validation slice is single-class
+    perm = np.random.RandomState(0).permutation(len(y))
+    x, y = x[perm], y[perm]
 
     tokens = Input(shape=(args.max_len,), dtype="int32")
     seq = TransformerLayer(vocab=args.max_features, seq_len=args.max_len,

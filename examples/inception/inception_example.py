@@ -70,6 +70,10 @@ def main():
         x, y = synthetic_imagenet(size=args.image_size,
                                   classes=args.class_num)
 
+    # folder reads arrive grouped by class directory — shuffle before
+    # the split or the validation slice is the last class only
+    perm = np.random.RandomState(0).permutation(len(y))
+    x, y = x[perm], y[perm]
     steps_per_epoch = len(y) // args.batch_size
     warmup = args.warmup_epochs * steps_per_epoch
     total = args.max_epoch * steps_per_epoch

@@ -48,15 +48,20 @@ def obs(env, height=16, width=12):
 
 
 def step_env(env, action, height=16, width=12):
-    """action in {0: left, 1: stay, 2: right}; returns (env, reward, done)."""
+    """action in {0: left, 1: stay, 2: right}; returns (env, reward, done).
+
+    The terminal reward fires exactly ONCE — on the step the ball
+    CROSSES the bottom row — so the return is invariant to ``--horizon``
+    (longer horizons just step a finished, frozen episode)."""
     paddle = jnp.clip(env["paddle"] + (action - 1.0), 0.0, width - 1)
     ball_x = jnp.clip(env["ball_x"] + env["vel_x"], 0.0, width - 1)
     ball_y = env["ball_y"] + 1.0
+    arrived = (ball_y >= height - 1) & (env["ball_y"] < height - 1)
     done = ball_y >= height - 1
     caught = jnp.abs(ball_x - paddle) <= 1.5
-    reward = jnp.where(done, jnp.where(caught, 1.0, -1.0), 0.0)
-    return {"ball_x": ball_x, "ball_y": ball_y, "vel_x": env["vel_x"],
-            "paddle": paddle}, reward, done
+    reward = jnp.where(arrived, jnp.where(caught, 1.0, -1.0), 0.0)
+    return {"ball_x": ball_x, "ball_y": jnp.minimum(ball_y, height - 1.0),
+            "vel_x": env["vel_x"], "paddle": paddle}, reward, done
 
 
 def policy_net(params, o):
@@ -85,6 +90,9 @@ def main():
     args = ap.parse_args()
 
     init_zoo_context()
+    if args.horizon < 15:
+        print(f"note: --horizon {args.horizon} < 15 (the drop height): "
+              "episodes never terminate, every return is 0")
     tx = optax.adam(args.lr)
     params = init_params(jax.random.PRNGKey(0))
     opt_state = tx.init(params)

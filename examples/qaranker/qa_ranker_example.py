@@ -54,10 +54,16 @@ def to_pairs(relations, qx, ax, rs):
         by_q.setdefault(q, ([], []))[0 if l else 1].append(a)
     q1, a1, q2, a2 = [], [], [], []
     for q, (pos, neg) in by_q.items():
+        if not neg:         # all candidates relevant: nothing to rank
+            continue
         for p in pos:
             n = neg[rs.randint(len(neg))]
             q1.append(qx[q]); a1.append(ax[p])
             q2.append(qx[q]); a2.append(ax[n])
+    if not q1:
+        raise ValueError("no (relevant, irrelevant) pairs in relations — "
+                         "pairwise ranking needs at least one negative "
+                         "per question")
     qs = np.stack([v for pair in zip(q1, q2) for v in pair])
     ans = np.stack([v for pair in zip(a1, a2) for v in pair])
     y = np.tile([1.0, 0.0], len(q1)).astype(np.float32)
