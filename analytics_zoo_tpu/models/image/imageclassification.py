@@ -28,12 +28,13 @@ from analytics_zoo_tpu.nn.layers.pooling import (
 
 
 def _conv_bn(x, filters, k, strides=1, activation="relu", name=None,
-             border_mode="same", bn_stats_fraction=1.0):
+             border_mode="same", bn_stats_fraction=1.0, bn_momentum=0.99):
     x = Convolution2D(filters, k, k, subsample=(strides, strides),
                       border_mode=border_mode, bias=False,
                       name=None if name is None else f"{name}_conv")(x)
     x = BatchNormalization(name=None if name is None else f"{name}_bn",
-                           stats_fraction=bn_stats_fraction)(x)
+                           stats_fraction=bn_stats_fraction,
+                           momentum=bn_momentum)(x)
     if activation:
         x = Activation(activation)(x)
     return x
@@ -42,7 +43,7 @@ def _conv_bn(x, filters, k, strides=1, activation="relu", name=None,
 # ---------------------------------------------------------------- ResNet --
 
 def _bottleneck(x, filters, strides=1, downsample=False, name="",
-                bn_stats_fraction=1.0):
+                bn_stats_fraction=1.0, bn_momentum=0.99):
     shortcut = x
     if downsample:
         shortcut = Convolution2D(filters * 4, 1, 1,
@@ -50,15 +51,17 @@ def _bottleneck(x, filters, strides=1, downsample=False, name="",
                                  border_mode="same", bias=False,
                                  name=f"{name}_proj")(x)
         shortcut = BatchNormalization(
-            name=f"{name}_proj_bn",
+            name=f"{name}_proj_bn", momentum=bn_momentum,
             stats_fraction=bn_stats_fraction)(shortcut)
     y = _conv_bn(x, filters, 1, strides=strides, name=f"{name}_a",
-                 bn_stats_fraction=bn_stats_fraction)
+                 bn_stats_fraction=bn_stats_fraction,
+                 bn_momentum=bn_momentum)
     y = _conv_bn(y, filters, 3, name=f"{name}_b",
-                 bn_stats_fraction=bn_stats_fraction)
+                 bn_stats_fraction=bn_stats_fraction,
+                 bn_momentum=bn_momentum)
     y = Convolution2D(filters * 4, 1, 1, border_mode="same", bias=False,
                       name=f"{name}_c_conv")(y)
-    y = BatchNormalization(name=f"{name}_c_bn",
+    y = BatchNormalization(name=f"{name}_c_bn", momentum=bn_momentum,
                            stats_fraction=bn_stats_fraction)(y)
     out = merge([y, shortcut], mode="sum")
     return Activation("relu")(out)
@@ -67,7 +70,8 @@ def _bottleneck(x, filters, strides=1, downsample=False, name="",
 def resnet50(class_num: int = 1000,
              input_shape: Sequence[int] = (224, 224, 3),
              space_to_depth_stem: bool = True,
-             bn_stats_fraction: float = 1.0) -> Model:
+             bn_stats_fraction: float = 1.0,
+             bn_momentum: float = 0.99) -> Model:
     """ResNet-50 (bottleneck [3,4,6,3]).  Reference: examples/resnet/ and
     ImageClassificationConfig 'resnet-50' entry.
 
@@ -84,7 +88,7 @@ def resnet50(class_num: int = 1000,
     else:
         x = Convolution2D(64, 7, 7, subsample=(2, 2), border_mode="same",
                           bias=False, name="stem_conv")(inp)
-    x = BatchNormalization(name="stem_bn",
+    x = BatchNormalization(name="stem_bn", momentum=bn_momentum,
                            stats_fraction=bn_stats_fraction)(x)
     x = Activation("relu")(x)
     x = MaxPooling2D((3, 3), strides=(2, 2), border_mode="same")(x)
@@ -94,7 +98,8 @@ def resnet50(class_num: int = 1000,
             strides = 2 if (b == 0 and stage > 0) else 1
             x = _bottleneck(x, filters, strides=strides, downsample=(b == 0),
                             name=f"s{stage}b{b}",
-                            bn_stats_fraction=bn_stats_fraction)
+                            bn_stats_fraction=bn_stats_fraction,
+                            bn_momentum=bn_momentum)
     x = GlobalAveragePooling2D()(x)
     x = Dense(class_num, name="fc")(x)
     return Model(inp, x, name="resnet50")

@@ -89,8 +89,14 @@ def _sparse_labels(y_true, preds):
 
 
 def sparse_categorical_crossentropy(y_true, y_pred, zero_based_label=True):
-    """Integer targets vs probability outputs
-    (reference SparseCategoricalCrossEntropy, 0/1-based switch)."""
+    """Integer targets vs PROBABILITY outputs
+    (reference SparseCategoricalCrossEntropy, 0/1-based switch).
+
+    Pair logits heads — e.g. the models.image zoo (resnet50/inception/
+    mobilenet/vgg16 end in a raw Dense) — with
+    ``sparse_categorical_crossentropy_with_logits`` instead: feeding
+    logits here clips through the log and the model silently memorizes
+    without generalizing (r5 post-mortem in bench_resnet_accuracy)."""
     labels = _sparse_labels(y_true, y_pred)
     if not zero_based_label:
         labels = labels - 1

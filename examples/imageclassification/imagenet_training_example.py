@@ -104,7 +104,7 @@ def main():
 
     model = build(args.model, args.classes, args.image_size)
     model.compile(optimizer=Adam(lr=1e-3),
-                  loss="sparse_categorical_crossentropy",
+                  loss="sparse_categorical_crossentropy_with_logits",
                   metrics=["accuracy"])
     model.estimator.set_checkpoint(ckpt, trigger=EveryEpoch())
 
@@ -122,7 +122,7 @@ def main():
     reset_name_scope()
     model2 = build(args.model, args.classes, args.image_size)
     model2.compile(optimizer=Adam(lr=1e-3),
-                   loss="sparse_categorical_crossentropy",
+                   loss="sparse_categorical_crossentropy_with_logits",
                    metrics=["accuracy"])
     model2.estimator._ensure_built([x[:2]])
     model2.estimator.load_checkpoint(ckpt)

@@ -83,7 +83,7 @@ def main():
     model.compile(
         optimizer=SGD(lr=max_lr, momentum=0.9, schedule="poly",
                       warmup_steps=warmup, total_steps=total),
-        loss="sparse_categorical_crossentropy",
+        loss="sparse_categorical_crossentropy_with_logits",
         metrics=["accuracy", "top5_accuracy"])
 
     split = int(0.9 * len(y))
