@@ -596,15 +596,40 @@ class Estimator:
                            and self.ctx.process_count == 1)
         y_arr = y if device_resident else np.asarray(y)
 
+        # Pair-structured losses (rank_hinge: (pos, neg) rows interleaved)
+        # must shuffle PAIRS, not rows — a row-level permutation would
+        # scramble which positive faces which negative every epoch and
+        # silently train on random pairings.
+        pair_structured = getattr(self.loss_fn, "batch_structured", False)
+
+        def _pair_perm_np(rng):
+            pairs = rng.permutation(n // 2)
+            idx = np.empty((n // 2) * 2, np.int64)
+            idx[0::2] = pairs * 2
+            idx[1::2] = pairs * 2 + 1
+            if n % 2:
+                idx = np.concatenate([idx, [n - 1]])
+            return idx
+
         while epoch < epochs:
             batches = None
             try:
                 t0 = time.time()
                 if not shuffle:
                     perm = None         # contiguous slices in both modes
+                elif device_resident and pair_structured:
+                    pairs = jax.random.permutation(
+                        jax.random.PRNGKey(cfg.seed + 7919 * epoch), n // 2)
+                    perm = jnp.stack([pairs * 2, pairs * 2 + 1],
+                                     axis=1).reshape(-1)
+                    if n % 2:
+                        perm = jnp.concatenate(
+                            [perm, jnp.asarray([n - 1])])
                 elif device_resident:
                     perm = jax.random.permutation(
                         jax.random.PRNGKey(cfg.seed + 7919 * epoch), n)
+                elif pair_structured:
+                    perm = _pair_perm_np(rng_np)
                 else:
                     perm = rng_np.permutation(n)
                 losses = []

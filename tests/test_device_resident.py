@@ -140,3 +140,49 @@ def test_fit_device_resident_no_shuffle_matches_host_exactly(zoo_ctx):
     acc_host = m_host.evaluate(x, yv, batch_size=256)["accuracy"]
     assert acc_dev == pytest.approx(acc_host, abs=1e-6)
     assert losses_dev[-1] < losses_dev[0]     # it is actually training
+
+
+def test_pair_structured_shuffle_preserves_pairs(zoo_ctx):
+    """rank_hinge-style losses shuffle PAIRS: every epoch each even row
+    must stay immediately before its odd partner (r5 fix — row-level
+    shuffling silently trained ranking models on random pairings)."""
+    from analytics_zoo_tpu import init_zoo_context
+    from analytics_zoo_tpu.nn import reset_name_scope
+    from analytics_zoo_tpu.nn.layers.core import Dense
+    from analytics_zoo_tpu.nn.topology import Sequential
+
+    init_zoo_context(steps_per_execution=1)
+    reset_name_scope()
+    rs = np.random.RandomState(0)
+    n = 128
+    # feature encodes the pair id; a pos row is its pair id + 0.5
+    pair_id = np.repeat(np.arange(n // 2, dtype=np.float32), 2)
+    is_pos = np.tile([1.0, 0.0], n // 2)
+    x = np.stack([pair_id, is_pos], axis=1)
+    y = is_pos.astype(np.float32)
+
+    seen = []
+
+    m = Sequential()
+    m.add(Dense(1, input_shape=(2,)))
+    m.compile(optimizer="adam", loss="rank_hinge")
+    est = m.estimator
+
+    orig = est._shard_batch
+
+    def spy(arrs):
+        a = np.asarray(arrs[0])
+        if a.ndim == 2:                 # feature batches only (y is 1-D)
+            seen.append(a)
+        return orig(arrs)
+
+    est._shard_batch = spy
+    m.fit(x, y, batch_size=32, nb_epoch=2, shuffle=True, verbose=False)
+    assert seen, "no batches captured"
+    for batch in seen:
+        ids, pos = batch[:, 0], batch[:, 1]
+        # rows arrive as (pos, neg) couples of the SAME pair id
+        assert np.all(ids[0::2] == ids[1::2])
+        assert np.all(pos[0::2] == 1.0) and np.all(pos[1::2] == 0.0)
+    # shuffling actually happened: some batch is not in ascending order
+    assert any(not np.all(np.diff(b[0::2, 0]) > 0) for b in seen)
