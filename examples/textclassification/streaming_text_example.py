@@ -1,0 +1,146 @@
+"""Streaming text classification: a TextClassifier behind the Cluster
+Serving worker — raw strings flow through a queue, class predictions
+flow back (reference pyzoo/zoo/examples/streaming/textclassification/
+streaming_text_classification.py: a Spark structured-streaming query
+feeding the model; here the stream is the serving queue and the "query"
+is the worker loop on one chip).
+
+One process (memory queue):
+    python streaming_text_example.py
+
+Cross-process (file queue; start the worker first):
+    python streaming_text_example.py --queue-dir /tmp/textq --role worker
+    python streaming_text_example.py --queue-dir /tmp/textq --role client
+
+TPU-first notes: the worker tokenizes/indexes each micro-batch on the
+host (the vocabulary travels with the model) and runs one bucketed
+predict per poll — strings in, ``class:confidence`` out.
+"""
+
+import argparse
+import time
+
+import numpy as np
+
+from analytics_zoo_tpu import init_zoo_context
+from analytics_zoo_tpu.data.datasets import generate_text_classification
+from analytics_zoo_tpu.data.text import TextSet
+from analytics_zoo_tpu.deploy.inference import InferenceModel
+from analytics_zoo_tpu.deploy.serving import (ClusterServing, FileQueue,
+                                              InputQueue, MemoryQueue,
+                                              OutputQueue, ServingConfig)
+from analytics_zoo_tpu.models.text import TextClassifier
+
+SEQ_LEN = 32
+
+
+def trained_classifier(epochs=3):
+    """Train the classifier + build the vocabulary it serves with."""
+    texts, labels = generate_text_classification(n_classes=3, per_class=80)
+    ts = (TextSet.from_texts(texts, labels).tokenize().normalize()
+          .word2idx(max_words_num=4000).shape_sequence(SEQ_LEN))
+    x, y = ts.to_arrays()
+    clf = TextClassifier(class_num=3, token_length=16,
+                         sequence_length=SEQ_LEN, encoder="cnn",
+                         encoder_output_dim=32, max_words_num=4000)
+    clf.compile(optimizer="adam", loss="sparse_categorical_crossentropy",
+                metrics=["accuracy"])
+    clf.fit(x, y.astype(np.int32), batch_size=64, nb_epoch=epochs)
+    return clf, ts.word_index, texts
+
+
+def text_forward(clf, word_index):
+    """Serving forward: object array of raw strings → "class:conf"."""
+    import jax
+
+    params = jax.device_get(clf.estimator.params)
+    state = jax.device_get(clf.estimator.state)
+    model = InferenceModel.from_keras_net(clf.model, params, state,
+                                          batch_buckets=(1, 8, 32))
+
+    def forward(xs):
+        rows = np.asarray(xs[0], np.uint8)
+        raw = [bytes(r).rstrip(b"\x00").decode("utf-8", "replace")
+               for r in rows]
+        feats = (TextSet.from_texts(raw).tokenize().normalize()
+                 .word2idx(existing_map=word_index)
+                 .shape_sequence(SEQ_LEN))
+        ids, _ = feats.to_arrays()
+        probs = np.asarray(model.predict([ids]))
+        cls = probs.argmax(-1)
+        conf = probs.max(-1)
+        return np.asarray([f"{int(c)}:{p:.3f}"
+                           for c, p in zip(cls, conf)], dtype=object)
+
+    return forward
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--role", choices=["both", "worker", "client"],
+                    default="both")
+    ap.add_argument("--queue-dir", default=None,
+                    help="FileQueue dir for cross-process streaming")
+    ap.add_argument("--messages", type=int, default=12)
+    ap.add_argument("--epochs", type=int, default=3)
+    args = ap.parse_args()
+
+    init_zoo_context()
+    queue = (FileQueue(args.queue_dir) if args.queue_dir
+             else MemoryQueue())
+
+    worker = None
+    sample_texts = None
+    if args.role in ("both", "worker"):
+        clf, word_index, sample_texts = trained_classifier(args.epochs)
+        infer = InferenceModel(text_forward(clf, word_index),
+                               batch_buckets=(1, 8, 32))
+        worker = ClusterServing(infer, queue,
+                                ServingConfig(batch_size=8,
+                                              poll_timeout_s=0.05))
+        worker.start()
+        print("worker: text classifier online, polling the stream")
+        if args.role == "worker":
+            try:
+                while True:
+                    time.sleep(1)
+            except KeyboardInterrupt:
+                worker.stop()
+            return
+
+    inq = InputQueue(queue)
+    outq = OutputQueue(queue)
+    if sample_texts is None:
+        sample_texts, _ = generate_text_classification(n_classes=3,
+                                                       per_class=20)
+    rs = np.random.RandomState(1)
+    picks = rs.choice(len(sample_texts), args.messages, replace=False)
+
+    def to_wire(text: str) -> np.ndarray:
+        """Fixed-width uint8 wire row (the queue ships numeric arrays)."""
+        arr = np.zeros(256, np.uint8)
+        b = text.encode("utf-8")[:256]
+        arr[: len(b)] = np.frombuffer(b, np.uint8)
+        return arr
+
+    t0 = time.time()
+    uris = []
+    for i in picks:
+        uri = f"msg{i:04d}"
+        inq.enqueue(uri, text=to_wire(sample_texts[i]))
+        uris.append(uri)
+    print(f"client: streamed {len(uris)} messages")
+    got = 0
+    for uri in uris:
+        res = outq.query(uri, timeout=60.0)
+        print(f"  {uri} -> {res}")
+        got += 1
+    dt = time.time() - t0
+    print(f"classified {got}/{args.messages} streamed messages "
+          f"in {dt:.2f}s")
+    if worker is not None:
+        worker.stop()
+
+
+if __name__ == "__main__":
+    main()

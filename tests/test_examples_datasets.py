@@ -273,3 +273,8 @@ class TestRound5Examples:
                           "--envs", "128", "--updates", "50",
                           timeout=600)
         assert "steps/s" in out and "final mean return" in out
+
+    def test_streaming_text_example(self):
+        out = _run_example("textclassification/streaming_text_example.py",
+                          "--epochs", "1", "--messages", "6", timeout=600)
+        assert "classified 6/6 streamed messages" in out
