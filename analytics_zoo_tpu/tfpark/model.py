@@ -287,7 +287,11 @@ class TFGraphOptimizer:
 
         from analytics_zoo_tpu.train.optimizers import Adam
 
-        self.tx = optim_method if optim_method is not None else Adam(1e-3)
+        # strings lower through the same registry compile() uses
+        from analytics_zoo_tpu.train import optimizers as _opts
+
+        self.tx = (_opts.get(optim_method) if optim_method is not None
+                   else Adam(1e-3))
         self._params = [jnp.asarray(v.numpy()) for v in self.variables]
         self._opt_state = self.tx.init(self._params)
         self._clip_norm, self._clip_value = clip_norm, clip_value

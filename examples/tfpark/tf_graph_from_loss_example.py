@@ -1,0 +1,62 @@
+"""Arbitrary-TF-graph training — the tensorflow example
+(reference pyzoo/zoo/examples/tensorflow/tfpark + tf_optimizer
+`TFOptimizer.from_loss`: hand-built TF tensors trained by the zoo
+optimizer, no Keras layers involved).
+
+The user's graph stays TensorFlow (GradientTape over their own
+variables); the update rule is the zoo/optax optimizer — the same
+split the reference used (gradients in the TF session, updates in the
+JVM optimizer).  Anything expressible as ``loss_fn(*batch) -> scalar``
+trains, including this example's hand-rolled logistic regression with
+an L2 penalty written in raw tf ops.
+"""
+
+import argparse
+
+import numpy as np
+
+from analytics_zoo_tpu import init_zoo_context
+from analytics_zoo_tpu.tfpark.model import TFOptimizer
+from analytics_zoo_tpu.train.optimizers import Adam
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=4000)
+    ap.add_argument("--epochs", type=int, default=8)
+    args = ap.parse_args()
+
+    import tensorflow as tf
+
+    init_zoo_context()
+    rs = np.random.RandomState(0)
+    true_w = rs.randn(6).astype(np.float32)
+    x = rs.randn(args.n, 6).astype(np.float32)
+    y = (x @ true_w + 0.1 * rs.randn(args.n) > 0).astype(np.float32)
+
+    w = tf.Variable(tf.zeros([6, 1]), name="w")
+    b = tf.Variable(tf.zeros([1]), name="b")
+
+    def loss_fn(xb, yb):
+        logits = tf.squeeze(tf.matmul(xb, w) + b, axis=1)
+        ce = tf.nn.sigmoid_cross_entropy_with_logits(labels=yb,
+                                                     logits=logits)
+        return tf.reduce_mean(ce) + 1e-3 * tf.nn.l2_loss(w)
+
+    opt = TFOptimizer.from_loss(loss_fn, [w, b],
+                                optim_method=Adam(lr=1e-2),
+                                dataset=([x], [y]))
+    history = opt.optimize(epochs=args.epochs, batch_size=256)
+    print("final loss:", round(history[-1]["loss"], 4))
+
+    learned = w.numpy().ravel()
+    cos = float(learned @ true_w
+                / (np.linalg.norm(learned) * np.linalg.norm(true_w)))
+    print("cosine(learned, true):", round(cos, 4))
+    acc = float((((x @ learned + b.numpy()[0]) > 0) == y).mean())
+    print("train accuracy:", round(acc, 4))
+    assert cos > 0.95 and acc > 0.88
+
+
+if __name__ == "__main__":
+    main()
