@@ -314,3 +314,8 @@ class TestRound5Examples:
             "--sessions", "3000", "--epochs", "5", timeout=600,
             single_device=True)
         assert "next-item validation" in out
+
+    def test_tensorboard_example(self):
+        out = _run_example("observability/tensorboard_example.py",
+                          "--epochs", "4", timeout=420)
+        assert "event files written" in out and "loss: 4 points" in out
