@@ -34,11 +34,14 @@ live BigDL process.
 
 from __future__ import annotations
 
+import logging
 import struct
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
+
+logger = logging.getLogger("analytics_zoo_tpu.bigdl")
 
 # -- wire primitives (protobuf TLV) -----------------------------------------
 
@@ -124,17 +127,27 @@ def _decode_tensor(buf: bytes) -> _Tensor:
         elif fnum == 6:
             t.n_elements = val
         elif fnum == 8:                      # TensorStorage
+            # accumulate-and-concatenate (like onnx/proto.py's float
+            # handling): a proto2-style unpacked writer emits one field
+            # entry PER element, so overwriting t.data would keep only
+            # the last one
+            chunks: List[np.ndarray] = []
             for f2, w2, v2 in _fields(val):
-                if f2 == 2:                  # packed float_data
-                    t.data = np.frombuffer(v2, np.float32) \
-                        if w2 == 2 else np.asarray(
-                            [struct.unpack("<f", v2)[0]], np.float32)
-                elif f2 == 3:                # packed double_data
-                    t.data = np.frombuffer(v2, np.float64).astype(
-                        np.float32) if w2 == 2 else np.asarray(
-                            [struct.unpack("<d", v2)[0]], np.float32)
+                if f2 == 2:                  # float_data (packed or not)
+                    chunks.append(
+                        np.frombuffer(v2, np.float32) if w2 == 2
+                        else np.asarray([struct.unpack("<f", v2)[0]],
+                                        np.float32))
+                elif f2 == 3:                # double_data (packed or not)
+                    chunks.append(
+                        (np.frombuffer(v2, np.float64) if w2 == 2
+                         else np.asarray([struct.unpack("<d", v2)[0]],
+                                         np.float64)).astype(np.float32))
                 elif f2 == 9:
                     t.storage_id = v2
+            if chunks:
+                t.data = (chunks[0] if len(chunks) == 1
+                          else np.concatenate(chunks))
         elif fnum == 9:
             t.tensor_id = val
     return t
@@ -210,7 +223,19 @@ def _resolve(m: BigDLModule, storages: Dict[int, _Tensor],
             data = by_storage[t.storage_id]
         if data is None:
             return None
-        n = t.n_elements or int(np.prod(t.size)) if t.size else data.size
+        if t.n_elements:
+            n = t.n_elements
+        elif t.size:
+            n = int(np.prod(t.size))
+        else:
+            # a size-less view into (possibly shared) storage has no
+            # defensible extent — taking the rest of the buffer is a
+            # guess, so say so instead of silently decoding garbage
+            logger.warning(
+                "tensor without size or nElements (storage_id=%s, "
+                "tensor_id=%s): taking the remaining %d storage elements",
+                t.storage_id, t.tensor_id, data.size - (t.offset - 1))
+            n = data.size
         arr = data[t.offset - 1:t.offset - 1 + n]
         return arr.reshape(t.size) if t.size else arr
 

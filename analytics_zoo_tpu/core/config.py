@@ -55,6 +55,18 @@ class ZooConfig:
     default_memory_type: str = "DRAM"
     data_prefetch: int = 2                  # batches prefetched to device
     shuffle_buffer: int = 10000
+    # Cache level for FeatureSets that don't pin one themselves: HOST
+    # keeps the reference behaviour (host batches + prefetch/device_put);
+    # DEVICE materializes the dataset into HBM once and runs the
+    # Estimator's device-resident epoch body (on-device shuffle +
+    # in-step minibatch gather, zero host→device bytes per epoch) — the
+    # TPU analog of the reference's PMEM/DRAM cached partitions
+    # (feature/FeatureSet.scala:690-722).
+    data_cache_level: str = "HOST"
+    # HBM budget for DEVICE caching; datasets above it fall back to the
+    # HOST prefetch path automatically (4 GiB default leaves room for
+    # params/activations on every shipping TPU generation).
+    data_device_budget_bytes: int = 4 << 30
 
     # --- logging / summaries --------------------------------------------
     log_level: str = "INFO"

@@ -37,11 +37,18 @@ class _Stat:
 
 
 class Timers:
-    """Process-wide named wall-clock scopes (thread-safe)."""
+    """Process-wide named wall-clock scopes + event counters (thread-safe).
+
+    Counters record *how often* something happened (per-batch
+    ``device_put`` dispatches, which data path an Estimator.fit took)
+    where a duration would be meaningless; tests assert on them to prove
+    hot-path properties ("zero host→device transfers per epoch") instead
+    of eyeballing traces."""
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._stats: Dict[str, _Stat] = {}
+        self._counts: Dict[str, int] = {}
 
     @contextlib.contextmanager
     def scope(self, name: str, log: bool = False) -> Iterator[None]:
@@ -58,6 +65,20 @@ class Timers:
             if log:
                 logger.info("[timeit] %s: %.3fms", name, dt * 1e3)
 
+    def incr(self, name: str, n: int = 1) -> None:
+        """Bump the named event counter by ``n``."""
+        with self._lock:
+            self._counts[name] = self._counts.get(name, 0) + n
+
+    def count(self, name: str) -> int:
+        """Current value of the named counter (0 if never bumped)."""
+        with self._lock:
+            return self._counts.get(name, 0)
+
+    def counts(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._counts)
+
     def stats(self) -> Dict[str, Dict[str, float]]:
         with self._lock:
             return {k: {"count": v.count, "total_s": v.total_s,
@@ -67,6 +88,7 @@ class Timers:
     def reset(self) -> None:
         with self._lock:
             self._stats.clear()
+            self._counts.clear()
 
     def report(self) -> str:
         lines = ["name count total_s mean_ms max_ms"]
@@ -74,6 +96,11 @@ class Timers:
                            key=lambda kv: -kv[1]["total_s"]):
             lines.append(f"{k} {v['count']} {v['total_s']:.3f} "
                          f"{v['mean_s'] * 1e3:.2f} {v['max_s'] * 1e3:.2f}")
+        counts = self.counts()
+        if counts:
+            lines.append("-- counters --")
+            for k, n in sorted(counts.items()):
+                lines.append(f"{k} {n}")
         return "\n".join(lines)
 
 
@@ -83,6 +110,11 @@ TIMERS = Timers()
 def timeit(name: str, log: bool = False):
     """``with timeit("shard_batch"): ...`` — scoped wall-clock timer."""
     return TIMERS.scope(name, log=log)
+
+
+def count_event(name: str, n: int = 1) -> None:
+    """Bump a process-wide event counter (``TIMERS.counts()`` reads it)."""
+    TIMERS.incr(name, n)
 
 
 @contextlib.contextmanager

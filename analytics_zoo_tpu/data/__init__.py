@@ -1,4 +1,5 @@
 from analytics_zoo_tpu.data.featureset import (  # noqa: F401
+    CacheLevel,
     FeatureSet,
     SlicedFeatureSet,
 )

@@ -17,6 +17,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 __all__ = ["read_movielens_1m", "generate_movielens_like",
+           "movielens_featureset",
            "read_pascal_voc", "read_coco", "read_text_folder",
            "generate_text_classification"]
 
@@ -61,6 +62,27 @@ def generate_movielens_like(n_users: int = 6040, n_items: int = 3706,
         ratings.extend(r.tolist())
     return (np.asarray(users, np.int64), np.asarray(items, np.int64),
             np.asarray(ratings, np.float32))
+
+
+def movielens_featureset(path: Optional[str] = None,
+                         cache_level: Optional[str] = None,
+                         memory_type: str = "DRAM", **generate_kw):
+    """Ratings as an Estimator-ready ``FeatureSet``:
+    arrays ``(user[:, None], item[:, None], rating)`` — the NeuralCF
+    explicit-feedback input layout.  Reads ml-1m from ``path`` when
+    given, else generates the synthetic stand-in
+    (``generate_movielens_like(**generate_kw)``).
+
+    ``cache_level="DEVICE"`` pins the HBM-resident tier: the Estimator
+    materializes the set into device memory once and shuffles/gathers
+    minibatches inside the compiled step (see data/README.md)."""
+    from analytics_zoo_tpu.data.featureset import FeatureSet
+
+    users, items, ratings = (read_movielens_1m(path) if path
+                             else generate_movielens_like(**generate_kw))
+    return FeatureSet.from_ndarrays(
+        [users[:, None].astype(np.int32), items[:, None].astype(np.int32)],
+        ratings, memory_type=memory_type, cache_level=cache_level)
 
 
 # ---------------------------------------------------------------------------

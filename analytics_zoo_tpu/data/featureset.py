@@ -26,6 +26,38 @@ import numpy as np
 MemoryType = str  # "DRAM" | "DISK_AND_DRAM" | "DIRECT"
 
 
+class CacheLevel:
+    """Where a FeatureSet's rows live while the Estimator trains from it.
+
+    Mirrors the reference's memory tiers (DRAM / PMEM,
+    feature/FeatureSet.scala:690-722) translated to TPU hosts: the
+    capacity tier there (PMEM) becomes HBM residency here — the fast
+    tier is *on the accelerator*, not a slower-but-bigger host medium.
+
+    - ``HOST``: rows stay on the host (numpy / mmap per ``memory_type``);
+      batches are assembled per step and ``device_put`` onto the mesh
+      (overlapped via train/prefetch.py).
+    - ``DEVICE``: the whole dataset is materialized into HBM once and the
+      Estimator's device-resident epoch body shuffles and gathers
+      minibatches *inside* the compiled step — zero host→device bytes
+      per epoch.  Falls back to HOST automatically when the dataset
+      exceeds ``ZooConfig.data_device_budget_bytes``.
+    """
+
+    HOST = "HOST"
+    DEVICE = "DEVICE"
+
+    _LEVELS = (HOST, DEVICE)
+
+    @staticmethod
+    def normalize(level: str) -> str:
+        lv = str(level).upper()
+        if lv not in CacheLevel._LEVELS:
+            raise ValueError(f"unknown cache level {level!r}; "
+                             f"known: {CacheLevel._LEVELS}")
+        return lv
+
+
 class FeatureSet:
     """A set of aligned arrays (inputs..., label) with lazy transforms.
 
@@ -36,7 +68,7 @@ class FeatureSet:
     def __init__(self, arrays: Sequence[np.ndarray],
                  memory_type: MemoryType = "DRAM",
                  transforms: Optional[List[Callable]] = None,
-                 seed: int = 0):
+                 seed: int = 0, cache_level: Optional[str] = None):
         if not arrays:
             raise ValueError("FeatureSet needs at least one array")
         n = len(arrays[0])
@@ -47,6 +79,9 @@ class FeatureSet:
         self.transforms = list(transforms or [])
         self.seed = seed
         self._rng = np.random.RandomState(seed)
+        # None = inherit ZooConfig.data_cache_level at fit time
+        self.cache_level = (CacheLevel.normalize(cache_level)
+                            if cache_level is not None else None)
         if self.memory_type in ("DISK_AND_DRAM", "DIRECT"):
             self.arrays = [self._to_mmap(np.asarray(a)) for a in arrays]
         else:
@@ -55,11 +90,13 @@ class FeatureSet:
     # -- constructors (parity with FeatureSet.rdd / ImageSet / TextSet) ---
     @staticmethod
     def from_ndarrays(x, y=None, memory_type: MemoryType = "DRAM",
-                      seed: int = 0) -> "FeatureSet":
+                      seed: int = 0,
+                      cache_level: Optional[str] = None) -> "FeatureSet":
         xs = list(x) if isinstance(x, (list, tuple)) else [x]
         if y is not None:
             xs = xs + [y]
-        return FeatureSet(xs, memory_type=memory_type, seed=seed)
+        return FeatureSet(xs, memory_type=memory_type, seed=seed,
+                          cache_level=cache_level)
 
     @staticmethod
     def from_npy_files(paths: Sequence[str],
@@ -72,6 +109,7 @@ class FeatureSet:
         fs.transforms = []
         fs.seed = 0
         fs._rng = np.random.RandomState(0)
+        fs.cache_level = None
         fs.arrays = list(arrays)
         return fs
 
@@ -97,7 +135,62 @@ class FeatureSet:
         fs.transforms = self.transforms + [fn]
         fs.seed = self.seed
         fs._rng = self._rng
+        fs.cache_level = self.cache_level
         return fs
+
+    # -- cache levels (HBM residency) -------------------------------------
+    def cache(self, level: str = CacheLevel.DEVICE) -> "FeatureSet":
+        """Pin this FeatureSet's cache level (``CacheLevel.HOST`` /
+        ``DEVICE``), the analog of the reference's
+        ``FeatureSet.rdd(memoryType=...)`` tier selection.  Returns a
+        shallow copy sharing the backing arrays."""
+        fs = FeatureSet.__new__(FeatureSet)
+        fs.__dict__.update(self.__dict__)
+        fs.cache_level = CacheLevel.normalize(level)
+        return fs
+
+    @property
+    def nbytes(self) -> int:
+        """Total bytes of the backing arrays (the HBM bill of a DEVICE
+        cache, pre-transform)."""
+        return int(sum(a.dtype.itemsize * a.size for a in self.arrays))
+
+    def device_arrays(self, ctx=None) -> List["Any"]:
+        """Materialize the dataset into HBM: one ``device_put`` per array,
+        rows sharded over the mesh's data axis when they divide it
+        (parallel/sharding.dataset_sharding), replicated otherwise.
+
+        Transforms are applied ONCE here, over the full arrays — valid
+        for row-independent (per-sample) transforms, which is what the
+        lazy per-batch protocol already implies; transforms that couple
+        rows across a batch would change meaning under a different batch
+        size too.  The upload is timed under
+        ``featureset/device_cache_put`` so the one-off transfer cost is
+        visible next to the per-step timings it eliminates.
+        """
+        import jax
+
+        from analytics_zoo_tpu.core.context import get_zoo_context
+        from analytics_zoo_tpu.core.profiling import timeit
+        from analytics_zoo_tpu.parallel.sharding import dataset_sharding
+
+        ctx = ctx or get_zoo_context()
+        arrays = self.arrays
+        if self.transforms:
+            batch = tuple(np.asarray(a) for a in arrays)
+            for fn in self.transforms:
+                batch = fn(*batch)
+                if not isinstance(batch, tuple):
+                    batch = (batch,)
+            arrays = list(batch)
+        n = len(arrays[0])
+        with timeit("featureset/device_cache_put"):
+            out = [jax.device_put(
+                a, dataset_sharding(ctx.mesh, n, np.ndim(a),
+                                    axis=ctx.data_axis))
+                for a in arrays]
+            jax.block_until_ready(out)
+        return out
 
     # -- iteration --------------------------------------------------------
     def __len__(self) -> int:
@@ -198,6 +291,9 @@ class SlicedFeatureSet(FeatureSet):
         self.transforms = []
         self.seed = seed
         self._rng = np.random.RandomState(seed)
+        # slice-wise sets exist BECAUSE the data outgrows resident memory;
+        # HBM caching is never applicable
+        self.cache_level = CacheLevel.HOST
         # row counts from headers only (no data load)
         self._slice_rows = []
         for s in self.slice_paths:
@@ -211,6 +307,25 @@ class SlicedFeatureSet(FeatureSet):
         fs.__dict__.update(self.__dict__)
         fs.transforms = self.transforms + [fn]
         return fs
+
+    @property
+    def nbytes(self) -> int:
+        """Summed on-disk bytes across slices (headers only, no load)."""
+        total = 0
+        for s in self.slice_paths:
+            for p in s:
+                a = np.load(p, mmap_mode="r")
+                total += a.dtype.itemsize * a.size
+        return int(total)
+
+    def cache(self, level: str = CacheLevel.DEVICE) -> "SlicedFeatureSet":
+        if CacheLevel.normalize(level) == CacheLevel.DEVICE:
+            raise ValueError(
+                "SlicedFeatureSet streams slices because the dataset "
+                "outgrows resident memory; a DEVICE (HBM) cache cannot "
+                "hold it — use FeatureSet.from_ndarrays for data that "
+                "fits the device budget")
+        return self
 
     def __len__(self) -> int:
         return int(sum(self._slice_rows))

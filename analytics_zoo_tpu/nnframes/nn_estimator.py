@@ -260,6 +260,7 @@ class NNModel(_Params):
                                                       pandas_to_spark_df)
 
         spark_session = df.sparkSession if is_spark_df(df) else None
+        template = df if spark_session is not None else None
         df, xs = self._extract_features(df)
         scores = np.asarray(self.estimator.predict(
             xs, batch_size=self.batch_size))
@@ -268,7 +269,8 @@ class NNModel(_Params):
         for col, vals in self._extra_columns(scores).items():
             out[col] = vals
         if spark_session is not None:   # a Spark stage must return Spark
-            return pandas_to_spark_df(out, spark_session)
+            return pandas_to_spark_df(out, spark_session,
+                                      template_df=template)
         return out
 
     def _extra_columns(self, scores: np.ndarray) -> dict:

@@ -51,13 +51,38 @@ def spark_df_to_pandas(df):
 def pandas_to_spark_df(pdf, session, template_df=None):
     """Ship a pandas result back into the caller's SparkSession.
     ndarray cells become plain python lists (Spark has no ndarray
-    encoder); scalars pass through."""
+    encoder); scalars pass through.  When ``template_df`` carries the
+    columns being returned, its schema is reused so Spark keeps the
+    caller's column types instead of re-inferring them."""
     out = pdf.copy()
     for c in out.columns:
         if len(out) and isinstance(out[c].iloc[0], np.ndarray):
             out[c] = [v.tolist() for v in out[c]]
         elif out[c].dtype == np.float32:
             out[c] = out[c].astype(np.float64)
+    if template_df is not None:
+        try:
+            from pyspark.sql.types import (ArrayType, DoubleType, LongType,
+                                           StructField, StructType)
+
+            fields = {f.name: f for f in template_df.schema.fields}
+
+            def infer(c):
+                if c in fields:
+                    return fields[c]
+                kind = out[c].dtype.kind        # new (e.g. prediction) col
+                if kind == "f":
+                    return StructField(c, DoubleType())
+                if kind in ("i", "u"):
+                    return StructField(c, LongType())
+                if len(out) and isinstance(out[c].iloc[0], list):
+                    return StructField(c, ArrayType(DoubleType()))
+                raise TypeError(f"cannot infer spark type for {c!r}")
+
+            schema = StructType([infer(c) for c in out.columns])
+            return session.createDataFrame(out, schema=schema)
+        except Exception:
+            pass        # unmappable column: plain re-inference below
     return session.createDataFrame(out)
 
 

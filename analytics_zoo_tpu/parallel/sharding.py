@@ -315,6 +315,24 @@ class AutoSharding(TensorParallel):
         return super().param_shardings(mesh, params)
 
 
+def dataset_sharding(mesh, n_rows: int, ndim: int,
+                     axis: str = "data") -> NamedSharding:
+    """Placement for a DEVICE-cached (HBM-resident) dataset array.
+
+    Rows split over the mesh's data axis so an N-device mesh holds 1/N of
+    the dataset per chip (the capacity analog of the reference's
+    partition-per-executor caching); every other dim is replicated.  When
+    the row count doesn't divide the axis — or the axis is missing, e.g.
+    a pure model-parallel mesh — the array is replicated instead: the
+    resident epoch body gathers by *global* permutation indices, so a
+    replicated copy is always correct, just not capacity-optimal.
+    """
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    if axis in sizes and sizes[axis] > 1 and n_rows % sizes[axis] == 0:
+        return NamedSharding(mesh, P(axis, *([None] * (ndim - 1))))
+    return NamedSharding(mesh, P())
+
+
 def make_strategy(name: str, mesh, **kw) -> ShardingStrategy:
     """String lowering (config-system entry point)."""
     name = name.lower()

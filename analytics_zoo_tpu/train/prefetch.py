@@ -9,6 +9,12 @@ indexing, per-batch transforms, ``device_put`` onto the mesh) on a
 background thread while the device executes the current step.  JAX
 dispatch is asynchronous, so one batch of lookahead is enough to hide
 host work; the queue depth is the ``data_prefetch`` config knob.
+
+The consumer's blocked-on-queue time aggregates under the
+``prefetch/consumer_wait`` timer (core/profiling.TIMERS): a large total
+relative to step time means the input pipeline — not the device — is the
+bottleneck, which is exactly when the DEVICE cache level
+(data/featureset.CacheLevel) pays off.
 """
 
 from __future__ import annotations
@@ -16,6 +22,8 @@ from __future__ import annotations
 import queue
 import threading
 from typing import Any, Callable, Iterable, Iterator, Optional
+
+from analytics_zoo_tpu.core.profiling import timeit
 
 _SENTINEL = object()
 
@@ -74,25 +82,27 @@ class PrefetchIterator:
         # gone without its sentinel having been consumed (belt to the
         # suspenders above), surface its error / end-of-iteration instead
         # of hanging the training loop
-        while True:
-            try:
-                item = self._q.get(timeout=1.0)
-                break
-            except queue.Empty:
-                if not self._thread.is_alive():
-                    try:
-                        item = self._q.get_nowait()
-                        break
-                    except queue.Empty:
-                        if self._err is not None:
-                            raise self._err
-                        raise StopIteration from None
+        with timeit("prefetch/consumer_wait"):
+            item = self._get()
         if item is _SENTINEL:
             self._thread.join()
             if self._err is not None:
                 raise self._err
             raise StopIteration
         return item
+
+    def _get(self) -> Any:
+        while True:
+            try:
+                return self._q.get(timeout=1.0)
+            except queue.Empty:
+                if not self._thread.is_alive():
+                    try:
+                        return self._q.get_nowait()
+                    except queue.Empty:
+                        if self._err is not None:
+                            raise self._err
+                        raise StopIteration from None
 
     def close(self) -> None:
         """Stop the producer (used on early exit / exception paths)."""

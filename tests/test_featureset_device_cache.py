@@ -1,0 +1,248 @@
+"""HBM-resident FeatureSet (CacheLevel.DEVICE): the TPU analog of the
+reference's PMEM/DRAM cached-partition tiers (feature/FeatureSet.scala:
+690-722).  DEVICE materializes the dataset into device memory once; the
+Estimator then runs each epoch as ONE jitted dispatch — on-device
+``jax.random.permutation`` shuffle, in-step gather minibatching, zero
+host→device bytes per epoch.  Over-budget sets fall back to the host
+prefetch path automatically (data_device_budget_bytes knob)."""
+
+import jax
+import numpy as np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def fresh_names():
+    from analytics_zoo_tpu.nn import reset_name_scope
+
+    reset_name_scope()
+
+
+# ---------------------------------------------------------------------------
+# CacheLevel plumbing on the FeatureSet itself
+# ---------------------------------------------------------------------------
+
+
+def test_cache_level_plumbing(zoo_ctx):
+    from analytics_zoo_tpu.data.featureset import CacheLevel, FeatureSet
+
+    x = np.arange(64, dtype=np.float32).reshape(16, 4)
+    y = np.zeros(16, np.float32)
+    fs = FeatureSet.from_ndarrays([x], y)
+    assert fs.cache_level is None           # inherit the config default
+    assert fs.nbytes == x.nbytes + y.nbytes
+
+    cached = fs.cache("DEVICE")
+    assert cached.cache_level == CacheLevel.DEVICE
+    assert fs.cache_level is None           # cache() is non-mutating
+    # transforms carry the level along
+    assert cached.transform(lambda *a: a).cache_level == CacheLevel.DEVICE
+
+    with pytest.raises(ValueError):
+        CacheLevel.normalize("PMEM")        # unknown tier is an error
+    with pytest.raises(ValueError):
+        fs.cache("DISK")
+
+
+def test_sliced_featureset_rejects_device_cache(zoo_ctx, tmp_path):
+    from analytics_zoo_tpu.data.featureset import (CacheLevel, FeatureSet,
+                                                   SlicedFeatureSet)
+
+    paths = []
+    for k in range(2):
+        x = np.arange(80, dtype=np.float32).reshape(20, 4) + k
+        y = np.zeros(20, np.float32)
+        xp, yp = tmp_path / f"x{k}.npy", tmp_path / f"y{k}.npy"
+        np.save(xp, x)
+        np.save(yp, y)
+        paths.append((str(xp), str(yp)))
+    fs = FeatureSet.from_npy_slices(paths)
+    assert isinstance(fs, SlicedFeatureSet)
+    assert fs.cache_level == CacheLevel.HOST    # pinned, not inherited
+    with pytest.raises(ValueError):
+        fs.cache("DEVICE")                  # beyond-memory tier by design
+    # nbytes from headers: full on-disk extent across slices
+    assert fs.nbytes == 2 * (80 * 4 + 20 * 4)
+
+
+# ---------------------------------------------------------------------------
+# on-device epoch permutation: exactly-once coverage
+# ---------------------------------------------------------------------------
+
+
+def test_resident_epoch_indices_cover_every_row(zoo_ctx):
+    from analytics_zoo_tpu.train.estimator import resident_epoch_indices
+
+    rng = jax.random.PRNGKey(3)
+    for n in (64, 257):                     # even and odd
+        idx = np.asarray(resident_epoch_indices(rng, n))
+        assert sorted(idx.tolist()) == list(range(n))
+    # two epochs draw different orders from split keys
+    a = np.asarray(resident_epoch_indices(jax.random.PRNGKey(1), 128))
+    b = np.asarray(resident_epoch_indices(jax.random.PRNGKey(2), 128))
+    assert not np.array_equal(a, b)
+    # shuffle off → contiguous order (the parity-with-host mode)
+    assert np.array_equal(
+        np.asarray(resident_epoch_indices(rng, 32, shuffle=False)),
+        np.arange(32))
+
+
+def test_resident_epoch_indices_pair_structured(zoo_ctx):
+    from analytics_zoo_tpu.train.estimator import resident_epoch_indices
+
+    idx = np.asarray(resident_epoch_indices(
+        jax.random.PRNGKey(0), 128, pair_structured=True))
+    assert sorted(idx.tolist()) == list(range(128))     # exactly once
+    pairs = idx.reshape(-1, 2)
+    # every (pos, neg) couple stays adjacent: even row then its partner
+    assert np.array_equal(pairs[:, 0] % 2, np.zeros(64))
+    assert np.array_equal(pairs[:, 1], pairs[:, 0] + 1)
+
+
+# ---------------------------------------------------------------------------
+# Estimator routing + training through the resident path
+# ---------------------------------------------------------------------------
+
+
+def _ncf_data(n=256, seed=1):
+    rs = np.random.RandomState(seed)
+    u = rs.randint(1, 51, (n, 1)).astype(np.int32)
+    i = rs.randint(1, 41, (n, 1)).astype(np.int32)
+    y = rs.randint(0, 2, n).astype(np.int32)
+    return u, i, y
+
+
+def _small_ncf():
+    from analytics_zoo_tpu.models import NeuralCF
+    from analytics_zoo_tpu.nn import reset_name_scope
+    from analytics_zoo_tpu.train.optimizers import Adam
+
+    reset_name_scope()
+    ncf = NeuralCF(user_count=50, item_count=40, class_num=2,
+                   user_embed=8, item_embed=8, mf_embed=8,
+                   hidden_layers=(16, 8))
+    ncf.compile(optimizer=Adam(lr=1e-2),
+                loss="sparse_categorical_crossentropy")
+    return ncf
+
+
+def test_device_path_parity_with_host(zoo_ctx):
+    """shuffle=False makes both paths consume the same contiguous order,
+    so the resident fori_loop epoch and the host K-step scan must train
+    to the same weights (rtol 1e-6, the repo's cross-program-fusion
+    parity bar; measured bit-exact on the CPU mesh)."""
+    from analytics_zoo_tpu import init_zoo_context
+    from analytics_zoo_tpu.data import FeatureSet
+
+    def train(level):
+        init_zoo_context(steps_per_execution=2, seed=7)
+        u, i, y = _ncf_data()
+        ncf = _small_ncf()
+        fs = FeatureSet.from_ndarrays([u, i], y, cache_level=level)
+        h = ncf.estimator.fit(fs, batch_size=32, epochs=2, verbose=False,
+                              shuffle=False)
+        return (ncf.estimator.last_data_path,
+                jax.device_get(ncf.estimator.params),
+                [r["loss"] for r in h])
+
+    path_h, params_h, losses_h = train(None)
+    path_d, params_d, losses_d = train("DEVICE")
+    assert path_h == "host_prefetch"
+    assert path_d == "device_resident"
+    np.testing.assert_allclose(losses_d, losses_h, rtol=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(params_h),
+                    jax.tree_util.tree_leaves(params_d)):
+        np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)
+
+
+def test_budget_fallback_engages_automatically(zoo_ctx):
+    from analytics_zoo_tpu import init_zoo_context
+    from analytics_zoo_tpu.data import FeatureSet
+
+    init_zoo_context(steps_per_execution=2, seed=0)
+    u, i, y = _ncf_data()
+    ncf = _small_ncf()
+    est = ncf.estimator
+    est.ctx.config.data_device_budget_bytes = 64     # nothing fits
+    fs = FeatureSet.from_ndarrays([u, i], y, cache_level="DEVICE")
+    h = est.fit(fs, batch_size=32, epochs=1, verbose=False)
+    assert est.last_data_path == "host_prefetch"
+    assert "over device budget" in est.last_data_path_reason
+    assert len(h) == 1 and h[-1]["loss"] > 0         # it still trained
+
+
+def test_config_default_cache_level(zoo_ctx):
+    """data_cache_level="DEVICE" in the config routes a plain FeatureSet
+    (no per-set pin) through the resident path."""
+    from analytics_zoo_tpu import init_zoo_context
+    from analytics_zoo_tpu.data import FeatureSet
+
+    init_zoo_context(steps_per_execution=2, seed=0)
+    u, i, y = _ncf_data()
+    ncf = _small_ncf()
+    ncf.estimator.ctx.config.data_cache_level = "DEVICE"
+    fs = FeatureSet.from_ndarrays([u, i], y)
+    ncf.estimator.fit(fs, batch_size=32, epochs=1, verbose=False)
+    assert ncf.estimator.last_data_path == "device_resident"
+
+
+def test_resident_path_moves_no_per_batch_bytes(zoo_ctx):
+    """The hot path must not call the host→device upload helper at all:
+    the ONLY transfer is the one-time materialization
+    (featureset/device_cache_put).  Counter-based, so a regression that
+    quietly reintroduces per-batch device_put fails loudly."""
+    from analytics_zoo_tpu import init_zoo_context
+    from analytics_zoo_tpu.core.profiling import TIMERS
+    from analytics_zoo_tpu.data import FeatureSet
+
+    init_zoo_context(steps_per_execution=2, seed=0)
+    u, i, y = _ncf_data()
+    ncf = _small_ncf()
+    fs = FeatureSet.from_ndarrays([u, i], y, cache_level="DEVICE")
+    TIMERS.reset()
+    ncf.estimator.fit(fs, batch_size=32, epochs=3, verbose=False)
+    assert ncf.estimator.last_data_path == "device_resident"
+    assert TIMERS.count("estimator/host_device_put") == 0
+    assert TIMERS.count("estimator/data_path_device_resident") == 1
+    # the one-time HBM materialization was timed (one put per array)
+    assert "featureset/device_cache_put" in TIMERS.report()
+    # ...and the host path DOES bump the counter (the probe works)
+    init_zoo_context(steps_per_execution=2, seed=0)
+    ncf2 = _small_ncf()
+    TIMERS.reset()
+    ncf2.estimator.fit(FeatureSet.from_ndarrays([u, i], y), batch_size=32,
+                       epochs=1, verbose=False)
+    assert ncf2.estimator.last_data_path == "host_prefetch"
+    assert TIMERS.count("estimator/host_device_put") > 0
+
+
+def test_resident_shuffle_trains_and_reshuffles(zoo_ctx):
+    """With shuffle on, the resident path still converges on a learnable
+    separable problem and epoch losses keep improving (a broken gather /
+    stale permutation would flatline)."""
+    from analytics_zoo_tpu import init_zoo_context
+    from analytics_zoo_tpu.data import FeatureSet
+    from analytics_zoo_tpu.nn import reset_name_scope
+    from analytics_zoo_tpu.nn.layers.core import Dense
+    from analytics_zoo_tpu.nn.topology import Sequential
+    from analytics_zoo_tpu.train.optimizers import Adam
+
+    init_zoo_context(steps_per_execution=2, seed=3)
+    reset_name_scope()
+    rs = np.random.RandomState(0)
+    x = rs.randn(512, 12).astype(np.float32)
+    w = rs.randn(12).astype(np.float32)
+    yv = (x @ w > 0).astype(np.int32)
+    m = Sequential()
+    m.add(Dense(16, activation="relu", input_shape=(12,)))
+    m.add(Dense(2, activation="softmax"))
+    m.compile(optimizer=Adam(lr=1e-2),
+              loss="sparse_categorical_crossentropy",
+              metrics=["accuracy"])
+    fs = FeatureSet.from_ndarrays([x], yv, cache_level="DEVICE")
+    h = m.fit(fs, batch_size=64, nb_epoch=10, verbose=False)
+    assert m.estimator.last_data_path == "device_resident"
+    losses = [r["loss"] for r in h]
+    assert losses[-1] < 0.5 * losses[0]
+    acc = m.evaluate(x, yv, batch_size=256)["accuracy"]
+    assert acc > 0.9
