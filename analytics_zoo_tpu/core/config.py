@@ -68,6 +68,34 @@ class ZooConfig:
     # params/activations on every shipping TPU generation).
     data_device_budget_bytes: int = 4 << 30
 
+    # --- robustness ------------------------------------------------------
+    # What a non-finite training loss does (docs/ROBUSTNESS.md):
+    #   "skip"     — the jitted step discards the bad update on device
+    #                (params/opt-state keep their pre-step values) and the
+    #                epoch-boundary check counts it; training continues.
+    #   "rollback" — like skip, plus: >= max_bad_steps CONSECUTIVE bad
+    #                steps restores the last checkpoint and scales the
+    #                learning rate by nan_backoff_factor.
+    #   "raise"    — any bad step raises FloatingPointError at the next
+    #                epoch-boundary check (the update was still skipped,
+    #                so the surviving params are finite for post-mortem).
+    # Checks are epoch-granular: the bad-step counters ride the device
+    # carry, so the happy path costs zero extra host syncs.
+    nan_policy: str = "skip"
+    max_bad_steps: int = 5
+    nan_backoff_factor: float = 0.5
+    # Verify per-leaf CRC32 manifests on checkpoint restore; torn/corrupt
+    # snapshots quarantine and restore falls back to the newest intact one.
+    ckpt_verify: bool = True
+    # RetryPolicy defaults (robust/retry.py) — exponential backoff with
+    # jitter, bounded by attempts and an optional wall-clock deadline.
+    retry_max_attempts: int = 5
+    retry_base_delay_s: float = 0.1
+    retry_max_delay_s: float = 30.0
+    retry_multiplier: float = 2.0
+    retry_jitter: float = 0.1
+    retry_deadline_s: Optional[float] = None
+
     # --- logging / summaries --------------------------------------------
     log_level: str = "INFO"
     tensorboard_dir: Optional[str] = None

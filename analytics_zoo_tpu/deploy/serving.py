@@ -19,6 +19,14 @@ broadcast).  The transport is pluggable:
 
 Client API parity: ``InputQueue.enqueue`` / ``enqueue_image`` (base64) and
 ``OutputQueue.dequeue`` / ``query`` keep the reference semantics.
+
+Robustness contract shared by all three backends (docs/ROBUSTNESS.md):
+``get_result`` raises :class:`TimeoutError` with a uniform message once
+the deadline passes, ``health()`` returns a ``{"ok": bool, ...}`` probe
+(writability for FileQueue, PING for RedisQueue), and persistent-backend
+I/O runs under a :class:`~analytics_zoo_tpu.robust.RetryPolicy`
+(transient filesystem/connection blips are retried with backoff; the
+``queue.io`` fault-injection site exercises exactly those paths).
 """
 
 from __future__ import annotations
@@ -33,6 +41,8 @@ import uuid
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
+
+from analytics_zoo_tpu.robust import RetryPolicy, faults
 
 __all__ = ["MemoryQueue", "FileQueue", "RedisQueue", "make_queue",
            "InputQueue", "OutputQueue", "ServingConfig", "ClusterServing",
@@ -80,6 +90,21 @@ def decode_image(payload: Dict[str, Any]) -> np.ndarray:
 # ---------------------------------------------------------------------------
 # queue backends
 # ---------------------------------------------------------------------------
+
+def _timeout_msg(q, rid: str, timeout: float) -> str:
+    """One TimeoutError message shape across every backend, so callers
+    (and tests) never have to care which transport is underneath."""
+    return (f"{type(q).__name__}[{q.name}]: no result for {rid!r} "
+            f"within {timeout:.1f}s")
+
+
+def _io_retry(name: str, retry_on) -> RetryPolicy:
+    """Default retry for persistent-backend I/O: 3 quick attempts —
+    enough to absorb a transient fs/connection blip without turning a
+    dead backend into a multi-second client hang."""
+    return RetryPolicy(max_attempts=3, base_delay_s=0.02, max_delay_s=0.5,
+                       retry_on=retry_on, name=name)
+
 
 class MemoryQueue:
     """In-process stream + result store (single-process serving/tests)."""
@@ -130,13 +155,19 @@ class MemoryQueue:
             while rid not in self._results:
                 left = deadline - time.monotonic()
                 if left <= 0:
-                    raise TimeoutError(f"no result for {rid}")
+                    raise TimeoutError(_timeout_msg(self, rid, timeout))
                 self._cv.wait(timeout=left)
             return self._results.pop(rid)
 
     def pending_results(self) -> List[str]:
         with self._cv:
             return list(self._results)
+
+    def health(self) -> Dict[str, Any]:
+        with self._cv:
+            return {"ok": True, "backend": "memory",
+                    "depth": len(self._items),
+                    "pending_results": len(self._results)}
 
 
 class FileQueue:
@@ -147,7 +178,8 @@ class FileQueue:
     Redis server plays for the reference when no Redis is available.
     """
 
-    def __init__(self, root: str, name: str = "serving_stream"):
+    def __init__(self, root: str, name: str = "serving_stream",
+                 retry: Optional[RetryPolicy] = None):
         self.name = name
         self.root = os.path.join(root, name)
         self.in_dir = os.path.join(self.root, "in")
@@ -155,15 +187,21 @@ class FileQueue:
         for d in (self.in_dir, self.out_dir):
             os.makedirs(d, exist_ok=True)
         self._seq = 0
+        self._retry = retry or _io_retry("filequeue_io", (OSError,))
 
     def push(self, record: Dict) -> str:
         rid = record.get("uri") or uuid.uuid4().hex
         self._seq += 1
         fn = f"{time.time_ns():020d}_{self._seq:06d}_{rid}.json"
-        fd, tmp = tempfile.mkstemp(dir=self.root, suffix=".tmp")
-        with os.fdopen(fd, "w") as f:
-            json.dump({"rid": rid, "record": record}, f)
-        os.replace(tmp, os.path.join(self.in_dir, fn))
+
+        def _write():
+            faults.inject("queue.io")
+            fd, tmp = tempfile.mkstemp(dir=self.root, suffix=".tmp")
+            with os.fdopen(fd, "w") as f:
+                json.dump({"rid": rid, "record": record}, f)
+            os.replace(tmp, os.path.join(self.in_dir, fn))
+
+        self._retry.call(_write)
         return rid
 
     # claims older than this are from a crashed worker and get requeued
@@ -218,27 +256,48 @@ class FileQueue:
         return drop
 
     def set_result(self, rid: str, value: Any) -> None:
-        fd, tmp = tempfile.mkstemp(dir=self.root, suffix=".tmp")
-        with os.fdopen(fd, "w") as f:
-            json.dump(value, f)
-        os.replace(tmp, os.path.join(self.out_dir, rid + ".json"))
+        def _write():
+            faults.inject("queue.io")
+            fd, tmp = tempfile.mkstemp(dir=self.root, suffix=".tmp")
+            with os.fdopen(fd, "w") as f:
+                json.dump(value, f)
+            os.replace(tmp, os.path.join(self.out_dir, rid + ".json"))
+
+        self._retry.call(_write)
 
     def get_result(self, rid: str, timeout: float = 10.0) -> Any:
         path = os.path.join(self.out_dir, rid + ".json")
         deadline = time.monotonic() + timeout
+
+        def _read():
+            faults.inject("queue.io")
+            with open(path) as f:
+                val = json.load(f)
+            os.unlink(path)
+            return val
+
         while True:
             if os.path.exists(path):
-                with open(path) as f:
-                    val = json.load(f)
-                os.unlink(path)
-                return val
+                return self._retry.call(_read)
             if time.monotonic() >= deadline:
-                raise TimeoutError(f"no result for {rid}")
+                raise TimeoutError(_timeout_msg(self, rid, timeout))
             time.sleep(0.005)
 
     def pending_results(self) -> List[str]:
         return [fn[:-5] for fn in os.listdir(self.out_dir)
                 if fn.endswith(".json")]
+
+    def health(self) -> Dict[str, Any]:
+        """Probe: the spool directories must exist and be writable."""
+        try:
+            fd, tmp = tempfile.mkstemp(dir=self.root, suffix=".probe")
+            os.close(fd)
+            os.unlink(tmp)
+            return {"ok": True, "backend": "file", "root": self.root,
+                    "depth": len(self)}
+        except OSError as e:
+            return {"ok": False, "backend": "file", "root": self.root,
+                    "error": str(e)}
 
 
 class RedisQueue:
@@ -254,12 +313,17 @@ class RedisQueue:
     GROUP = "serving_workers"
 
     def __init__(self, host: str = "localhost", port: int = 6379,
-                 name: str = "serving_stream"):
+                 name: str = "serving_stream",
+                 retry: Optional[RetryPolicy] = None):
         import redis  # gated import
 
         self.name = name
         self._r = redis.Redis(host=host, port=port, decode_responses=True)
         self._consumer = uuid.uuid4().hex
+        self._retry = retry or _io_retry(
+            "redisqueue_io",
+            (getattr(redis, "ConnectionError", OSError),
+             getattr(redis, "TimeoutError", OSError), OSError))
         try:
             self._r.xgroup_create(self.name, self.GROUP, id="0",
                                   mkstream=True)
@@ -269,8 +333,13 @@ class RedisQueue:
 
     def push(self, record: Dict) -> str:
         rid = record.get("uri") or uuid.uuid4().hex
-        self._r.xadd(self.name, {"blob": json.dumps({"rid": rid,
-                                                     "record": record})})
+
+        def _write():
+            faults.inject("queue.io")
+            self._r.xadd(self.name, {"blob": json.dumps(
+                {"rid": rid, "record": record})})
+
+        self._retry.call(_write)
         return rid
 
     def pop_batch(self, n: int, timeout: float = 0.1
@@ -309,21 +378,39 @@ class RedisQueue:
         return max(0, before - self._r.xlen(self.name))
 
     def set_result(self, rid: str, value: Any) -> None:
-        self._r.hset(f"result:{rid}", "value", json.dumps(value))
+        def _write():
+            faults.inject("queue.io")
+            self._r.hset(f"result:{rid}", "value", json.dumps(value))
+
+        self._retry.call(_write)
 
     def get_result(self, rid: str, timeout: float = 10.0) -> Any:
         deadline = time.monotonic() + timeout
+
+        def _read():
+            faults.inject("queue.io")
+            return self._r.hget(f"result:{rid}", "value")
+
         while True:
-            v = self._r.hget(f"result:{rid}", "value")
+            v = self._retry.call(_read)
             if v is not None:
                 self._r.delete(f"result:{rid}")
                 return json.loads(v)
             if time.monotonic() >= deadline:
-                raise TimeoutError(f"no result for {rid}")
+                raise TimeoutError(_timeout_msg(self, rid, timeout))
             time.sleep(0.01)
 
     def pending_results(self) -> List[str]:
         return [k.split(":", 1)[1] for k in self._r.keys("result:*")]
+
+    def health(self) -> Dict[str, Any]:
+        """Probe: PING the server (the reference serving stack's startup
+        does the same liveness check before starting the stream)."""
+        try:
+            self._r.ping()
+            return {"ok": True, "backend": "redis", "depth": len(self)}
+        except Exception as e:
+            return {"ok": False, "backend": "redis", "error": str(e)}
 
 
 def make_queue(backend: str = "memory", **kw):

@@ -1,0 +1,28 @@
+"""Resilience substrate shared by train/, deploy/ and data/.
+
+The reference inherits fault tolerance from Spark (task retry, driver
+recovery, lineage recompute under ``DistriOptimizer``).  The TPU-native
+single-controller stack has no scheduler underneath it, so the
+equivalent machinery lives here, as three reusable pieces:
+
+- :class:`RetryPolicy` — exponential backoff + jitter + deadline +
+  sliding failure window, used by the Estimator's failure-retry loop,
+  checkpoint writes and the serving queue I/O.
+- :class:`FaultInjector` — deterministic fault injection at named sites
+  (torn checkpoint files, prefetch producer crashes, NaN losses,
+  simulated preemptions), the substrate of the chaos test suite.
+- :class:`TrainingPreempted` — raised by ``Estimator.fit`` after the
+  preemption handler has flushed its final synchronous checkpoint.
+
+See docs/ROBUSTNESS.md for the end-to-end guarantees.
+"""
+
+from analytics_zoo_tpu.robust.errors import TrainingPreempted
+from analytics_zoo_tpu.robust.faults import FaultInjector, fire, inject
+from analytics_zoo_tpu.robust.retry import (RetryDeadlineExceeded,
+                                            RetryPolicy, RetryState)
+
+__all__ = [
+    "RetryPolicy", "RetryState", "RetryDeadlineExceeded",
+    "FaultInjector", "fire", "inject", "TrainingPreempted",
+]

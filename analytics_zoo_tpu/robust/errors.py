@@ -1,0 +1,18 @@
+"""Exceptions of the resilience layer."""
+
+from __future__ import annotations
+
+
+class TrainingPreempted(Exception):
+    """Raised by ``Estimator.fit`` after a preemption (SIGTERM or an
+    injected fault) has been handled: the final synchronous checkpoint
+    is already on disk when this propagates.  ``fit(resume=True)``
+    continues the run exactly where it left off.
+
+    Deliberately NOT retried by the failure-retry loop — a preemption
+    means the host is going away.
+    """
+
+    def __init__(self, message: str, step: int = -1):
+        super().__init__(message)
+        self.step = step

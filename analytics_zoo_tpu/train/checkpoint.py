@@ -9,28 +9,65 @@ Format: our own compact layout — one ``.npz`` holding every array leaf
 keyed by its pytree path, plus a pickled treedef skeleton.  This avoids a
 hard orbax dependency while staying host-portable.
 
+Durability (docs/ROBUSTNESS.md):
+
+- **atomic + synced writes** — serialize into a tempfile in the target
+  directory, ``fsync`` the file, ``os.replace`` onto the final path, then
+  ``fsync`` the directory, so a preemption at ANY instant leaves either
+  the old file set or the new one — never a torn archive at the final
+  path.
+- **per-leaf CRC32 manifest** — stored inside the archive
+  (``__manifest__``); ``load_pytree(verify=True)`` recomputes every
+  leaf's CRC and raises :class:`CheckpointCorruptError` on mismatch, so
+  silent bit-rot (or a torn file written by a non-atomic writer) is
+  detected, not trained on.
+- **verified fallback restore** — ``CheckpointManager.restore()`` walks
+  snapshots newest→oldest, quarantines torn/corrupt files (renamed to
+  ``*.corrupt``, counted in ``robust/ckpt_quarantined``) and recovers
+  from the newest *intact* one; corruption is only fatal when no intact
+  snapshot remains.
+- **retried writes** — transient I/O errors during a save go through a
+  ``RetryPolicy`` before surfacing.
+
 ``CheckpointManager.save_async`` implements the ``async_checkpoint``
 config knob: the device→host copy happens synchronously (cheap — it only
 waits for in-flight steps touching the buffers), then serialization + the
 atomic rename run on a background thread so the training loop resumes
 immediately.  ``wait()`` joins the in-flight write and re-raises its
 error, and is called before any restore so readers never race a writer.
+GC runs under ``_fs_lock`` so a background writer's GC can never hand a
+concurrent ``all_steps()``/``restore()`` a half-deleted directory.
 """
 
 from __future__ import annotations
 
+import json
+import logging
 import os
 import pickle
 import re
 import tempfile
 import threading
-import time
+import zlib
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
 
+from analytics_zoo_tpu.core.profiling import TIMERS
+from analytics_zoo_tpu.robust import RetryPolicy, faults
+
+logger = logging.getLogger("analytics_zoo_tpu.train")
+
 _LEAF = "__leaf__"
+_MANIFEST = "__manifest__"
+_TREEDEF = "__treedef__"
+FORMAT_VERSION = 2
+
+
+class CheckpointCorruptError(RuntimeError):
+    """The archive is readable but fails integrity verification
+    (missing manifest entries or a per-leaf CRC32 mismatch)."""
 
 
 def _path_str(path) -> str:
@@ -47,49 +84,141 @@ def _path_str(path) -> str:
     return "/".join(parts)
 
 
-def save_pytree(path: str, tree: Any) -> None:
-    """Atomically save a pytree of arrays/scalars to ``path`` (.zoo dir)."""
+def _crc32(a: np.ndarray) -> int:
+    return zlib.crc32(np.ascontiguousarray(a).tobytes()) & 0xFFFFFFFF
+
+
+def _fsync_dir(dirname: str) -> None:
+    """Persist the rename itself (POSIX: a rename is durable only once
+    the containing directory is synced)."""
+    try:
+        fd = os.open(dirname, os.O_RDONLY)
+    except OSError:
+        return  # e.g. object-store FUSE mounts without dir handles
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def save_pytree(path: str, tree: Any, fsync: bool = True) -> None:
+    """Atomically + durably save a pytree of arrays/scalars to ``path``.
+
+    The archive embeds a JSON manifest with a CRC32 per leaf so readers
+    can verify integrity end-to-end (``load_pytree(verify=True)``).
+    """
     leaves_with_paths, treedef = jax.tree_util.tree_flatten_with_path(tree)
     arrays: Dict[str, np.ndarray] = {}
+    manifest_leaves: Dict[str, Dict[str, Any]] = {}
     for i, (p, leaf) in enumerate(leaves_with_paths):
-        arrays[f"{i:06d}|{_path_str(p)}"] = np.asarray(leaf)
-    os.makedirs(os.path.dirname(os.path.abspath(path)) or ".", exist_ok=True)
-    # atomic write: tmp + rename
-    dirname = os.path.dirname(os.path.abspath(path))
+        key = f"{i:06d}|{_path_str(p)}"
+        a = np.asarray(leaf)
+        arrays[key] = a
+        manifest_leaves[key] = {"crc32": _crc32(a), "dtype": str(a.dtype),
+                                "shape": list(a.shape)}
+    treedef_bytes = np.frombuffer(pickle.dumps(treedef), dtype=np.uint8)
+    manifest_leaves[_TREEDEF] = {"crc32": _crc32(treedef_bytes),
+                                 "dtype": "uint8",
+                                 "shape": [int(treedef_bytes.size)]}
+    manifest = {"version": FORMAT_VERSION, "leaves": manifest_leaves}
+    manifest_bytes = np.frombuffer(
+        json.dumps(manifest, sort_keys=True).encode("utf-8"), dtype=np.uint8)
+    dirname = os.path.dirname(os.path.abspath(path)) or "."
+    os.makedirs(dirname, exist_ok=True)
+    # atomic write: tmp + fsync + rename + dir fsync
     fd, tmp = tempfile.mkstemp(dir=dirname, suffix=".tmp")
     try:
         with os.fdopen(fd, "wb") as f:
-            np.savez(f, __treedef__=np.frombuffer(
-                pickle.dumps(treedef), dtype=np.uint8), **arrays)
+            np.savez(f, **{_TREEDEF: treedef_bytes,
+                           _MANIFEST: manifest_bytes}, **arrays)
+            f.flush()
+            if fsync:
+                os.fsync(f.fileno())
+        plan = faults.fire("checkpoint.write")
+        if plan is not None:
+            if plan.exc is not None:
+                raise plan.exc
+            if plan.action == "torn":
+                # simulate a non-atomic writer dying mid-write: the final
+                # path receives a truncated archive
+                frac = plan.payload if plan.payload is not None else 0.5
+                size = os.path.getsize(tmp)
+                with open(tmp, "r+b") as f:
+                    f.truncate(max(1, int(size * float(frac))))
         os.replace(tmp, path)
+        if fsync:
+            _fsync_dir(dirname)
     finally:
         if os.path.exists(tmp):
             os.unlink(tmp)
 
 
-def load_pytree(path: str) -> Any:
+def load_pytree(path: str, verify: bool = True) -> Any:
+    """Load a pytree archive; with ``verify`` (default) recompute every
+    leaf's CRC32 against the embedded manifest.  Archives written before
+    the manifest existed (format v1) load unverified with a debug log —
+    old snapshots stay restorable."""
     with np.load(path, allow_pickle=False) as z:
-        treedef = pickle.loads(z["__treedef__"].tobytes())
-        keys = sorted((k for k in z.files if k != "__treedef__"),
+        manifest = None
+        if _MANIFEST in z.files:
+            manifest = json.loads(z[_MANIFEST].tobytes().decode("utf-8"))
+        elif verify:
+            logger.debug("checkpoint %s has no integrity manifest "
+                         "(pre-v%d format); loading unverified",
+                         path, FORMAT_VERSION)
+        treedef_bytes = z[_TREEDEF]
+        keys = sorted((k for k in z.files
+                       if k not in (_TREEDEF, _MANIFEST)),
                       key=lambda k: int(k.split("|", 1)[0]))
-        leaves = [z[k] for k in keys]
+        if verify and manifest is not None:
+            expected = manifest.get("leaves", {})
+            want = set(expected) - {_TREEDEF}
+            have = set(keys)
+            if want != have:
+                raise CheckpointCorruptError(
+                    f"{path}: manifest/leaf mismatch "
+                    f"(missing={sorted(want - have)[:3]} "
+                    f"extra={sorted(have - want)[:3]})")
+            if _TREEDEF in expected and \
+                    _crc32(treedef_bytes) != expected[_TREEDEF]["crc32"]:
+                raise CheckpointCorruptError(f"{path}: treedef CRC mismatch")
+        leaves = []
+        for k in keys:
+            a = z[k]
+            if verify and manifest is not None:
+                if _crc32(a) != manifest["leaves"][k]["crc32"]:
+                    raise CheckpointCorruptError(
+                        f"{path}: CRC mismatch on leaf {k!r}")
+            leaves.append(a)
+        treedef = pickle.loads(treedef_bytes.tobytes())
     return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
 class CheckpointManager:
-    """Numbered snapshots in a directory + latest-recovery.
+    """Numbered snapshots in a directory + verified latest-recovery.
 
     Mirrors the reference's timestamped dirs / ``getLatestFile`` recovery
     (Topology.scala:1519-1536) with explicit step numbering instead of
     mtimes (mtimes lie on object stores).
     """
 
-    def __init__(self, directory: str, keep: int = 3):
+    def __init__(self, directory: str, keep: int = 3, verify: bool = True,
+                 retry: Optional[RetryPolicy] = None):
         self.directory = directory
         self.keep = keep
+        self.verify = verify
         os.makedirs(directory, exist_ok=True)
         self._writer: Optional[threading.Thread] = None
         self._writer_err: Optional[BaseException] = None
+        # serializes GC deletes against foreground listings/restores so
+        # a background save_async's GC can never hand all_steps() or
+        # restore() a half-deleted directory
+        self._fs_lock = threading.Lock()
+        self._retry = retry or RetryPolicy(
+            max_attempts=3, base_delay_s=0.05, max_delay_s=1.0,
+            retry_on=(OSError,), name="checkpoint_write")
 
     def _path(self, step: int) -> str:
         return os.path.join(self.directory, f"ckpt_{step:010d}.npz")
@@ -97,7 +226,8 @@ class CheckpointManager:
     def save(self, step: int, tree: Any) -> str:
         self.wait()
         path = self._path(step)
-        save_pytree(path, tree)
+        with TIMERS.scope("checkpoint/write_sync"):
+            self._retry.call(save_pytree, path, tree)
         self._gc()
         return path
 
@@ -113,7 +243,8 @@ class CheckpointManager:
 
         def write():
             try:
-                save_pytree(path, host_tree)
+                with TIMERS.scope("checkpoint/write_async"):
+                    self._retry.call(save_pytree, path, host_tree)
                 self._gc()
             except BaseException as e:
                 self._writer_err = e
@@ -133,35 +264,75 @@ class CheckpointManager:
             err, self._writer_err = self._writer_err, None
             if raise_errors:
                 raise err
-            import logging
-            logging.getLogger("analytics_zoo_tpu.train").warning(
+            logger.warning(
                 "ignoring failed async checkpoint write during restore: %s",
                 err)
 
     def all_steps(self) -> List[int]:
         steps = []
-        for fn in os.listdir(self.directory):
-            m = re.fullmatch(r"ckpt_(\d+)\.npz", fn)
-            if m:
-                steps.append(int(m.group(1)))
+        with self._fs_lock:
+            for fn in os.listdir(self.directory):
+                m = re.fullmatch(r"ckpt_(\d+)\.npz", fn)
+                if m:
+                    steps.append(int(m.group(1)))
         return sorted(steps)
 
     def latest_step(self) -> Optional[int]:
         steps = self.all_steps()
         return steps[-1] if steps else None
 
+    def _quarantine(self, step: int, err: BaseException) -> None:
+        """Move a torn/corrupt snapshot out of the recovery set (kept on
+        disk for post-mortem, renamed so it can never be restored)."""
+        path = self._path(step)
+        try:
+            with self._fs_lock:
+                os.replace(path, path + ".corrupt")
+        except OSError:
+            pass
+        TIMERS.incr("robust/ckpt_quarantined")
+        logger.warning("checkpoint step %d is corrupt (%s: %s); quarantined "
+                       "as %s.corrupt — falling back to an older snapshot",
+                       step, type(err).__name__, err, os.path.basename(path))
+
     def restore(self, step: Optional[int] = None) -> Tuple[int, Any]:
+        """Load a snapshot, verifying integrity (``verify``).
+
+        With ``step=None`` (latest), torn or corrupt snapshots are
+        quarantined and the newest *intact* one wins; corruption is only
+        fatal when nothing intact remains.  An explicitly requested step
+        is loaded strictly — its corruption raises.
+        """
         self.wait(raise_errors=False)
-        if step is None:
-            step = self.latest_step()
-        if step is None:
+        if step is not None:
+            return step, load_pytree(self._path(step), verify=self.verify)
+        steps = self.all_steps()
+        if not steps:
             raise FileNotFoundError(f"no checkpoints in {self.directory}")
-        return step, load_pytree(self._path(step))
+        for s in reversed(steps):
+            try:
+                tree = load_pytree(self._path(s), verify=self.verify)
+                return s, tree
+            except KeyboardInterrupt:
+                raise
+            except Exception as e:
+                # torn zip (BadZipFile/EOF), CRC mismatch, unpickle noise —
+                # every flavour of "this file is not a usable snapshot"
+                self._quarantine(s, e)
+        raise FileNotFoundError(
+            f"no intact checkpoints in {self.directory} "
+            f"({len(steps)} candidate(s) quarantined)")
 
     def _gc(self) -> None:
-        steps = self.all_steps()
-        for s in steps[: max(0, len(steps) - self.keep)]:
-            try:
-                os.unlink(self._path(s))
-            except OSError:
-                pass
+        with self._fs_lock:
+            steps = []
+            for fn in os.listdir(self.directory):
+                m = re.fullmatch(r"ckpt_(\d+)\.npz", fn)
+                if m:
+                    steps.append(int(m.group(1)))
+            steps.sort()
+            for s in steps[: max(0, len(steps) - self.keep)]:
+                try:
+                    os.unlink(self._path(s))
+                except OSError:
+                    pass

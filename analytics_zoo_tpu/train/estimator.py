@@ -36,6 +36,9 @@ from __future__ import annotations
 
 import logging
 import math
+import pickle
+import signal
+import threading
 import time
 from functools import partial
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
@@ -50,6 +53,7 @@ from analytics_zoo_tpu.core.profiling import TIMERS, timeit
 from analytics_zoo_tpu.core.triggers import (EveryEpoch, Trigger, TriggerState)
 from analytics_zoo_tpu.nn import metrics as metrics_lib
 from analytics_zoo_tpu.nn import objectives
+from analytics_zoo_tpu.robust import RetryPolicy, TrainingPreempted, faults
 from analytics_zoo_tpu.train import checkpoint as ckpt_lib
 from analytics_zoo_tpu.train import optimizers as optim_lib
 from analytics_zoo_tpu.train import prefetch as prefetch_lib
@@ -158,6 +162,14 @@ class Estimator:
         self._last_val_result: Optional[Dict[str, float]] = None
         self._tb_writer = None
         self._rng = jax.random.PRNGKey(self.ctx.config.seed)
+        # resilience state (docs/ROBUSTNESS.md): the host-side shuffle rng
+        # is an attribute (not a fit() local) so checkpoints can capture it
+        # and fit(resume=True) can continue the exact shuffle stream
+        self._host_rng = np.random.RandomState(self.ctx.config.seed)
+        self._lr_scale = 1.0            # NaN-rollback learning-rate backoff
+        self._guard = None              # device-resident NaN-guard carry
+        self._pending_resume: Optional[Tuple[int, int, Any]] = None
+        self._preempt = threading.Event()
 
         self._train_step = None
         self._multi_step = None
@@ -175,7 +187,8 @@ class Estimator:
     # ------------------------------------------------------------------
     def set_checkpoint(self, path: str, over_write: bool = True,
                        trigger: Optional[Trigger] = None, keep: int = 3):
-        self._ckpt_mgr = ckpt_lib.CheckpointManager(path, keep=keep)
+        self._ckpt_mgr = ckpt_lib.CheckpointManager(
+            path, keep=keep, verify=self.ctx.config.ckpt_verify)
         if trigger is not None:
             self._ckpt_trigger = trigger
         return self
@@ -277,6 +290,84 @@ class Estimator:
         self.opt_state = jax.jit(
             self.tx.init, out_shardings=self._opt_shardings())(self.params)
 
+    # ------------------------------------------------------------------
+    # NaN/Inf guard (docs/ROBUSTNESS.md)
+    # ------------------------------------------------------------------
+    def _fresh_guard(self):
+        """Device-resident guard carry: bad/consecutive-bad step counters
+        plus the rollback learning-rate scale.  Rides the donated step
+        carry so the happy path costs ZERO extra host syncs — the host
+        reads it back once per epoch (``_check_nan_guard``)."""
+        rep = self.ctx.replicated_sharding()
+        return jax.device_put(
+            {"bad": jnp.zeros((), jnp.int32),
+             "consec": jnp.zeros((), jnp.int32),
+             "max_consec": jnp.zeros((), jnp.int32),
+             "lr_scale": jnp.asarray(self._lr_scale, jnp.float32)}, rep)
+
+    @staticmethod
+    def _guard_step(guard, finite):
+        """One step's guard-carry update (traced inside the jitted step)."""
+        bad_inc = jnp.where(finite, 0, 1).astype(jnp.int32)
+        consec = jnp.where(finite, 0, guard["consec"] + 1).astype(jnp.int32)
+        return {"bad": guard["bad"] + bad_inc,
+                "consec": consec,
+                "max_consec": jnp.maximum(guard["max_consec"], consec),
+                "lr_scale": guard["lr_scale"]}
+
+    def _check_nan_guard(self, steps_in_window: int) -> bool:
+        """Epoch-boundary policy check: ONE host sync reads the guard
+        carry back, applies ``nan_policy``, and re-arms a fresh guard.
+        Returns True when the policy rolled training back to the last
+        checkpoint (the caller must re-run from ``finished_epochs``)."""
+        cfg = self.ctx.config
+        g = jax.device_get(self._guard)
+        TIMERS.incr("robust/guard_check")
+        self._guard = self._fresh_guard()
+        bad = int(g["bad"])
+        max_consec = int(g["max_consec"])
+        if bad == 0:
+            return False
+        TIMERS.incr("robust/nan_steps", bad)
+        logger.warning("%d/%d steps had a non-finite loss (max %d "
+                       "consecutive); nan_policy=%s", bad, steps_in_window,
+                       max_consec, cfg.nan_policy)
+        if cfg.nan_policy == "raise":
+            TIMERS.incr("robust/nan_raised")
+            raise FloatingPointError(
+                f"{bad} non-finite training step(s) in the last "
+                f"{steps_in_window} (nan_policy=raise); the bad updates "
+                f"were skipped on device, params remain finite")
+        TIMERS.incr("robust/nan_skipped", bad)
+        if cfg.nan_policy == "rollback" and max_consec >= cfg.max_bad_steps:
+            if self._ckpt_mgr is not None:
+                self._ckpt_mgr.wait(raise_errors=False)
+            if (self._ckpt_mgr is None
+                    or self._ckpt_mgr.latest_step() is None):
+                raise FloatingPointError(
+                    f"{max_consec} consecutive non-finite steps >= "
+                    f"max_bad_steps={cfg.max_bad_steps} but no checkpoint "
+                    "to roll back to (set_checkpoint first)")
+            # back off from the LIVE scale (restore would reset it to the
+            # checkpoint's value, so repeated rollbacks must compound past
+            # the restore)
+            backed_off = self._lr_scale * cfg.nan_backoff_factor
+            TIMERS.incr("robust/nan_rollbacks")
+            logger.warning(
+                "rolling back to last checkpoint after %d consecutive "
+                "non-finite steps; learning-rate scale backed off to %.4g",
+                max_consec, backed_off)
+            self._restore_checkpoint()
+            self._lr_scale = backed_off
+            self._guard = self._fresh_guard()   # picks up the new lr_scale
+            return True
+        if cfg.nan_policy == "skip" and max_consec >= cfg.max_bad_steps:
+            raise FloatingPointError(
+                f"{max_consec} consecutive non-finite steps >= "
+                f"max_bad_steps={cfg.max_bad_steps} under nan_policy=skip "
+                "— training is making no progress")
+        return False
+
     def _build_train_step(self):
         model, loss_fn, tx = self.model, self.loss_fn, self.tx
         data_shard = self.ctx.data_sharding()
@@ -291,7 +382,9 @@ class Estimator:
         strat = self._strategy()
         mesh = self.ctx.mesh
 
-        def step(params, state, opt_state, rng, xs, y):
+        guard_step = self._guard_step
+
+        def step(params, state, opt_state, rng, guard, xs, y):
             # rng is carried ON DEVICE and split inside the step — passing
             # a host step counter per step would cost a blocking scalar
             # transfer (tens of ms over remote-tunnel links) per iteration
@@ -333,21 +426,42 @@ class Estimator:
             (loss, new_state), grads = jax.value_and_grad(
                 lossf, has_aux=True)(params)
             updates, new_opt = tx.update(grads, opt_state, params)
+            # NaN-rollback LR backoff: a replicated scalar in the guard
+            # carry scales the update — changing it costs no recompile
+            updates = jax.tree_util.tree_map(
+                lambda u: u * guard["lr_scale"].astype(u.dtype)
+                if jnp.issubdtype(jnp.asarray(u).dtype, jnp.floating) else u,
+                updates)
             if frozen:
                 updates = {
                     k: (jax.tree_util.tree_map(jnp.zeros_like, u)
                         if k in frozen else u)
                     for k, u in updates.items()}
             new_params = optax.apply_updates(params, updates)
-            return new_params, new_state, new_opt, rng, loss
+            # NaN/Inf guard: a non-finite loss means this update is junk —
+            # discard it ON DEVICE (params/state/opt keep their pre-step
+            # values) and count it in the carried guard; the host applies
+            # the nan_policy at epoch granularity (zero per-step syncs)
+            finite = jnp.isfinite(loss)
+
+            def keep_if_finite(new, old):
+                return jax.tree_util.tree_map(
+                    lambda a, b: jnp.where(finite, a, b), new, old)
+
+            new_params = keep_if_finite(new_params, params)
+            new_state = keep_if_finite(new_state, state)
+            new_opt = keep_if_finite(new_opt, opt_state)
+            return (new_params, new_state, new_opt, rng,
+                    guard_step(guard, finite), loss)
 
         # params/state/opt shardings are inherited from their device_put
         # placement (replicated for DP, model-axis split for TP) — pinning
         # only the batch keeps one step implementation for every strategy.
         self._train_step = jax.jit(
             step,
-            in_shardings=(None, None, None, rep, data_shard, data_shard),
-            donate_argnums=(0, 1, 2, 3),
+            in_shardings=(None, None, None, rep, rep, data_shard,
+                          data_shard),
+            donate_argnums=(0, 1, 2, 3, 4),
         )
         self._single_step_fn = step
 
@@ -369,21 +483,23 @@ class Estimator:
         # batch axis is axis 1 of the (K, B, ...) superbatch
         chunk_shard = NamedSharding(self.ctx.mesh, P(None, self.ctx.data_axis))
 
-        def multi(params, state, opt_state, rng, xs_stack, y_stack):
+        def multi(params, state, opt_state, rng, guard, xs_stack, y_stack):
             def body(carry, batch):
-                p, s, o, r = carry
+                p, s, o, r, g = carry
                 bxs, by = batch
-                p, s, o, r, loss = single(p, s, o, r, bxs, by)
-                return (p, s, o, r), loss
+                p, s, o, r, g, loss = single(p, s, o, r, g, bxs, by)
+                return (p, s, o, r, g), loss
 
-            (params, state, opt_state, rng), losses = jax.lax.scan(
-                body, (params, state, opt_state, rng), (xs_stack, y_stack))
-            return params, state, opt_state, rng, losses
+            (params, state, opt_state, rng, guard), losses = jax.lax.scan(
+                body, (params, state, opt_state, rng, guard),
+                (xs_stack, y_stack))
+            return params, state, opt_state, rng, guard, losses
 
         self._multi_step = jax.jit(
             multi,
-            in_shardings=(None, None, None, rep, chunk_shard, chunk_shard),
-            donate_argnums=(0, 1, 2, 3),
+            in_shardings=(None, None, None, rep, rep, chunk_shard,
+                          chunk_shard),
+            donate_argnums=(0, 1, 2, 3, 4),
         )
 
     def _build_resident_epoch(self, n: int, eff_batch: int, steps: int,
@@ -418,27 +534,34 @@ class Estimator:
                 v, NamedSharding(mesh, P(data_axis,
                                          *([None] * (v.ndim - 1)))))
 
-        def epoch(params, state, opt_state, rng, xs, y):
+        def epoch(params, state, opt_state, rng, guard, xs, y):
             rng, prm = jax.random.split(rng)
             perm = resident_epoch_indices(
                 prm, n, shuffle=shuffle, pair_structured=pair_structured)
 
             def body(i, carry):
-                p, s, o, r, loss_sum = carry
+                p, s, o, r, g, loss_sum, good = carry
                 idx = jax.lax.dynamic_slice_in_dim(perm, i * eff_batch,
                                                    eff_batch)
                 bxs = [constrain(jnp.take(a, idx, axis=0)) for a in xs]
                 by = constrain(jnp.take(y, idx, axis=0))
-                p, s, o, r, loss = single(p, s, o, r, bxs, by)
-                return (p, s, o, r, loss_sum + loss)
+                p, s, o, r, g, loss = single(p, s, o, r, g, bxs, by)
+                # NaN guard: bad-step counts accumulate in the carried
+                # guard; the epoch-mean loss aggregates finite steps only
+                # so one bad step cannot poison the reported loss
+                finite = jnp.isfinite(loss)
+                loss_sum = loss_sum + jnp.where(finite, loss, 0.0)
+                good = good + finite.astype(jnp.int32)
+                return (p, s, o, r, g, loss_sum, good)
 
-            carry = (params, state, opt_state, rng,
-                     jnp.zeros((), jnp.float32))
-            params, state, opt_state, rng, loss_sum = jax.lax.fori_loop(
-                0, steps, body, carry)
-            return params, state, opt_state, rng, loss_sum / steps
+            carry = (params, state, opt_state, rng, guard,
+                     jnp.zeros((), jnp.float32), jnp.zeros((), jnp.int32))
+            (params, state, opt_state, rng, guard, loss_sum,
+             good) = jax.lax.fori_loop(0, steps, body, carry)
+            mean = loss_sum / jnp.maximum(good, 1).astype(jnp.float32)
+            return params, state, opt_state, rng, guard, mean
 
-        self._resident_epoch = jax.jit(epoch, donate_argnums=(0, 1, 2, 3))
+        self._resident_epoch = jax.jit(epoch, donate_argnums=(0, 1, 2, 3, 4))
         self._resident_epoch_key = key
         return self._resident_epoch
 
@@ -605,7 +728,8 @@ class Estimator:
             validation_data=None, end_trigger: Optional[Trigger] = None,
             shuffle: bool = True, verbose: bool = True,
             validation_trigger: Optional[Trigger] = None,
-            validation_batch_size: Optional[int] = None):
+            validation_batch_size: Optional[int] = None,
+            resume: bool = False):
         """Synchronous SPMD training with retry-from-checkpoint.
 
         ``x`` — array or list of arrays (multi-input models); or a
@@ -614,11 +738,28 @@ class Estimator:
         every epoch); ``validation_batch_size`` defaults to the training
         batch (reference setValidation trigger/batch semantics,
         Topology.scala:223-244).
+        ``resume`` — continue from the newest intact checkpoint (set via
+        ``set_checkpoint`` or the ``checkpoint_dir`` config knob): full
+        training state — params, optimizer, device AND host rng streams,
+        epoch/step position — is restored, so an interrupted run re-run
+        with ``resume=True`` reproduces the uninterrupted run exactly
+        (docs/ROBUSTNESS.md).  A SIGTERM during fit flushes one final
+        synchronous checkpoint and raises
+        :class:`~analytics_zoo_tpu.robust.TrainingPreempted`.
         """
         from analytics_zoo_tpu.data.featureset import FeatureSet
 
         self._val_trigger = validation_trigger
         self._val_batch = validation_batch_size
+        if resume:
+            self._try_resume()
+        else:
+            # a non-resuming fit() replays the configured shuffle stream
+            # from its seed (deterministic runs); resume instead restores
+            # the stream position from the checkpoint manifest
+            self._host_rng = np.random.RandomState(self.ctx.config.seed)
+            self._pending_resume = None
+        self._preempt.clear()
         # freeze()/unfreeze() after a previous fit must take effect: the
         # compiled step captured the old frozen set, so rebuild it
         cur_frozen = frozenset(getattr(self.model, "_frozen", ()))
@@ -627,18 +768,120 @@ class Estimator:
             self._train_step = None
             self._multi_step = None
             self._resident_epoch = None
-        if isinstance(x, FeatureSet):
-            path, reason = self._resolve_data_path(x)
-            self.last_data_path, self.last_data_path_reason = path, reason
-            TIMERS.incr(f"estimator/data_path_{path}")
-            if path == "device_resident":
-                return self._fit_device_resident(
-                    x, batch_size, epochs, validation_data, end_trigger,
-                    verbose, shuffle)
-            return self._fit_featureset(x, batch_size, epochs,
-                                        validation_data, end_trigger,
-                                        verbose, shuffle)
+        restore_sig = self._install_preempt_handler()
+        try:
+            if isinstance(x, FeatureSet):
+                path, reason = self._resolve_data_path(x)
+                self.last_data_path, self.last_data_path_reason = \
+                    path, reason
+                TIMERS.incr(f"estimator/data_path_{path}")
+                if path == "device_resident":
+                    return self._fit_device_resident(
+                        x, batch_size, epochs, validation_data,
+                        end_trigger, verbose, shuffle)
+                return self._fit_featureset(x, batch_size, epochs,
+                                            validation_data, end_trigger,
+                                            verbose, shuffle)
+            return self._fit_arrays(x, y, batch_size, epochs,
+                                    validation_data, end_trigger, shuffle,
+                                    verbose)
+        finally:
+            restore_sig()
 
+    # ------------------------------------------------------------------
+    # resilience plumbing (docs/ROBUSTNESS.md)
+    # ------------------------------------------------------------------
+    def _try_resume(self) -> bool:
+        """``fit(resume=True)``: restore full training state from the
+        newest intact checkpoint; a missing checkpoint is a fresh start,
+        never an error (so the same command line works for attempt #1
+        and every restart after a preemption)."""
+        cfg = self.ctx.config
+        if self._ckpt_mgr is None and cfg.checkpoint_dir:
+            self.set_checkpoint(cfg.checkpoint_dir)
+        if self._ckpt_mgr is None or self._ckpt_mgr.latest_step() is None:
+            logger.info("fit(resume=True): no checkpoint found; "
+                        "starting fresh")
+            self._host_rng = np.random.RandomState(cfg.seed)
+            self._pending_resume = None
+            return False
+        self._restore_checkpoint()
+        TIMERS.incr("robust/auto_resume")
+        return True
+
+    def _install_preempt_handler(self) -> Callable[[], None]:
+        """SIGTERM → request a final synchronous checkpoint at the next
+        step boundary (the preemption story: lose at most one step, not
+        the run).  Returns a callable restoring the previous handler.
+        No-op off the main thread (signal.signal would raise)."""
+        if threading.current_thread() is not threading.main_thread():
+            return lambda: None
+
+        def _on_sigterm(signum, frame):
+            logger.warning("SIGTERM received: flushing a final checkpoint "
+                           "at the next step boundary")
+            self._preempt.set()
+
+        try:
+            prev = signal.signal(signal.SIGTERM, _on_sigterm)
+        except (ValueError, OSError):
+            return lambda: None
+
+        def restore():
+            try:
+                signal.signal(signal.SIGTERM, prev)
+            except (ValueError, OSError, TypeError):
+                pass
+
+        return restore
+
+    def _flush_preempt(self, epoch: int, in_epoch_step: int,
+                       epoch_rng_state) -> None:
+        """Preemption (SIGTERM or injected): flush ONE synchronous
+        checkpoint carrying the mid-epoch resume manifest, then abort
+        fit with :class:`TrainingPreempted`."""
+        step = self.global_step
+        if self._ckpt_mgr is not None:
+            self._ckpt_mgr.save(step, self._snapshot(
+                resume_epoch=epoch, in_epoch_step=in_epoch_step,
+                epoch_rng_state=epoch_rng_state))
+            TIMERS.incr("robust/preempt_flush")
+            logger.warning(
+                "preempted at global step %d (epoch %d, in-epoch step %d): "
+                "final synchronous checkpoint flushed; fit(resume=True) "
+                "continues exactly here", step, epoch + 1, in_epoch_step)
+        else:
+            logger.warning("preempted at global step %d with NO checkpoint "
+                           "manager set; training state is lost", step)
+        raise TrainingPreempted(
+            f"training preempted at global step {step}", step=step)
+
+    def _maybe_preempt(self, epoch: int, in_epoch_step: int,
+                       epoch_rng_state=None) -> None:
+        """Per-step preemption check (host paths; the device-resident
+        path checks between its one-dispatch epochs)."""
+        if faults.fire("estimator.preempt") is not None:
+            self._preempt.set()
+        if self._preempt.is_set():
+            self._flush_preempt(epoch, in_epoch_step, epoch_rng_state)
+
+    @staticmethod
+    def _inject_step_faults(bx, by):
+        """Chaos hook consulted once per prepared dispatch: a planned
+        ``estimator.step`` fault either raises (pipeline failure) or
+        NaN-poisons the batch (numerical blow-up) — both exactly at the
+        planned dispatch index."""
+        plan = faults.fire("estimator.step")
+        if plan is not None:
+            if plan.exc is not None:
+                raise plan.exc
+            if plan.action == "nan":
+                poisoned = faults.poison_nan(list(bx) + [by])
+                bx, by = poisoned[:-1], poisoned[-1]
+        return bx, by
+
+    def _fit_arrays(self, x, y, batch_size, epochs, validation_data,
+                    end_trigger, shuffle, verbose):
         xs = _as_list(x)
         assert y is not None, "y required for array training"
         n = xs[0].shape[0]
@@ -665,8 +908,16 @@ class Estimator:
         if self._train_step is None:
             self._build_train_step()
 
-        fail_times: List[float] = []
         cfg = self.ctx.config
+        # Failure-retry semantics of the reference's retryTimes /
+        # retryTimeInterval pair (Topology.scala:1179-1261), now expressed
+        # through the reusable RetryPolicy: failures age out of a sliding
+        # window, and each retry backs off exponentially before restoring
+        # the last checkpoint.
+        retry = RetryPolicy.from_config(
+            cfg, max_attempts=cfg.failure_retry_times,
+            window_s=cfg.failure_retry_interval_s,
+            name="estimator_fit").state()
         K = max(1, int(cfg.steps_per_execution))
         if K > 1 and self._val_trigger is not None:
             logger.warning(
@@ -677,7 +928,7 @@ class Estimator:
         n_chunks = steps_per_epoch // K if K > 1 else 0
         rem = steps_per_epoch - n_chunks * K
         epoch = self.finished_epochs
-        rng_np = np.random.RandomState(cfg.seed)
+        self._guard = self._fresh_guard()
         # Device-resident mode: when the caller hands in jax.Arrays, every
         # epoch's shuffle permutation, gather, and (K, B) reshape happen ON
         # DEVICE — an epoch moves zero bytes host→device.  This is the hot
@@ -715,6 +966,28 @@ class Estimator:
             batches = None
             try:
                 t0 = time.time()
+                # Mid-epoch resume (preemption manifest): rewind the host
+                # shuffle rng to the interrupted epoch's start state so the
+                # SAME permutation is redrawn, then skip the steps the
+                # interrupted run already trained — the step sequence seen
+                # by the optimizer is bit-identical to an uninterrupted run.
+                start_step = 0
+                if (self._pending_resume is not None
+                        and self._pending_resume[0] == epoch):
+                    _, start_step, rng_state = self._pending_resume
+                    self._pending_resume = None
+                    if rng_state is not None:
+                        self._host_rng.set_state(rng_state)
+                    # steps advance K at a time inside chunks; align down so
+                    # resume never starts mid-chunk (the flush only happens
+                    # at dispatch boundaries, so this is exact in practice)
+                    if K > 1 and start_step < n_chunks * K:
+                        start_step = (start_step // K) * K
+                    logger.info("resuming epoch %d at in-epoch step %d",
+                                epoch + 1, start_step)
+                elif self._pending_resume is not None:
+                    self._pending_resume = None
+                epoch_rng_state = self._host_rng.get_state()
                 if not shuffle:
                     perm = None         # contiguous slices in both modes
                 elif device_resident and pair_structured:
@@ -729,53 +1002,74 @@ class Estimator:
                     perm = jax.random.permutation(
                         jax.random.PRNGKey(cfg.seed + 7919 * epoch), n)
                 elif pair_structured:
-                    perm = _pair_perm_np(rng_np)
+                    perm = _pair_perm_np(self._host_rng)
                 else:
-                    perm = rng_np.permutation(n)
+                    perm = self._host_rng.permutation(n)
                 losses = []
 
-                def gen(perm=perm):
-                    ofs = 0
-                    for _ in range(n_chunks):
+                def gen(perm=perm, start=start_step):
+                    for ci in range(n_chunks):
+                        s0 = ci * K
+                        if s0 < start:      # resume: already trained
+                            continue
+                        ofs = s0 * eff_batch
                         sl = (slice(ofs, ofs + K * eff_batch)
                               if perm is None
                               else perm[ofs:ofs + K * eff_batch])
-                        ofs += K * eff_batch
                         yield ("K",
                                [a[sl].reshape((K, eff_batch) + a.shape[1:])
                                 for a in xs],
                                y_arr[sl].reshape(
                                    (K, eff_batch) + y_arr.shape[1:]))
-                    for _ in range(rem):
+                    for ri in range(rem):
+                        s0 = n_chunks * K + ri
+                        if s0 < start:
+                            continue
+                        ofs = s0 * eff_batch
                         sl = (slice(ofs, ofs + eff_batch) if perm is None
                               else perm[ofs:ofs + eff_batch])
-                        ofs += eff_batch
                         yield ("1", [a[sl] for a in xs], y_arr[sl])
 
                 def prep(item):
                     kind, bx, by = item
+                    bx, by = self._inject_step_faults(bx, by)
                     put = self._shard_chunk if kind == "K" else \
                         self._shard_batch
-                    return kind, put(bx), put([by])[0]
+                    return kind, put(list(bx)), put([by])[0]
 
                 # overlap host batch prep + device_put with device compute
                 batches = prefetch_lib.prefetch(gen(), prep,
                                                 depth=cfg.data_prefetch)
+                in_epoch = start_step
                 for kind, batch_x, batch_y in batches:
+                    # pre-dispatch check: a flush here can never mark a
+                    # fully-trained epoch as mid-epoch (in_epoch stays
+                    # strictly below steps_per_epoch)
+                    self._maybe_preempt(epoch, in_epoch, epoch_rng_state)
                     step_fn = (self._multi_step if kind == "K"
                                else self._train_step)
                     (self.params, self.state, self.opt_state, self._rng,
-                     loss) = step_fn(self.params, self.state,
-                                     self.opt_state, self._rng,
-                                     batch_x, batch_y)
-                    self.global_step += K if kind == "K" else 1
+                     self._guard, loss) = step_fn(
+                         self.params, self.state, self.opt_state,
+                         self._rng, self._guard, batch_x, batch_y)
+                    k = K if kind == "K" else 1
+                    self.global_step += k
+                    in_epoch += k
                     losses.append(loss)
                     self._maybe_midepoch_validation(validation_data,
                                                     epoch + 1, eff_batch)
+                # ONE host sync per epoch reads the NaN-guard counters that
+                # rode the device carry (policy: skip / rollback / raise)
+                if self._check_nan_guard(in_epoch - start_step):
+                    epoch = self.finished_epochs   # rolled back
+                    continue
                 epoch += 1
                 self.finished_epochs = epoch
-                mean_loss = float(jnp.mean(jnp.concatenate(
+                # nanmean: skipped (non-finite) steps must not poison the
+                # epoch metric — their updates were discarded on device
+                mean_loss = (float(jnp.nanmean(jnp.concatenate(
                     [jnp.atleast_1d(l) for l in losses])))
+                    if losses else float("nan"))
                 dt = time.time() - t0
                 rec = {"epoch": epoch, "loss": mean_loss,
                        "throughput": steps_per_epoch * eff_batch / dt}
@@ -810,32 +1104,30 @@ class Estimator:
                     self._save_checkpoint()
                 if end_trigger is not None and end_trigger(tstate):
                     break
-            except (KeyboardInterrupt,):
+            except (KeyboardInterrupt, TrainingPreempted,
+                    FloatingPointError):
                 # release the prefetch producer (its sentinel delivery
-                # waits for close() on abandonment)
+                # waits for close() on abandonment); preemption and the
+                # "raise" NaN policy must surface, never be retried
                 if batches is not None and hasattr(batches, "close"):
                     batches.close()
                 raise
             except Exception as e:  # failure-retry (Topology.scala:1179-1261)
                 if batches is not None and hasattr(batches, "close"):
                     batches.close()
-                # Retries are counted within a sliding time window
-                # (``failure_retry_interval_s``) like the reference's
-                # retryTimes/retryTimeInterval pair: old failures age out,
-                # so a long-running job survives rare transient faults.
-                now = time.time()
-                fail_times = [t for t in fail_times
-                              if now - t < cfg.failure_retry_interval_s]
-                fail_times.append(now)
+                if self._ckpt_mgr is not None:
+                    # an async write may still be in flight — land it so
+                    # the retry decision sees the newest snapshot
+                    self._ckpt_mgr.wait(raise_errors=False)
                 if (self._ckpt_mgr is None
                         or self._ckpt_mgr.latest_step() is None
-                        or len(fail_times) > cfg.failure_retry_times):
+                        or not retry.record_failure()):
                     raise
-                logger.warning(
-                    "step failed (%s); retry %d/%d (within %.0fs window) "
-                    "from checkpoint", e, len(fail_times),
-                    cfg.failure_retry_times, cfg.failure_retry_interval_s)
+                logger.warning("step failed (%s); retry %s from checkpoint",
+                               e, retry.describe())
+                retry.backoff()
                 self._restore_checkpoint()
+                self._guard = self._fresh_guard()
                 # re-sync the loop counter so rolled-back epochs re-train
                 epoch = self.finished_epochs
         if self._ckpt_mgr is not None:
@@ -936,6 +1228,14 @@ class Estimator:
                 "device-resident path runs each epoch as one dispatch; "
                 "validation_trigger is evaluated at epoch boundaries only")
         epoch_fn = self._build_resident_epoch(n, eff_batch, steps, shuffle)
+        if self._pending_resume is not None:
+            # resident epochs are one dispatch, so resume granularity is
+            # the epoch boundary: a mid-epoch manifest (written by a host
+            # input path) restarts its epoch from the restored weights
+            if self._pending_resume[1] > 0:
+                logger.warning("device-resident path resumes at epoch "
+                               "boundaries; dropping mid-epoch resume marker")
+            self._pending_resume = None
         # commit the carry under the mesh BEFORE the first dispatch: the
         # epoch outputs come back mesh-replicated, and a first call with
         # uncommitted host-placed params would compile a second, separate
@@ -946,16 +1246,38 @@ class Estimator:
         (self.params, self.state, self.opt_state, self._rng) = \
             jax.device_put(
                 (self.params, self.state, self.opt_state, self._rng), rep)
-        for epoch in range(self.finished_epochs, epochs):
+        self._guard = self._fresh_guard()
+        epoch = self.finished_epochs
+        while epoch < epochs:
+            self._maybe_preempt(epoch, 0)
+            # chaos hook: poison planned rows of this epoch's (copy-on-
+            # write) inputs so the in-dispatch NaN guard has real work
+            xs_e, y_e = xs, y
+            plan = faults.fire("estimator.resident_nan_rows")
+            if plan is not None and plan.action == "nan":
+                rows = jnp.asarray(plan.payload)
+
+                def _poison(a):
+                    if jnp.issubdtype(a.dtype, jnp.floating):
+                        return a.at[rows].set(jnp.nan)
+                    return a
+
+                xs_e = [_poison(a) for a in xs]
+                y_e = _poison(y)
             t0 = time.time()
             with timeit("estimator/resident_epoch"):
                 (self.params, self.state, self.opt_state, self._rng,
-                 mean_loss) = epoch_fn(self.params, self.state,
-                                       self.opt_state, self._rng, xs, y)
+                 self._guard, mean_loss) = epoch_fn(
+                     self.params, self.state, self.opt_state, self._rng,
+                     self._guard, xs_e, y_e)
                 mean_loss = float(mean_loss)    # epoch-granular sync
             self.global_step += steps
+            if self._check_nan_guard(steps):
+                epoch = self.finished_epochs    # rolled back
+                continue
             dt = time.time() - t0
-            if self._epoch_bookkeeping(epoch + 1, mean_loss, dt,
+            epoch += 1
+            if self._epoch_bookkeeping(epoch, mean_loss, dt,
                                        steps * eff_batch, validation_data,
                                        batch_size, verbose, end_trigger):
                 break
@@ -972,10 +1294,21 @@ class Estimator:
         # bounded shuffle window keeps disk-backed tiers near-sequential
         shuffle_buffer = (cfg.shuffle_buffer
                           if fs.memory_type != "DRAM" else None)
-        for epoch in range(self.finished_epochs, epochs):
+        if self._pending_resume is not None:
+            # FeatureSet iterators own their shuffle stream, so resume
+            # granularity is the epoch boundary: restart the interrupted
+            # epoch from the restored (mid-epoch) weights
+            if self._pending_resume[1] > 0:
+                logger.warning("FeatureSet path resumes at epoch "
+                               "boundaries; restarting the interrupted epoch")
+            self._pending_resume = None
+        self._guard = self._fresh_guard()
+        epoch = self.finished_epochs
+        while epoch < epochs:
             t0 = time.time()
             losses = []
             count = 0
+            in_epoch = 0
             raw = fs.batches(batch_size, shuffle=shuffle,
                              drop_remainder=True,
                              pad_to=self.ctx.num_devices,
@@ -1014,23 +1347,27 @@ class Estimator:
             def prep(item):
                 kind, arrs = item
                 *bx, by = arrs
+                bx, by = self._inject_step_faults(bx, by)
                 put = self._shard_chunk if kind == "K" else self._shard_batch
                 rows = (by.shape[0] * by.shape[1] if kind == "K"
                         else by.shape[0])
-                return kind, put(bx), put([by])[0], rows
+                return kind, put(list(bx)), put([by])[0], rows
 
             src = chunked(raw) if K > 1 else (("1", list(b)) for b in raw)
             batches = prefetch_lib.prefetch(src, prep,
                                             depth=cfg.data_prefetch)
             try:
                 for kind, batch_x, batch_y, bn in batches:
+                    self._maybe_preempt(epoch, in_epoch)
                     step_fn = (self._multi_step if kind == "K"
                                else self._train_step)
                     (self.params, self.state, self.opt_state, self._rng,
-                     loss) = step_fn(self.params, self.state,
-                                     self.opt_state, self._rng,
-                                     batch_x, batch_y)
-                    self.global_step += K if kind == "K" else 1
+                     self._guard, loss) = step_fn(
+                         self.params, self.state, self.opt_state,
+                         self._rng, self._guard, batch_x, batch_y)
+                    k = K if kind == "K" else 1
+                    self.global_step += k
+                    in_epoch += k
                     count += bn
                     losses.append(loss)
                     self._maybe_midepoch_validation(validation_data,
@@ -1039,10 +1376,14 @@ class Estimator:
                 if hasattr(batches, "close"):
                     batches.close()
                 raise
-            mean_loss = float(jnp.mean(jnp.concatenate(
+            if self._check_nan_guard(in_epoch):
+                epoch = self.finished_epochs    # rolled back
+                continue
+            mean_loss = float(jnp.nanmean(jnp.concatenate(
                     [jnp.atleast_1d(l) for l in losses])))
             dt = time.time() - t0
-            if self._epoch_bookkeeping(epoch + 1, mean_loss, dt, count,
+            epoch += 1
+            if self._epoch_bookkeeping(epoch, mean_loss, dt, count,
                                        validation_data, batch_size,
                                        verbose, end_trigger):
                 break
@@ -1157,12 +1498,30 @@ class Estimator:
     # ------------------------------------------------------------------
     # checkpoint plumbing
     # ------------------------------------------------------------------
-    def _snapshot(self):
+    def _snapshot(self, resume_epoch: Optional[int] = None,
+                  in_epoch_step: int = 0, epoch_rng_state=None):
+        """Full training state: model/opt/rng plus the resume manifest
+        (docs/ROBUSTNESS.md).  Host rng states are pickled numpy
+        ``RandomState`` tuples stored as uint8 arrays — ``epoch_rng`` is
+        the stream position at the START of the (possibly interrupted)
+        epoch so a mid-epoch resume can redraw the identical shuffle."""
+        if epoch_rng_state is None:
+            epoch_rng_state = self._host_rng.get_state()
+        meta = {"global_step": np.asarray(self.global_step),
+                "finished_epochs": np.asarray(self.finished_epochs),
+                "rng": np.asarray(self._rng),
+                "lr_scale": np.asarray(self._lr_scale, np.float32),
+                "resume_epoch": np.asarray(
+                    self.finished_epochs if resume_epoch is None
+                    else resume_epoch),
+                "in_epoch_step": np.asarray(in_epoch_step),
+                "data_path": np.asarray(self.last_data_path or "unset"),
+                "host_rng": np.frombuffer(
+                    pickle.dumps(self._host_rng.get_state()), np.uint8),
+                "epoch_rng": np.frombuffer(
+                    pickle.dumps(epoch_rng_state), np.uint8)}
         return {"params": self.params, "state": self.state,
-                "opt_state": self.opt_state,
-                "meta": {"global_step": np.asarray(self.global_step),
-                         "finished_epochs": np.asarray(self.finished_epochs),
-                         "rng": np.asarray(self._rng)}}
+                "opt_state": self.opt_state, "meta": meta}
 
     def _save_checkpoint(self):
         with timeit("estimator/checkpoint_save"):
@@ -1190,13 +1549,36 @@ class Estimator:
             self.opt_state = jax.device_put(tree["opt_state"], rep)
         self.global_step = int(tree["meta"]["global_step"])
         self.finished_epochs = int(tree["meta"]["finished_epochs"])
-        if "rng" in tree["meta"]:   # resume the dropout/shuffle rng stream
-            self._rng = jnp.asarray(tree["meta"]["rng"])
+        meta = tree["meta"]
+        if "rng" in meta:   # resume the dropout/shuffle rng stream
+            self._rng = jnp.asarray(meta["rng"])
         else:
             # pre-rng-meta checkpoint: the live key may be a donated
             # (deleted) buffer after a failed step — re-seed so retry works
             self._rng = jax.random.fold_in(
                 jax.random.PRNGKey(self.ctx.config.seed), step)
+        if "lr_scale" in meta:
+            self._lr_scale = float(meta["lr_scale"])
+        if "host_rng" in meta and np.asarray(meta["host_rng"]).size:
+            st = pickle.loads(np.asarray(meta["host_rng"]).tobytes())
+            self._host_rng = np.random.RandomState()
+            self._host_rng.set_state(st)
+        # Resume manifest.  Armed whenever an epoch-start rng state was
+        # recorded, even at in_epoch_step == 0: a preemption flush on the
+        # FIRST iteration of an epoch happens after that epoch's shuffle
+        # permutation was already drawn, so the restart must rewind the
+        # host rng to the epoch start or it redraws a different perm.
+        # (For ordinary boundary snapshots epoch_rng equals host_rng and
+        # the rewind is a no-op.)
+        self._pending_resume = None
+        r_step = int(meta["in_epoch_step"]) if "in_epoch_step" in meta else 0
+        rng_state = None
+        if "epoch_rng" in meta and np.asarray(meta["epoch_rng"]).size:
+            rng_state = pickle.loads(
+                np.asarray(meta["epoch_rng"]).tobytes())
+        if r_step > 0 or rng_state is not None:
+            r_epoch = int(meta.get("resume_epoch", self.finished_epochs))
+            self._pending_resume = (r_epoch, r_step, rng_state)
         logger.info("restored checkpoint step %d", step)
 
     def load_checkpoint(self, directory: str):

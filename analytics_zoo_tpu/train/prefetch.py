@@ -19,11 +19,15 @@ bottleneck, which is exactly when the DEVICE cache level
 
 from __future__ import annotations
 
+import logging
 import queue
 import threading
 from typing import Any, Callable, Iterable, Iterator, Optional
 
 from analytics_zoo_tpu.core.profiling import timeit
+from analytics_zoo_tpu.robust import faults
+
+logger = logging.getLogger("analytics_zoo_tpu.train")
 
 _SENTINEL = object()
 
@@ -41,6 +45,8 @@ class PrefetchIterator:
         self._q: "queue.Queue" = queue.Queue(maxsize=max(1, depth))
         self._err: Optional[BaseException] = None
         self._stop = threading.Event()
+        self._closed = False
+        self._close_lock = threading.Lock()
 
         def put_retry(obj) -> bool:
             """Deliver unless the consumer called close(); never drop."""
@@ -55,6 +61,9 @@ class PrefetchIterator:
         def run():
             try:
                 for item in it:
+                    # chaos hook: a planned producer crash surfaces here
+                    # exactly like a real data-pipeline failure would
+                    faults.inject("prefetch.producer")
                     if transform is not None:
                         item = transform(item)
                     if not put_retry(item):
@@ -104,15 +113,43 @@ class PrefetchIterator:
                             raise self._err
                         raise StopIteration from None
 
-    def close(self) -> None:
-        """Stop the producer (used on early exit / exception paths)."""
+    def close(self, timeout: float = 5.0) -> None:
+        """Stop the producer (used on early exit / exception paths).
+
+        Idempotent.  Drains the queue so a producer blocked in
+        ``put_retry`` can observe the stop flag, then joins it with a
+        bounded ``timeout``: a producer wedged inside the source
+        iterator or transform (which Python threads cannot interrupt)
+        is surfaced as a logged warning instead of silently leaking —
+        the daemon flag still guarantees it cannot block interpreter
+        exit."""
+        with self._close_lock:
+            if self._closed:
+                return
+            self._closed = True
         self._stop.set()
-        # drain so the producer can observe the stop flag
-        try:
-            while True:
-                self._q.get_nowait()
-        except queue.Empty:
-            pass
+        deadline = None
+        while self._thread.is_alive():
+            # keep draining: the producer may have re-filled the queue
+            # between our drain and its next put_retry attempt
+            try:
+                while True:
+                    self._q.get_nowait()
+            except queue.Empty:
+                pass
+            self._thread.join(timeout=0.05)
+            if not self._thread.is_alive():
+                break
+            import time as _time
+            if deadline is None:
+                deadline = _time.monotonic() + timeout
+            elif _time.monotonic() > deadline:
+                logger.warning(
+                    "prefetch producer did not stop within %.1fs of "
+                    "close(); it is wedged in the source iterator or "
+                    "transform and will be abandoned (daemon thread)",
+                    timeout)
+                break
 
 
 def prefetch(it: Iterable, transform: Optional[Callable] = None,

@@ -763,6 +763,72 @@ def bench_data_paths(n_rows=1 << 20, batch=8192, epochs=3, k_steps=32):
     return out
 
 
+def bench_checkpoint_overhead(n=1 << 15, batch=4096, epochs=4,
+                              k_steps=8):
+    """Cost of the durability layer (docs/ROBUSTNESS.md): the SAME
+    NCF-shaped ``Estimator.fit`` run three ways — no checkpointing,
+    async per-epoch snapshots (the default: CRC32-manifested atomic
+    writes land on a background thread), and fully synchronous saves —
+    plus the raw latency of one verified save and one verified
+    restore.  The async column is the claim under test: durability at
+    per-epoch granularity should cost ~nothing on the step path."""
+    import shutil
+    import tempfile
+
+    from analytics_zoo_tpu import init_zoo_context
+    from analytics_zoo_tpu.models import NeuralCF
+    from analytics_zoo_tpu.nn import reset_name_scope
+    from analytics_zoo_tpu.train import checkpoint as ckpt_lib
+    from analytics_zoo_tpu.train.optimizers import Adam
+
+    rs = np.random.RandomState(0)
+    n = max(batch, (n // batch) * batch)
+    out = {}
+    est = None
+    for leg, async_ckpt in (("no_ckpt", None), ("async", True),
+                            ("sync", False)):
+        init_zoo_context(steps_per_execution=k_steps, seed=0,
+                         async_checkpoint=bool(async_ckpt))
+        reset_name_scope()
+        model = NeuralCF(user_count=6040, item_count=3706, class_num=2,
+                         user_embed=16, item_embed=16, mf_embed=16,
+                         hidden_layers=(64, 32, 16))
+        xs = [rs.randint(1, 6041, (n, 1)).astype(np.int32),
+              rs.randint(1, 3707, (n, 1)).astype(np.int32)]
+        y = rs.randint(0, 2, n).astype(np.int32)
+        model.compile(optimizer=Adam(lr=1e-3),
+                      loss="sparse_categorical_crossentropy")
+        est = model.estimator
+        tmp = tempfile.mkdtemp(prefix="bench_ckpt_")
+        try:
+            if async_ckpt is not None:
+                est.set_checkpoint(tmp)
+            est.fit(xs, y, batch_size=batch, epochs=epochs,
+                    verbose=False)
+            tputs = [r["throughput"] for r in est.history[1:]]
+            out[f"{leg}_samples_per_sec"] = round(
+                float(np.median(tputs)) if tputs else 0.0, 1)
+            if async_ckpt is not None and leg == "sync":
+                # raw verified save/restore latency on the live snapshot
+                mgr = ckpt_lib.CheckpointManager(tmp)
+                t0 = time.perf_counter()
+                mgr.save(est.global_step + 1, est._snapshot())
+                out["save_verified_ms"] = round(
+                    (time.perf_counter() - t0) * 1e3, 1)
+                t0 = time.perf_counter()
+                mgr.restore()
+                out["restore_verified_ms"] = round(
+                    (time.perf_counter() - t0) * 1e3, 1)
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+    base = out.get("no_ckpt_samples_per_sec") or 0
+    for leg in ("async", "sync"):
+        tput = out.get(f"{leg}_samples_per_sec")
+        if base and tput:
+            out[f"{leg}_overhead_pct"] = round(100 * (1 - tput / base), 1)
+    return out
+
+
 def bench_nnframes(n=120_000, epochs=2, batch=8192):
     """NNFrames end-to-end rows/sec (BASELINE config #3): DataFrame →
     NNEstimator.fit → NNModel.transform, including the pandas column
@@ -1368,6 +1434,19 @@ def main():
     else:
         extra["data_paths_skipped"] = "time budget"
     _mark("data_paths", t0)
+
+    # durability layer cost (ISSUE 3): verified-checkpoint overhead on
+    # the training path — async should be ~free, sync bounds the worst
+    # case (the preemption-flush latency)
+    t0 = time.time()
+    if _remaining() > 120:
+        try:
+            extra["checkpoint_overhead"] = bench_checkpoint_overhead()
+        except Exception as e:
+            extra["checkpoint_overhead_error"] = f"{type(e).__name__}: {e}"
+    else:
+        extra["checkpoint_overhead_skipped"] = "time budget"
+    _mark("checkpoint_overhead", t0)
 
     # north-star evidence in ONE run: matched-accuracy convergence with
     # device-resident data + the CPU leg of the SAME code path — the

@@ -24,6 +24,30 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_makereport(item, call):
+    """Attach the robustness event counters (``robust/*`` — NaN guard
+    trips, checkpoint quarantines, retries, preempt flushes) to every
+    FAILED test report: when a tier-1 run goes red the fault-layer
+    activity around the failure is in the log, not lost."""
+    outcome = yield
+    report = outcome.get_result()
+    if report.when != "call" or not report.failed:
+        return
+    try:
+        from analytics_zoo_tpu.core.profiling import TIMERS
+
+        counters = {k: v for k, v in TIMERS.counts().items()
+                    if k.startswith("robust/")}
+        if counters:
+            report.sections.append(
+                ("robustness counters",
+                 "\n".join(f"{k} = {v}"
+                           for k, v in sorted(counters.items()))))
+    except Exception:
+        pass    # reporting must never mask the real failure
+
+
 @pytest.fixture(scope="session")
 def zoo_ctx():
     from analytics_zoo_tpu import init_zoo_context

@@ -15,9 +15,16 @@ class ResponseError(Exception):
     pass
 
 
+# the real package re-exports these at module level (redis.ConnectionError
+# subclasses the builtin); RedisQueue's retry policy keys off them
+ConnectionError = ConnectionError
+TimeoutError = TimeoutError
+
+
 class exceptions:  # mirror redis.exceptions namespace
     ResponseError = ResponseError
     ConnectionError = ConnectionError
+    TimeoutError = TimeoutError
 
 
 class _Server:
@@ -153,3 +160,6 @@ class Redis:
 
     def info(self):
         return {"used_memory": 0, "maxmemory": 1 << 30}
+
+    def ping(self):
+        return True

@@ -1,0 +1,570 @@
+"""Chaos suite for the fault-tolerance stack (docs/ROBUSTNESS.md).
+
+Covers the acceptance scenarios end to end with deterministic fault
+injection (robust/faults.py): torn checkpoints fall back to the newest
+intact snapshot, ``fit(resume=True)`` after a preemption reproduces the
+uninterrupted run bit-exactly, NaN steps are skipped/rolled back per
+policy with counters, a crashed prefetch producer is survived via
+retry-from-checkpoint, and every serving-queue backend honours the same
+TimeoutError/health contract.  The fast scenarios run unmarked; the
+repeated-preemption soak is marked ``slow``.
+"""
+
+import os
+import signal
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def fresh_names():
+    from analytics_zoo_tpu.nn import reset_name_scope
+
+    reset_name_scope()
+
+
+@pytest.fixture(autouse=True)
+def default_ctx():
+    """Robustness knobs are per-test; restore defaults afterwards."""
+    yield
+    from analytics_zoo_tpu import init_zoo_context
+
+    init_zoo_context()
+
+
+def _counters():
+    from analytics_zoo_tpu.core.profiling import TIMERS
+
+    return TIMERS
+
+
+def _build_model():
+    from analytics_zoo_tpu.nn import Sequential, reset_name_scope
+    from analytics_zoo_tpu.nn.layers.core import Dense
+
+    reset_name_scope()
+    return Sequential([Dense(8, input_shape=(4,), activation="relu"),
+                       Dense(1)])
+
+
+def _toy_data(n=64, d=4, seed=0):
+    rs = np.random.RandomState(seed)
+    return (rs.randn(n, d).astype(np.float32),
+            rs.randn(n, 1).astype(np.float32))
+
+
+def _estimator(**cfg):
+    from analytics_zoo_tpu import init_zoo_context
+    from analytics_zoo_tpu.train.estimator import Estimator
+
+    init_zoo_context(**cfg)
+    return Estimator(_build_model(), optimizer="sgd", loss="mse")
+
+
+def _leaves(tree):
+    import jax
+
+    return jax.tree_util.tree_leaves(jax.device_get(tree))
+
+
+# ---------------------------------------------------------------------------
+# RetryPolicy
+# ---------------------------------------------------------------------------
+
+class TestRetryPolicy:
+    def test_call_retries_then_succeeds(self):
+        from analytics_zoo_tpu.robust import RetryPolicy
+
+        sleeps = []
+        p = RetryPolicy(max_attempts=5, base_delay_s=0.1, jitter=0.0,
+                        retry_on=(OSError,), sleep=sleeps.append, seed=0)
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise OSError("blip")
+            return "ok"
+
+        assert p.call(flaky) == "ok"
+        assert calls["n"] == 3
+        # exponential: 0.1 then 0.2
+        assert sleeps == pytest.approx([0.1, 0.2])
+
+    def test_call_exhausts_attempts(self):
+        from analytics_zoo_tpu.robust import RetryPolicy
+
+        p = RetryPolicy(max_attempts=3, base_delay_s=0.0, jitter=0.0,
+                        retry_on=(ValueError,), sleep=lambda s: None)
+        n0 = _counters().count("robust/retry_exhausted/retry")
+        with pytest.raises(ValueError):
+            p.call(lambda: (_ for _ in ()).throw(ValueError("always")))
+        assert _counters().count("robust/retry_exhausted/retry") == n0 + 1
+
+    def test_delay_caps_at_max(self):
+        from analytics_zoo_tpu.robust import RetryPolicy
+
+        p = RetryPolicy(base_delay_s=1.0, max_delay_s=4.0, multiplier=2.0,
+                        jitter=0.0)
+        assert p.delay(10) == 4.0
+
+    def test_deadline_expiry(self):
+        from analytics_zoo_tpu.robust import (RetryDeadlineExceeded,
+                                              RetryPolicy)
+
+        t = {"now": 0.0}
+        p = RetryPolicy(max_attempts=100, base_delay_s=1.0, jitter=0.0,
+                        deadline_s=2.5, retry_on=(OSError,),
+                        sleep=lambda s: t.__setitem__("now", t["now"] + s),
+                        clock=lambda: t["now"], name="dl_test")
+
+        def fail():
+            raise OSError("down")
+
+        n0 = _counters().count("robust/retry_deadline/dl_test")
+        with pytest.raises(RetryDeadlineExceeded):
+            p.call(fail)
+        assert _counters().count("robust/retry_deadline/dl_test") == n0 + 1
+
+    def test_state_window_ages_out_failures(self):
+        from analytics_zoo_tpu.robust import RetryPolicy
+
+        t = {"now": 0.0}
+        st = RetryPolicy(max_attempts=2, window_s=10.0,
+                         sleep=lambda s: None,
+                         clock=lambda: t["now"]).state()
+        assert st.record_failure()          # 1 in window
+        assert st.record_failure()          # 2 in window
+        assert not st.record_failure()      # 3 > max_attempts
+        t["now"] += 100.0                   # everything ages out
+        assert st.record_failure()
+        assert st.failures == 1
+
+
+# ---------------------------------------------------------------------------
+# FaultInjector
+# ---------------------------------------------------------------------------
+
+class TestFaultInjector:
+    def test_fires_at_exact_index(self):
+        from analytics_zoo_tpu.robust import FaultInjector, faults
+
+        fi = FaultInjector().plan("site.x", at=2, exc=RuntimeError("boom"))
+        with fi:
+            faults.inject("site.x")
+            faults.inject("site.x")
+            with pytest.raises(RuntimeError, match="boom"):
+                faults.inject("site.x")
+        assert fi.fired["site.x"] == 1
+        assert fi.calls("site.x") == 3
+
+    def test_inactive_is_noop(self):
+        from analytics_zoo_tpu.robust import faults
+
+        assert faults.fire("site.unused") is None
+
+    def test_nested_injectors_rejected(self):
+        from analytics_zoo_tpu.robust import FaultInjector
+
+        with FaultInjector():
+            with pytest.raises(RuntimeError):
+                FaultInjector().__enter__()
+
+
+# ---------------------------------------------------------------------------
+# checkpoint durability (acceptance scenario a)
+# ---------------------------------------------------------------------------
+
+class TestCheckpointDurability:
+    def _tree(self, v):
+        return {"params": {"w": np.full((4, 4), float(v), np.float32)},
+                "meta": {"global_step": np.asarray(v)}}
+
+    def test_torn_write_falls_back_to_intact(self, tmp_path):
+        from analytics_zoo_tpu.robust import FaultInjector
+        from analytics_zoo_tpu.train.checkpoint import CheckpointManager
+
+        mgr = CheckpointManager(str(tmp_path), keep=5)
+        mgr.save(1, self._tree(1))
+        mgr.save(2, self._tree(2))
+        with FaultInjector().plan("checkpoint.write", at=0, action="torn"):
+            mgr.save(3, self._tree(3))
+        n0 = _counters().count("robust/ckpt_quarantined")
+        step, tree = mgr.restore()
+        assert step == 2
+        assert float(tree["params"]["w"][0, 0]) == 2.0
+        assert _counters().count("robust/ckpt_quarantined") == n0 + 1
+        # the torn file is quarantined, not deleted (post-mortem evidence)
+        assert any(f.endswith(".corrupt") for f in os.listdir(tmp_path))
+        # a fresh manager no longer sees step 3 at all
+        assert CheckpointManager(str(tmp_path)).latest_step() == 2
+
+    def test_explicit_step_load_of_corrupt_raises(self, tmp_path):
+        from analytics_zoo_tpu.robust import FaultInjector
+        from analytics_zoo_tpu.train.checkpoint import (
+            CheckpointCorruptError, CheckpointManager)
+
+        mgr = CheckpointManager(str(tmp_path))
+        with FaultInjector().plan("checkpoint.write", at=0, action="torn"):
+            mgr.save(7, self._tree(7))
+        with pytest.raises((CheckpointCorruptError, FileNotFoundError,
+                            Exception)):
+            mgr.restore(step=7)
+
+    def test_bitflip_detected_by_crc(self, tmp_path):
+        from analytics_zoo_tpu.train.checkpoint import (CheckpointManager,
+                                                        save_pytree)
+
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.save(1, self._tree(1))
+        path = mgr.save(2, self._tree(2))
+        # flip bytes in the middle of the archive (payload, not header)
+        blob = bytearray(open(path, "rb").read())
+        mid = len(blob) // 2
+        blob[mid] ^= 0xFF
+        blob[mid + 1] ^= 0xFF
+        with open(path, "wb") as f:
+            f.write(bytes(blob))
+        step, _ = mgr.restore()
+        assert step == 1
+
+    def test_no_intact_checkpoint_is_explicit_error(self, tmp_path):
+        from analytics_zoo_tpu.robust import FaultInjector
+        from analytics_zoo_tpu.train.checkpoint import CheckpointManager
+
+        mgr = CheckpointManager(str(tmp_path))
+        with FaultInjector().plan("checkpoint.write", at=0, action="torn"):
+            mgr.save(1, self._tree(1))
+        with pytest.raises(FileNotFoundError, match="no intact"):
+            mgr.restore()
+
+    def test_legacy_unmanifested_npz_still_loads(self, tmp_path):
+        """Snapshots written before the CRC manifest existed (format v1:
+        leaves + pickled treedef, no ``__manifest__``) must stay
+        restorable — unverified, with a debug log."""
+        import pickle
+
+        import jax
+
+        from analytics_zoo_tpu.train.checkpoint import load_pytree
+
+        tree = {"w": np.arange(4.0)}
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        legacy = tmp_path / "old.npz"
+        np.savez(legacy, **{"000000|w": leaves[0],
+                            "__treedef__": np.frombuffer(
+                                pickle.dumps(treedef), np.uint8)})
+        out = load_pytree(str(legacy))
+        assert np.array_equal(out["w"], np.arange(4.0))
+
+    def test_gc_keep_with_async_writes(self, tmp_path):
+        """Satellite (a): GC under the fs lock while async writes land."""
+        from analytics_zoo_tpu.train.checkpoint import CheckpointManager
+
+        mgr = CheckpointManager(str(tmp_path), keep=2)
+        for s in range(1, 7):
+            mgr.save_async(s, self._tree(s))
+        mgr.wait()
+        assert mgr.all_steps() == [5, 6]
+        step, _ = mgr.restore()
+        assert step == 6
+
+
+# ---------------------------------------------------------------------------
+# exact resume after preemption (acceptance scenario b)
+# ---------------------------------------------------------------------------
+
+class TestExactResume:
+    def test_resume_after_preemption_is_bit_exact(self, zoo_ctx, tmp_path):
+        import jax
+
+        from analytics_zoo_tpu.robust import FaultInjector, TrainingPreempted
+        from analytics_zoo_tpu.train.estimator import Estimator
+
+        x, y = _toy_data()
+        ref = Estimator(_build_model(), optimizer="sgd", loss="mse")
+        ref.fit(x, y, batch_size=8, epochs=3, verbose=False)
+
+        est = Estimator(_build_model(), optimizer="sgd", loss="mse")
+        est.set_checkpoint(str(tmp_path))
+        # preempt mid-epoch-2 (step index 9 = epoch 2, in-epoch step 2)
+        with FaultInjector().plan("estimator.preempt", at=9):
+            with pytest.raises(TrainingPreempted):
+                est.fit(x, y, batch_size=8, epochs=3, verbose=False)
+        assert _counters().count("robust/preempt_flush") >= 1
+
+        est2 = Estimator(_build_model(), optimizer="sgd", loss="mse")
+        est2.set_checkpoint(str(tmp_path))
+        est2.fit(x, y, batch_size=8, epochs=3, verbose=False, resume=True)
+        assert est2.finished_epochs == 3
+        for a, b in zip(_leaves(ref.params), _leaves(est2.params)):
+            assert np.array_equal(a, b), "resume diverged from reference"
+
+    def test_real_sigterm_flushes_and_raises(self, zoo_ctx, tmp_path):
+        """The actual signal handler: a SIGTERM mid-fit must flush a
+        final synchronous checkpoint and surface TrainingPreempted."""
+        from analytics_zoo_tpu.robust import TrainingPreempted
+        from analytics_zoo_tpu.train.estimator import Estimator
+
+        x, y = _toy_data(n=256)
+        est = Estimator(_build_model(), optimizer="sgd", loss="mse")
+        est.set_checkpoint(str(tmp_path))
+        killer = threading.Timer(0.3, os.kill, (os.getpid(), signal.SIGTERM))
+        killer.start()
+        try:
+            with pytest.raises(TrainingPreempted):
+                est.fit(x, y, batch_size=8, epochs=200, verbose=False)
+        finally:
+            killer.cancel()
+        assert est._ckpt_mgr.latest_step() is not None
+        # resume continues (shortened horizon keeps the test fast)
+        est2 = Estimator(_build_model(), optimizer="sgd", loss="mse")
+        est2.set_checkpoint(str(tmp_path))
+        est2.fit(x, y, batch_size=8, epochs=est.finished_epochs + 1,
+                 verbose=False, resume=True)
+        assert est2.finished_epochs >= est.finished_epochs
+
+    def test_resume_without_checkpoint_starts_fresh(self, zoo_ctx, tmp_path):
+        from analytics_zoo_tpu.train.estimator import Estimator
+
+        x, y = _toy_data()
+        est = Estimator(_build_model(), optimizer="sgd", loss="mse")
+        est.set_checkpoint(str(tmp_path))
+        est.fit(x, y, batch_size=8, epochs=1, verbose=False, resume=True)
+        assert est.finished_epochs == 1
+
+    @pytest.mark.slow
+    def test_repeated_preemption_soak(self, zoo_ctx, tmp_path):
+        """Soak: preempt at several points across a run; every resume must
+        land on the uninterrupted trajectory bit-exactly."""
+        import jax
+
+        from analytics_zoo_tpu.robust import FaultInjector, TrainingPreempted
+        from analytics_zoo_tpu.train.estimator import Estimator
+
+        x, y = _toy_data()
+        ref = Estimator(_build_model(), optimizer="sgd", loss="mse")
+        ref.fit(x, y, batch_size=8, epochs=5, verbose=False)
+
+        est = Estimator(_build_model(), optimizer="sgd", loss="mse")
+        est.set_checkpoint(str(tmp_path))
+        done = False
+        # injector indices are per-fit call sites; preempt the 4th step of
+        # whatever remains each round
+        for round_i in range(12):
+            try:
+                with FaultInjector().plan("estimator.preempt", at=3):
+                    est.fit(x, y, batch_size=8, epochs=5, verbose=False,
+                            resume=round_i > 0)
+                done = True
+                break
+            except TrainingPreempted:
+                continue
+        if not done:   # finish without further interruptions
+            est.fit(x, y, batch_size=8, epochs=5, verbose=False, resume=True)
+        assert est.finished_epochs == 5
+        for a, b in zip(_leaves(ref.params), _leaves(est.params)):
+            assert np.array_equal(a, b)
+
+
+# ---------------------------------------------------------------------------
+# NaN guard policies (acceptance scenario c)
+# ---------------------------------------------------------------------------
+
+class TestNaNGuard:
+    def test_happy_path_checks_once_per_epoch(self, tmp_path):
+        est = _estimator()
+        x, y = _toy_data()
+        n0 = _counters().count("robust/guard_check")
+        est.fit(x, y, batch_size=8, epochs=3, verbose=False)
+        # counter-verified: ONE guard sync per epoch, not per step
+        assert _counters().count("robust/guard_check") - n0 == 3
+
+    def test_skip_policy_discards_bad_update(self, tmp_path):
+        from analytics_zoo_tpu.robust import FaultInjector
+
+        est = _estimator(nan_policy="skip")
+        x, y = _toy_data()
+        n0 = _counters().count("robust/nan_steps")
+        s0 = _counters().count("robust/nan_skipped")
+        with FaultInjector().plan("estimator.step", at=3, action="nan"):
+            est.fit(x, y, batch_size=8, epochs=1, verbose=False)
+        assert _counters().count("robust/nan_steps") - n0 == 1
+        assert _counters().count("robust/nan_skipped") - s0 == 1
+        assert all(np.isfinite(l).all() for l in _leaves(est.params))
+        assert np.isfinite(est.history[-1]["loss"])
+
+    def test_raise_policy_surfaces(self, tmp_path):
+        from analytics_zoo_tpu.robust import FaultInjector
+
+        est = _estimator(nan_policy="raise")
+        x, y = _toy_data()
+        n0 = _counters().count("robust/nan_raised")
+        with FaultInjector().plan("estimator.step", at=2, action="nan"):
+            with pytest.raises(FloatingPointError):
+                est.fit(x, y, batch_size=8, epochs=1, verbose=False)
+        assert _counters().count("robust/nan_raised") == n0 + 1
+        # the bad update itself was still discarded on device
+        assert all(np.isfinite(l).all() for l in _leaves(est.params))
+
+    def test_rollback_restores_and_backs_off_lr(self, tmp_path):
+        from analytics_zoo_tpu.robust import FaultInjector
+
+        est = _estimator(nan_policy="rollback", max_bad_steps=2,
+                         nan_backoff_factor=0.5)
+        est.set_checkpoint(str(tmp_path))
+        x, y = _toy_data()
+        n0 = _counters().count("robust/nan_rollbacks")
+        # 3 consecutive bad steps in epoch 2 (after epoch 1's checkpoint)
+        with FaultInjector().plan("estimator.step", at=[8, 9, 10],
+                                  action="nan"):
+            est.fit(x, y, batch_size=8, epochs=2, verbose=False)
+        assert _counters().count("robust/nan_rollbacks") == n0 + 1
+        assert est._lr_scale == pytest.approx(0.5)
+        assert est.finished_epochs == 2
+        assert all(np.isfinite(l).all() for l in _leaves(est.params))
+
+    def test_device_resident_path_counts_bad_steps(self, tmp_path):
+        from analytics_zoo_tpu.data.featureset import FeatureSet
+        from analytics_zoo_tpu.robust import FaultInjector
+
+        est = _estimator(nan_policy="skip", data_cache_level="DEVICE")
+        x, y = _toy_data()
+        fs = FeatureSet.from_ndarrays(x, y).cache("DEVICE")
+        n0 = _counters().count("robust/nan_steps")
+        with FaultInjector().plan("estimator.resident_nan_rows", at=0,
+                                  action="nan", payload=list(range(8))):
+            est.fit(fs, batch_size=8, epochs=2, shuffle=False, verbose=False)
+        assert est.last_data_path == "device_resident"
+        assert _counters().count("robust/nan_steps") - n0 >= 1
+        assert all(np.isfinite(l).all() for l in _leaves(est.params))
+
+
+# ---------------------------------------------------------------------------
+# prefetch producer crash (satellite b + chaos coverage)
+# ---------------------------------------------------------------------------
+
+class TestPrefetchRobustness:
+    def test_producer_crash_mid_epoch_recovers(self, tmp_path):
+        from analytics_zoo_tpu.robust import FaultInjector
+
+        est = _estimator(failure_retry_times=3, retry_base_delay_s=0.01)
+        est.set_checkpoint(str(tmp_path))
+        x, y = _toy_data()
+        n0 = _counters().count("robust/retry_attempts/estimator_fit")
+        # crash the producer thread mid-epoch-2 (item index 11); epoch 1's
+        # checkpoint makes the failure retryable
+        with FaultInjector().plan("prefetch.producer", at=11,
+                                  exc=RuntimeError("disk died")) as fi:
+            est.fit(x, y, batch_size=8, epochs=2, verbose=False)
+        assert fi.fired["prefetch.producer"] == 1
+        assert est.finished_epochs == 2
+        assert _counters().count(
+            "robust/retry_attempts/estimator_fit") == n0 + 1
+
+    def test_producer_crash_without_checkpoint_raises(self):
+        from analytics_zoo_tpu.robust import FaultInjector
+
+        est = _estimator()
+        x, y = _toy_data()
+        with FaultInjector().plan("prefetch.producer", at=2,
+                                  exc=RuntimeError("disk died")):
+            with pytest.raises(RuntimeError, match="disk died"):
+                est.fit(x, y, batch_size=8, epochs=1, verbose=False)
+
+    def test_close_is_idempotent(self):
+        from analytics_zoo_tpu.train.prefetch import PrefetchIterator
+
+        it = PrefetchIterator(iter(range(100)), depth=2)
+        assert next(it) == 0
+        it.close()
+        it.close()   # second close is a no-op, not an error
+
+    def test_stuck_producer_is_abandoned_with_warning(self, caplog):
+        from analytics_zoo_tpu.train.prefetch import PrefetchIterator
+
+        release = threading.Event()
+
+        def slow_items():
+            yield 1
+            release.wait(10.0)   # wedged "source iterator"
+            yield 2
+
+        it = PrefetchIterator(slow_items(), depth=1)
+        assert next(it) == 1
+        with caplog.at_level("WARNING", logger="analytics_zoo_tpu.train"):
+            it.close(timeout=0.2)
+        assert any("did not stop" in r.message for r in caplog.records)
+        release.set()   # let the daemon thread finish
+
+
+# ---------------------------------------------------------------------------
+# serving queues: one contract across backends (satellite c)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def queue_backends(tmp_path, monkeypatch):
+    from tests import fake_redis as fr
+
+    fr._Server.reset()
+    monkeypatch.setitem(sys.modules, "redis", fr)
+    from analytics_zoo_tpu.deploy.serving import (FileQueue, MemoryQueue,
+                                                  RedisQueue)
+
+    yield [MemoryQueue(), FileQueue(str(tmp_path)),
+           RedisQueue(name="robustness_stream")]
+    fr._Server.reset()
+
+
+class TestQueueContract:
+    def test_get_result_timeout_is_uniform(self, queue_backends):
+        for q in queue_backends:
+            with pytest.raises(TimeoutError) as ei:
+                q.get_result("missing-rid", timeout=0.05)
+            msg = str(ei.value)
+            assert type(q).__name__ in msg and "missing-rid" in msg, msg
+
+    def test_health_probe_ok(self, queue_backends):
+        for q in queue_backends:
+            h = q.health()
+            assert h["ok"] is True
+            assert h["backend"] in ("memory", "file", "redis")
+
+    def test_file_health_reports_missing_root(self, tmp_path):
+        import shutil
+
+        from analytics_zoo_tpu.deploy.serving import FileQueue
+
+        q = FileQueue(str(tmp_path))
+        shutil.rmtree(q.root)
+        h = q.health()
+        assert h["ok"] is False and "error" in h
+
+    def test_transient_io_fault_is_retried(self, tmp_path):
+        from analytics_zoo_tpu.deploy.serving import FileQueue
+        from analytics_zoo_tpu.robust import FaultInjector
+
+        q = FileQueue(str(tmp_path))
+        with FaultInjector().plan("queue.io", at=0,
+                                  exc=OSError("transient")) as fi:
+            rid = q.push({"uri": "r1", "v": 1})
+        assert fi.fired["queue.io"] == 1
+        assert len(q) == 1 and rid == "r1"
+
+    def test_persistent_io_fault_exhausts_retry(self, tmp_path):
+        from analytics_zoo_tpu.deploy.serving import FileQueue
+        from analytics_zoo_tpu.robust import FaultInjector, RetryPolicy
+
+        q = FileQueue(str(tmp_path),
+                      retry=RetryPolicy(max_attempts=3, base_delay_s=0.0,
+                                        jitter=0.0, retry_on=(OSError,),
+                                        name="fq_test",
+                                        sleep=lambda s: None))
+        with FaultInjector().plan("queue.io", at=[0, 1, 2],
+                                  exc=OSError("dead disk")):
+            with pytest.raises(OSError, match="dead disk"):
+                q.push({"uri": "r1"})
