@@ -68,6 +68,24 @@ class ZooConfig:
     # params/activations on every shipping TPU generation).
     data_device_budget_bytes: int = 4 << 30
 
+    # --- serving ---------------------------------------------------------
+    # Pipelined serving engine (docs/SERVING.md).  The DynamicBatcher
+    # dispatches a shape bucket on whichever comes first: batch-full
+    # (serving_batch_size rows) or the serving_max_batch_delay_ms
+    # deadline — the continuous-batching tradeoff between latency under
+    # trickle load and MXU utilization under saturation.
+    serving_batch_size: int = 32
+    serving_max_batch_delay_ms: float = 5.0
+    # Decode-pool threads: base64/JSON decode + host preprocess run off
+    # the device hot path, concurrently with device compute.
+    serving_decode_workers: int = 4
+    # Model replicas round-robined by the device executor (one full copy
+    # per mesh device along the data axis; 1 = single-chip serving).
+    serving_replicas: int = 1
+    # Batches in flight per executor (2 = double buffering: batch N+1 is
+    # enqueued while N computes; also the backpressure bound).
+    serving_max_inflight: int = 2
+
     # --- robustness ------------------------------------------------------
     # What a non-finite training loss does (docs/ROBUSTNESS.md):
     #   "skip"     — the jitted step discards the bad update on device

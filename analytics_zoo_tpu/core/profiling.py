@@ -25,15 +25,39 @@ from typing import Dict, Iterator, Optional
 logger = logging.getLogger("analytics_zoo_tpu.profiling")
 
 
+# per-stat reservoir of recent durations for percentile rollups; 512
+# samples bound memory while keeping p99 meaningful over the last ~minutes
+# of a serving stage (the serving pipeline reads p50/p99 per stage)
+_MAX_SAMPLES = 512
+
+
 @dataclass
 class _Stat:
     count: int = 0
     total_s: float = 0.0
     max_s: float = 0.0
+    samples: list = field(default_factory=list)  # ring of recent durations
 
     @property
     def mean_s(self) -> float:
         return self.total_s / self.count if self.count else 0.0
+
+    def add(self, dt: float) -> None:
+        self.count += 1
+        self.total_s += dt
+        self.max_s = max(self.max_s, dt)
+        if len(self.samples) < _MAX_SAMPLES:
+            self.samples.append(dt)
+        else:
+            self.samples[self.count % _MAX_SAMPLES] = dt
+
+    def percentile(self, q: float) -> float:
+        """q-th percentile (0-100) over the recent-sample ring."""
+        if not self.samples:
+            return 0.0
+        s = sorted(self.samples)
+        i = min(len(s) - 1, max(0, int(round(q / 100.0 * (len(s) - 1)))))
+        return s[i]
 
 
 class Timers:
@@ -57,13 +81,22 @@ class Timers:
             yield
         finally:
             dt = time.perf_counter() - t0
-            with self._lock:
-                s = self._stats.setdefault(name, _Stat())
-                s.count += 1
-                s.total_s += dt
-                s.max_s = max(s.max_s, dt)
+            self.observe(name, dt)
             if log:
                 logger.info("[timeit] %s: %.3fms", name, dt * 1e3)
+
+    def observe(self, name: str, seconds: float) -> None:
+        """Record one duration measured externally (a cross-thread span —
+        e.g. request enqueue → response written — that no single
+        ``scope`` block can bracket)."""
+        with self._lock:
+            self._stats.setdefault(name, _Stat()).add(seconds)
+
+    def percentile(self, name: str, q: float) -> float:
+        """q-th percentile (0-100) of the named timer's recent samples."""
+        with self._lock:
+            s = self._stats.get(name)
+            return s.percentile(q) if s else 0.0
 
     def incr(self, name: str, n: int = 1) -> None:
         """Bump the named event counter by ``n``."""
@@ -82,7 +115,8 @@ class Timers:
     def stats(self) -> Dict[str, Dict[str, float]]:
         with self._lock:
             return {k: {"count": v.count, "total_s": v.total_s,
-                        "mean_s": v.mean_s, "max_s": v.max_s}
+                        "mean_s": v.mean_s, "max_s": v.max_s,
+                        "p50_s": v.percentile(50), "p99_s": v.percentile(99)}
                     for k, v in self._stats.items()}
 
     def reset(self) -> None:
@@ -91,11 +125,12 @@ class Timers:
             self._counts.clear()
 
     def report(self) -> str:
-        lines = ["name count total_s mean_ms max_ms"]
+        lines = ["name count total_s mean_ms p50_ms p99_ms max_ms"]
         for k, v in sorted(self.stats().items(),
                            key=lambda kv: -kv[1]["total_s"]):
             lines.append(f"{k} {v['count']} {v['total_s']:.3f} "
-                         f"{v['mean_s'] * 1e3:.2f} {v['max_s'] * 1e3:.2f}")
+                         f"{v['mean_s'] * 1e3:.2f} {v['p50_s'] * 1e3:.2f} "
+                         f"{v['p99_s'] * 1e3:.2f} {v['max_s'] * 1e3:.2f}")
         counts = self.counts()
         if counts:
             lines.append("-- counters --")

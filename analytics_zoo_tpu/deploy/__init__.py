@@ -7,9 +7,10 @@ Redis-stream streaming inference), plus the Python client
 """
 
 from analytics_zoo_tpu.deploy.inference import (  # noqa: F401
-    DynamicBatcher, InferenceModel, dequantize_pytree, imagenet_preprocess,
-    quantize_pytree)
+    BatchRequest, DynamicBatcher, InferenceModel, ModelReplica,
+    dequantize_pytree, imagenet_preprocess, quantize_pytree,
+    scatter_batch_results)
 from analytics_zoo_tpu.deploy.serving import (  # noqa: F401
-    ClusterServing, FileQueue, InputQueue, MemoryQueue, OutputQueue,
-    RedisQueue, ServingConfig, decode_image, decode_tensor, encode_image,
-    encode_tensor, make_queue)
+    ClusterServing, DeviceExecutor, FileQueue, InputQueue, MemoryQueue,
+    OutputQueue, RedisQueue, ServingConfig, decode_image, decode_tensor,
+    encode_image, encode_tensor, make_queue)

@@ -33,7 +33,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["InferenceModel", "DynamicBatcher", "quantize_pytree",
+__all__ = ["InferenceModel", "DynamicBatcher", "BatchRequest",
+           "ModelReplica", "scatter_batch_results", "quantize_pytree",
            "dequantize_pytree"]
 
 
@@ -58,6 +59,29 @@ def _next_bucket(n: int, buckets: Sequence[int]) -> int:
         if n <= b:
             return b
     return buckets[-1]
+
+
+def _match_compute_dtype(p, s, xs):
+    """A preprocess emitting bf16 (e.g. imagenet_preprocess's uint8→bf16
+    wire path) selects bf16 INFERENCE: float params AND state (BN stats)
+    cast to the input dtype in-program (XLA folds the casts), outputs
+    return as float32 for the client."""
+    from analytics_zoo_tpu.train.estimator import _cast_floats
+
+    floats = [x.dtype for x in xs
+              if jnp.issubdtype(x.dtype, jnp.floating)]
+    cd = jnp.result_type(*floats) if floats else jnp.float32
+    if cd != jnp.float32:
+        p = _cast_floats(p, cd)
+        s = _cast_floats(s, cd)
+    return p, s
+
+
+def _f32_out(out):
+    cast = (lambda o: o.astype(jnp.float32)
+            if jnp.issubdtype(o.dtype, jnp.floating) else o)
+    return ([cast(o) for o in out]
+            if isinstance(out, (list, tuple)) else cast(out))
 
 
 # ---------------------------------------------------------------------------
@@ -113,6 +137,23 @@ def dequantize_pytree(qparams):
 # InferenceModel
 # ---------------------------------------------------------------------------
 
+class ModelReplica:
+    """One serving replica: ``dispatch(xs)`` enqueues the computation and
+    returns a handle immediately (device futures for native models);
+    ``harvest(handle)`` performs the blocking readback and returns a list
+    of np output arrays.  The split is what lets the device executor
+    double-buffer: dispatch batch N+1 while N's readback is in flight."""
+
+    def __init__(self, dispatch: Callable, harvest: Callable, device=None,
+                 on_device_topn: bool = False, pads_input: bool = True):
+        self.dispatch = dispatch
+        self.harvest = harvest
+        self.device = device
+        self.on_device_topn = on_device_topn
+        # False = dispatch() already handles buckets/slicing (the shared
+        # predict() fallback); True = the executor pads to a bucket
+        self.pads_input = pads_input
+
 class InferenceModel:
     """Thread-safe model for serving.
 
@@ -133,6 +174,39 @@ class InferenceModel:
         self._forward = forward
         self.batch_buckets = tuple(sorted(batch_buckets))
         self.dtype = dtype
+        # program-shape ledger: one entry per distinct batch signature
+        # actually dispatched — i.e. per compiled program.  Tests assert
+        # on it to prove the bounded-program contract (novel large
+        # batches split into full-bucket programs instead of compiling
+        # one-off shapes).
+        self._seen_shapes = set()
+        self._shape_lock = threading.Lock()
+        self._net = None
+
+    # expose the bucket lowering on the class (callers/tests reach it as
+    # InferenceModel._next_bucket)
+    _next_bucket = staticmethod(_next_bucket)
+
+    def _note_shapes(self, xs, tag: str = "") -> bool:
+        """Record the batch signature about to be dispatched; True (and a
+        ``inference/novel_batch_shape`` counter bump) on first sight —
+        i.e. when this dispatch pays an XLA compile."""
+        sig = (tag,) + tuple((tuple(np.shape(x)),
+                              str(getattr(x, "dtype", ""))) for x in xs)
+        with self._shape_lock:
+            if sig in self._seen_shapes:
+                return False
+            self._seen_shapes.add(sig)
+        from analytics_zoo_tpu.core.profiling import count_event
+
+        count_event("inference/novel_batch_shape")
+        return True
+
+    @property
+    def compile_count(self) -> int:
+        """Number of distinct program shapes dispatched so far."""
+        with self._shape_lock:
+            return len(self._seen_shapes)
 
     # -- loaders -----------------------------------------------------------
     @classmethod
@@ -162,31 +236,9 @@ class InferenceModel:
         normalized on-chip — so the host→device link carries 4x fewer
         bytes than float32 (see ``deploy.imagenet_preprocess``)."""
         state = state or {}
-
-        def _match_compute_dtype(p, s, xs):
-            """A preprocess emitting bf16 (e.g. imagenet_preprocess's
-            uint8→bf16 wire path) selects bf16 INFERENCE: float params
-            AND state (BN stats) cast to the input dtype in-program (XLA
-            folds the casts), outputs return as float32 for the client."""
-            from analytics_zoo_tpu.train.estimator import _cast_floats
-
-            floats = [x.dtype for x in xs
-                      if jnp.issubdtype(x.dtype, jnp.floating)]
-            cd = jnp.result_type(*floats) if floats else jnp.float32
-            if cd != jnp.float32:
-                p = _cast_floats(p, cd)
-                s = _cast_floats(s, cd)
-            return p, s
-
-        def _f32_out(out):
-            cast = (lambda o: o.astype(jnp.float32)
-                    if jnp.issubdtype(o.dtype, jnp.floating) else o)
-            return ([cast(o) for o in out]
-                    if isinstance(out, (list, tuple)) else cast(out))
+        qparams = quantize_pytree(params) if int8 else None
 
         if int8:
-            qparams = quantize_pytree(params)
-
             @jax.jit
             def fwd(*xs):
                 if preprocess is not None:
@@ -209,7 +261,93 @@ class InferenceModel:
 
         m = cls(forward, **kw)
         m._net, m._params, m._int8 = net, params, int8
+        m._state, m._preprocess, m._qparams = state, preprocess, qparams
         return m
+
+    # -- replicas ----------------------------------------------------------
+    def _build_param_forward(self, top_n: Optional[int] = None):
+        """One jitted forward taking (params, state, *xs) explicitly, so
+        the same traced program runs on whichever device its arguments
+        live on — the building block for per-device serving replicas.
+        ``top_n`` fuses top-k into the program (scores never leave the
+        chip: the readback is 2*top_n scalars per row, not the logits)."""
+        net, pre, int8 = self._net, self._preprocess, self._int8
+
+        @jax.jit
+        def fwd(p, s, *xs):
+            if pre is not None:
+                xs = _as_tuple(pre(*xs))
+            if int8:
+                p = dequantize_pytree(p)
+            p2, s2 = _match_compute_dtype(p, s, xs)
+            out, _ = net.call(p2, s2, *xs, training=False)
+            out = _f32_out(out)
+            if top_n:
+                o = out[0] if isinstance(out, (list, tuple)) else out
+                v, i = jax.lax.top_k(o, top_n)
+                return i.astype(jnp.int32), v
+            return out
+
+        return fwd
+
+    def replica_forwards(self, n: int = 1, devices=None,
+                         top_n: Optional[int] = None
+                         ) -> List["ModelReplica"]:
+        """``n`` per-device serving replicas with *async* dispatch.
+
+        Models built from a native net (``from_keras_net`` / ``load``)
+        get true replicas: the weights are placed once per device and
+        each dispatch runs on its own chip, so a round-robin executor
+        keeps every chip busy.  Foreign loaders (TF/torch/ONNX/function)
+        fall back to sharing the base forward — it is thread-safe, just
+        not multi-device.
+        """
+        if devices is None:
+            from analytics_zoo_tpu.parallel.sharding import replica_devices
+
+            try:
+                from analytics_zoo_tpu.core.context import _GLOBAL_CONTEXT
+                devices = (replica_devices(_GLOBAL_CONTEXT.mesh)
+                           if _GLOBAL_CONTEXT is not None else jax.devices())
+            except Exception:
+                devices = jax.devices()
+        devices = list(devices)[:max(1, int(n))]
+        if self._net is None:
+            # shared-forward fallback: predict() handles buckets/top-N
+            model = self
+
+            def dispatch(xs, _m=model):
+                return _m.predict(xs)
+
+            def harvest(h):
+                return h if isinstance(h, list) else [h]
+
+            return [ModelReplica(dispatch, harvest, device=None,
+                                 on_device_topn=False, pads_input=False)
+                    for _ in devices]
+        fwd = self._build_param_forward(top_n=top_n)
+        weights = self._qparams if self._int8 else self._params
+        out = []
+        for dev in devices:
+            p_i = jax.device_put(weights, dev)
+            s_i = jax.device_put(self._state, dev)
+
+            def dispatch(xs, _p=p_i, _s=s_i, _d=dev):
+                # async: device_put and the jitted call both return
+                # immediately with future-backed arrays — readback (the
+                # only blocking part) happens in harvest()
+                self._note_shapes(xs, tag=str(_d))
+                return fwd(_p, _s, *[jax.device_put(jnp.asarray(x), _d)
+                                     for x in xs])
+
+            def harvest(h):
+                hs = h if isinstance(h, (list, tuple)) else [h]
+                return [np.asarray(o) for o in hs]
+
+            out.append(ModelReplica(dispatch, harvest, device=dev,
+                                    on_device_topn=bool(top_n),
+                                    pads_input=True))
+        return out
 
     @classmethod
     def load_onnx(cls, path: str, int8: bool = False,
@@ -332,8 +470,17 @@ class InferenceModel:
         xs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
         xs = [np.asarray(x) for x in xs]
         n = xs[0].shape[0]
-        bucket = (min(batch_size, _next_bucket(n, self.batch_buckets))
-                  if batch_size else _next_bucket(n, self.batch_buckets))
+        bucket = _next_bucket(n, self.batch_buckets)
+        if batch_size:
+            # snap an explicit cap DOWN to the nearest bucket: a cap
+            # between buckets (say 40 with buckets (8, 64)) would
+            # otherwise compile a fresh one-off 40-row program per novel
+            # cap — chunking into full-bucket programs keeps the compiled
+            # set bounded.  A cap below the smallest bucket is honored
+            # as-is (the caller explicitly chose that program shape).
+            eff = max((b for b in self.batch_buckets if b <= batch_size),
+                      default=batch_size)
+            bucket = min(eff, bucket)
         if bucket > n:
             xs = [np.concatenate(
                 [x, np.repeat(x[-1:], bucket - n, axis=0)], axis=0)
@@ -346,6 +493,7 @@ class InferenceModel:
                 return [np.concatenate([o[i] for o in outs], axis=0)
                         for i in range(len(outs[0]))]
             return np.concatenate(outs, axis=0)
+        self._note_shapes(xs)
         out = self._forward(xs)
         if isinstance(out, (list, tuple)):
             return [np.asarray(o)[:n] for o in out]
@@ -365,35 +513,106 @@ class InferenceModel:
 # Dynamic batching — the TPU replacement for the model-clone queue
 # ---------------------------------------------------------------------------
 
+class BatchRequest:
+    """One queued request inside the DynamicBatcher: ``xs`` keep their
+    leading batch dim (``n`` rows); ``callback(out, error)`` fires with
+    the request's slice of the fused output (or the batch error)."""
+
+    __slots__ = ("xs", "n", "callback", "t_submit")
+
+    def __init__(self, xs, callback):
+        self.xs = xs
+        self.n = xs[0].shape[0]
+        self.callback = callback
+        self.t_submit = time.monotonic()
+
+
+def scatter_batch_results(out, reqs: List[BatchRequest]) -> None:
+    """Slice one fused model output back to the requests that formed it."""
+    outs = out if isinstance(out, list) else [out]
+    s = 0
+    for r in reqs:
+        sliced = [np.asarray(o)[s:s + r.n] for o in outs]
+        r.callback(sliced if isinstance(out, list) else sliced[0], None)
+        s += r.n
+
+
 class DynamicBatcher:
-    """Groups concurrent predict() calls into device batches.
+    """Shape-bucketed continuous batching: stage 2 of the serving pipeline.
 
     Reference InferenceModel served N threads with N model clones
     (InferenceModel.scala:30-72); on TPU one compiled program is already
     thread-safe, so the win is *coalescing* small requests into one MXU
-    batch: requests wait at most ``max_latency_ms`` for peers.
+    batch.  Requests group by row shape/dtype (mixed-shape traffic never
+    fuses — each shape is its own bucket feeding its own compiled
+    program) and a bucket dispatches on whichever comes first:
+
+    - **batch-full** — ``max_batch`` rows accumulated (preempts the
+      deadline: a hot bucket never waits);
+    - **deadline** — ``max_latency_ms`` since the bucket's oldest
+      request (trickle traffic is never stranded).
+
+    Two front doors: blocking ``predict`` (drop-in concurrency helper)
+    and async ``submit(xs, callback)`` (the serving pipeline's path).
+    ``dispatch_fn(key, fused, reqs)`` hands full batches to an external
+    executor (the serving DeviceExecutor); without one, batches run
+    inline through ``model.predict``.
     """
 
-    def __init__(self, model: InferenceModel, max_batch: int = 64,
-                 max_latency_ms: float = 5.0):
+    def __init__(self, model: Optional[InferenceModel] = None,
+                 max_batch: int = 64, max_latency_ms: float = 5.0,
+                 dispatch_fn: Optional[Callable] = None,
+                 name: str = "serving"):
+        if model is None and dispatch_fn is None:
+            raise ValueError("DynamicBatcher needs a model or a "
+                             "dispatch_fn")
         self.model = model
         self.max_batch = max_batch
         self.max_latency = max_latency_ms / 1e3
-        self._q: "queue.Queue" = queue.Queue()
+        self.name = name
+        self._dispatch_fn = dispatch_fn
+        self._cv = threading.Condition()
+        self._buckets: Dict[Any, List[BatchRequest]] = {}
+        self._rows: Dict[Any, int] = {}
+        self._deadline: Dict[Any, float] = {}
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
 
-    def predict(self, inputs) -> Any:
-        """Enqueue one request (single example or small batch); blocks
-        until its slice of the fused batch returns."""
+    @staticmethod
+    def _key(xs) -> Any:
+        return tuple((tuple(x.shape[1:]), str(x.dtype)) for x in xs)
+
+    # -- front doors -------------------------------------------------------
+    def submit(self, inputs, callback: Callable) -> None:
+        """Async enqueue; ``callback(out, error)`` fires from the
+        dispatch side when this request's slice is ready."""
         if self._stop.is_set():
             raise RuntimeError("DynamicBatcher is closed")
         xs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
         xs = [np.asarray(x) for x in xs]
+        req = BatchRequest(xs, callback)
+        key = self._key(xs)
+        with self._cv:
+            self._buckets.setdefault(key, []).append(req)
+            self._rows[key] = self._rows.get(key, 0) + req.n
+            self._deadline.setdefault(key, req.t_submit + self.max_latency)
+            self._cv.notify_all()
+
+    def predict(self, inputs) -> Any:
+        """Enqueue one request (single example or small batch); blocks
+        until its slice of the fused batch returns."""
         done = threading.Event()
         slot: Dict[str, Any] = {}
-        self._q.put((xs, done, slot))
+
+        def cb(out, err):
+            if err is not None:
+                slot["error"] = err
+            else:
+                slot["out"] = out
+            done.set()
+
+        self.submit(inputs, cb)
         while not done.wait(timeout=1.0):
             if self._stop.is_set() and not done.is_set():
                 # raced with close(): the worker may have exited before
@@ -403,51 +622,103 @@ class DynamicBatcher:
             raise slot["error"]
         return slot["out"]
 
-    def close(self):
+    def close(self, flush: bool = False):
+        """Stop the dispatcher.  ``flush=True`` dispatches whatever is
+        buffered first (graceful pipeline drain); pending requests left
+        after that fail with RuntimeError so no caller blocks forever."""
+        if flush and not self._stop.is_set():
+            with self._cv:
+                groups = [(k, self._buckets.pop(k))
+                          for k in list(self._buckets)]
+                self._rows.clear()
+                self._deadline.clear()
+            for key, reqs in groups:
+                self._flush(key, reqs, full=False)
         self._stop.set()
+        with self._cv:
+            self._cv.notify_all()
         self._thread.join(timeout=2)
-        # fail any requests still queued so no caller blocks forever
-        while True:
-            try:
-                _, done, slot = self._q.get_nowait()
-            except queue.Empty:
-                break
-            slot["error"] = RuntimeError("DynamicBatcher closed")
-            done.set()
+        with self._cv:
+            pending = [r for reqs in self._buckets.values() for r in reqs]
+            self._buckets.clear()
+            self._rows.clear()
+            self._deadline.clear()
+        for r in pending:
+            r.callback(None, RuntimeError("DynamicBatcher closed"))
+
+    # -- dispatcher --------------------------------------------------------
+    def _ready(self, now: float) -> List[Any]:
+        full = [k for k, r in self._rows.items() if r >= self.max_batch]
+        due = [k for k, d in self._deadline.items()
+               if k not in full and d <= now and self._rows.get(k)]
+        return full + due
 
     def _loop(self):
         while not self._stop.is_set():
-            try:
-                first = self._q.get(timeout=0.05)
-            except queue.Empty:
-                continue
-            batch = [first]
-            deadline = time.monotonic() + self.max_latency
-            rows = first[0][0].shape[0]
-            while rows < self.max_batch:
-                timeout = deadline - time.monotonic()
-                if timeout <= 0:
-                    break
-                try:
-                    req = self._q.get(timeout=timeout)
-                except queue.Empty:
-                    break
-                batch.append(req)
-                rows += req[0][0].shape[0]
-            try:
-                fused = [np.concatenate([b[0][i] for b in batch], axis=0)
-                         for i in range(len(batch[0][0]))]
-                out = self.model.predict(fused)
-                outs = out if isinstance(out, list) else [out]
-                s = 0
-                for xs, done, slot in batch:
-                    n = xs[0].shape[0]
-                    sliced = [o[s:s + n] for o in outs]
-                    slot["out"] = (sliced if isinstance(out, list)
-                                   else sliced[0])
-                    s += n
-                    done.set()
-            except Exception as e:  # surface errors to every waiter
-                for _, done, slot in batch:
-                    slot["error"] = e
-                    done.set()
+            flushes = []
+            with self._cv:
+                now = time.monotonic()
+                ready = self._ready(now)
+                if not ready:
+                    timeout = 0.05
+                    if self._deadline:
+                        timeout = min(timeout, max(
+                            1e-4, min(self._deadline.values()) - now))
+                    self._cv.wait(timeout=timeout)
+                    now = time.monotonic()
+                    ready = self._ready(now)
+                for key in ready:
+                    reqs = self._buckets.pop(key, [])
+                    self._rows.pop(key, None)
+                    deadline_hit = self._deadline.pop(key, now) <= now
+                    if not reqs:
+                        continue
+                    groups, leftover = self._take(reqs, deadline_hit)
+                    flushes.extend((key, g, f) for g, f in groups)
+                    if leftover:
+                        # a full-flush leaves the partial tail batching
+                        # toward its own (original-arrival) deadline
+                        self._buckets[key] = leftover
+                        self._rows[key] = sum(r.n for r in leftover)
+                        self._deadline[key] = (leftover[0].t_submit
+                                               + self.max_latency)
+            for key, reqs, full in flushes:
+                self._flush(key, reqs, full)
+
+    def _take(self, reqs, deadline_hit):
+        """Pack requests into ≤max_batch-row groups (request boundaries
+        respected; a single oversized request flushes alone)."""
+        groups, cur, rows = [], [], 0
+        for r in reqs:
+            if cur and rows + r.n > self.max_batch:
+                groups.append((cur, True))
+                cur, rows = [], 0
+            cur.append(r)
+            rows += r.n
+        leftover = []
+        if cur:
+            if rows >= self.max_batch or deadline_hit:
+                groups.append((cur, rows >= self.max_batch))
+            else:
+                leftover = cur
+        return groups, leftover
+
+    def _flush(self, key, reqs: List[BatchRequest], full: bool) -> None:
+        from analytics_zoo_tpu.core.profiling import TIMERS
+
+        TIMERS.incr(f"{self.name}/flush_full" if full
+                    else f"{self.name}/flush_deadline")
+        now = time.monotonic()
+        for r in reqs:
+            TIMERS.observe(f"{self.name}/batch_wait", now - r.t_submit)
+        try:
+            fused = [np.concatenate([r.xs[i] for r in reqs], axis=0)
+                     for i in range(len(reqs[0].xs))]
+            if self._dispatch_fn is not None:
+                self._dispatch_fn(key, fused, reqs)
+                return
+            out = self.model.predict(fused)
+            scatter_batch_results(out, reqs)
+        except Exception as e:  # surface errors to every waiter
+            for r in reqs:
+                r.callback(None, e)

@@ -7,9 +7,20 @@ with XTRIM backpressure at :123-138) and the Python client
 pyzoo/zoo/serving/client.py:58-150 (InputQueue.enqueue_image / xadd,
 OutputQueue.dequeue / query).
 
-TPU-first redesign: the streaming engine is a plain worker loop around one
-compiled forward (no Spark, no model broadcast — the XLA executable IS the
-broadcast).  The transport is pluggable:
+TPU-first redesign: the streaming engine is a multi-stage async pipeline
+around compiled forwards (no Spark, no model broadcast — the XLA
+executable IS the broadcast; see docs/SERVING.md):
+
+    poller → decode pool → DynamicBatcher → DeviceExecutor → respond pool
+
+Decode/preprocess runs concurrently with device compute, the batcher
+groups requests by shape and flushes on batch-full or a deadline, and
+the executor double-buffers async dispatches round-robined over
+per-device model replicas.  Every stage reports into
+``core.profiling.TIMERS`` (``serving/queue_wait`` / ``decode`` /
+``batch_wait`` / ``device`` / ``respond`` / ``e2e``) with p50/p99
+rollups surfaced by :meth:`ClusterServing.health`.  The transport is
+pluggable:
 
 - ``MemoryQueue``   — in-process (tests, single-process apps);
 - ``FileQueue``     — spool directory with atomic renames (cross-process
@@ -33,7 +44,9 @@ from __future__ import annotations
 
 import base64
 import json
+import logging
 import os
+import queue as pyqueue
 import tempfile
 import threading
 import time
@@ -42,11 +55,14 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from analytics_zoo_tpu.core.profiling import TIMERS
+from analytics_zoo_tpu.deploy.inference import (
+    DynamicBatcher, _next_bucket, scatter_batch_results)
 from analytics_zoo_tpu.robust import RetryPolicy, faults
 
 __all__ = ["MemoryQueue", "FileQueue", "RedisQueue", "make_queue",
            "InputQueue", "OutputQueue", "ServingConfig", "ClusterServing",
-           "encode_image", "decode_image"]
+           "DeviceExecutor", "encode_image", "decode_image"]
 
 
 # ---------------------------------------------------------------------------
@@ -437,8 +453,15 @@ class InputQueue:
         self.queue = queue
 
     def enqueue(self, uri: Optional[str] = None, **data) -> str:
-        """Enqueue arbitrary named arrays (reference enqueue:58)."""
-        rec: Dict[str, Any] = {"uri": uri or uuid.uuid4().hex}
+        """Enqueue arbitrary named arrays (reference enqueue:58).
+
+        Native-client records carry ``ts`` (enqueue wall-clock, feeding
+        the ``serving/queue_wait`` / ``serving/e2e`` stage timers) and
+        ``fmt: "tensor"`` — the worker answers them with the lossless
+        tensor codec instead of ``tolist()`` (OutputQueue decodes
+        transparently; reference-wire records keep plain JSON lists)."""
+        rec: Dict[str, Any] = {"uri": uri or uuid.uuid4().hex,
+                               "ts": time.time(), "fmt": "tensor"}
         for k, v in data.items():
             rec[k] = encode_tensor(v)
         return self.queue.push(rec)
@@ -446,7 +469,8 @@ class InputQueue:
     def enqueue_image(self, uri: Optional[str] = None, image=None) -> str:
         """Enqueue one image (path or ndarray) — reference
         enqueue_image:83 (base64 xadd)."""
-        rec = {"uri": uri or uuid.uuid4().hex, **encode_image(image)}
+        rec = {"uri": uri or uuid.uuid4().hex, "ts": time.time(),
+               "fmt": "tensor", **encode_image(image)}
         return self.queue.push(rec)
 
 
@@ -456,9 +480,19 @@ class OutputQueue:
     def __init__(self, queue):
         self.queue = queue
 
+    @staticmethod
+    def _decode_result(val: Any) -> Any:
+        # native-client results ride the tensor codec (lossless, typed);
+        # everything else (top-N pairs, errors, reference-wire lists)
+        # passes through as-is
+        if isinstance(val, dict) and "tensor" in val:
+            return decode_tensor(val["tensor"])
+        return val
+
     def query(self, uri: str, timeout: float = 10.0) -> Any:
         """Result for one uri (reference query:140)."""
-        return self.queue.get_result(uri, timeout=timeout)
+        return self._decode_result(self.queue.get_result(uri,
+                                                         timeout=timeout))
 
     def dequeue(self, timeout: float = 10.0) -> Dict[str, Any]:
         """Drain all currently-available results (reference dequeue:127)."""
@@ -466,8 +500,9 @@ class OutputQueue:
         while True:
             pend = self.queue.pending_results()
             if pend:
-                return {rid: self.queue.get_result(rid, timeout=1.0)
-                        for rid in pend}
+                return {rid: self._decode_result(
+                    self.queue.get_result(rid, timeout=1.0))
+                    for rid in pend}
             if time.monotonic() >= deadline:
                 return {}
             time.sleep(0.01)
@@ -478,12 +513,24 @@ class OutputQueue:
 # ---------------------------------------------------------------------------
 
 class ServingConfig:
-    """YAML/dict config (reference ClusterServingHelper.scala:104-170)."""
+    """YAML/dict config (reference ClusterServingHelper.scala:104-170).
+
+    Pipeline knobs (docs/SERVING.md): ``max_batch_delay_ms`` is the
+    DynamicBatcher's deadline (oldest queued request never waits longer
+    for peers), ``decode_workers`` sizes the decode pool, ``replicas``
+    the per-device model copies the executor round-robins over, and
+    ``max_inflight`` bounds concurrently-dispatched device batches
+    (2 = double buffering).  ``pipeline=False`` falls back to the
+    synchronous one-thread worker (the bench's ``serving_sync_baseline``
+    leg measures exactly that)."""
 
     def __init__(self, model_path: Optional[str] = None, batch_size: int = 32,
                  backpressure_maxlen: int = 10_000, poll_timeout_s: float = 0.1,
                  postprocess_top_n: Optional[int] = None, int8: bool = False,
-                 tensorboard_dir: Optional[str] = None):
+                 tensorboard_dir: Optional[str] = None,
+                 max_batch_delay_ms: float = 5.0, decode_workers: int = 4,
+                 replicas: int = 1, max_inflight: int = 2,
+                 pipeline: bool = True):
         self.model_path = model_path
         self.batch_size = batch_size
         self.backpressure_maxlen = backpressure_maxlen
@@ -491,6 +538,11 @@ class ServingConfig:
         self.postprocess_top_n = postprocess_top_n
         self.int8 = int8
         self.tensorboard_dir = tensorboard_dir
+        self.max_batch_delay_ms = max_batch_delay_ms
+        self.decode_workers = max(1, int(decode_workers))
+        self.replicas = max(1, int(replicas))
+        self.max_inflight = max(1, int(max_inflight))
+        self.pipeline = pipeline
 
     @classmethod
     def from_yaml(cls, path: str) -> "ServingConfig":
@@ -499,6 +551,20 @@ class ServingConfig:
         with open(path) as f:
             blob = yaml.safe_load(f) or {}
         return cls(**blob)
+
+    @classmethod
+    def from_zoo(cls, zoo_cfg, **overrides: Any) -> "ServingConfig":
+        """Lift the global ``ZooConfig.serving_*`` knobs (ZOO_SERVING_*
+        env vars included) into a ServingConfig."""
+        kw: Dict[str, Any] = dict(
+            batch_size=zoo_cfg.serving_batch_size,
+            max_batch_delay_ms=zoo_cfg.serving_max_batch_delay_ms,
+            decode_workers=zoo_cfg.serving_decode_workers,
+            replicas=zoo_cfg.serving_replicas,
+            max_inflight=zoo_cfg.serving_max_inflight,
+            tensorboard_dir=zoo_cfg.tensorboard_dir)
+        kw.update(overrides)
+        return cls(**kw)
 
 
 def _decode_record(rec: Dict) -> Dict[str, np.ndarray]:
@@ -511,13 +577,195 @@ def _decode_record(rec: Dict) -> Dict[str, np.ndarray]:
     return out
 
 
-class ClusterServing:
-    """The worker loop: pop batch → decode → predict → write results.
+class DeviceExecutor:
+    """Stage 3: keeps the chips busy with double-buffered async dispatch.
 
-    One process per TPU chip/slice; scale out by running more workers on
-    the same queue (FileQueue/RedisQueue hand each record to exactly one
-    claimer).  Backpressure trims the input stream like the reference's
-    XTRIM-at-memory-threshold (ClusterServing.scala:123-138).
+    A dispatch thread pulls full batches off a bounded inbox, pads them
+    to the model's shape buckets, round-robins them over per-device
+    :class:`~analytics_zoo_tpu.deploy.inference.ModelReplica`\\ s, and
+    enqueues the *handle* (future-backed device arrays — JAX's async
+    dispatch returns before the TPU finishes) onto a pending queue whose
+    ``maxsize=max_inflight`` IS the double-buffering bound: with 2 in
+    flight, batch N+1 is transferring/queueing while N computes.  A
+    separate harvest thread performs the only blocking readback.
+
+    Overlap is counter-verified, not eyeballed: ``serving/device_idle_events``
+    counts dispatches that found the device quiet for more than
+    ``IDLE_EPS_S`` since the previous harvest (saturated load must keep
+    it ~flat), and ``busy()`` lets the decode pool prove it decodes
+    while the device computes (``serving/decode_overlap``).
+    """
+
+    IDLE_EPS_S = 0.005  # harvest→dispatch gaps above this count as idle
+
+    def __init__(self, replicas: List, buckets=(1, 32),
+                 max_inflight: int = 2, name: str = "serving"):
+        if not replicas:
+            raise ValueError("DeviceExecutor needs at least one replica")
+        self.replicas = list(replicas)
+        self.buckets = tuple(sorted(buckets))
+        self.max_inflight = max(1, int(max_inflight))
+        self.name = name
+        self._inbox: "pyqueue.Queue" = pyqueue.Queue(
+            maxsize=max(2, self.max_inflight * 4))
+        self._pending: "pyqueue.Queue" = pyqueue.Queue(
+            maxsize=self.max_inflight)
+        self._lock = threading.Lock()
+        self._inflight = 0
+        self._rr = 0
+        self._last_harvest_t: Optional[float] = None
+        self._swap: Optional[List] = None
+        self._stop = threading.Event()
+        self._dispatch_thread = threading.Thread(
+            target=self._dispatch_loop, daemon=True, name="srv-dispatch")
+        self._harvest_thread = threading.Thread(
+            target=self._harvest_loop, daemon=True, name="srv-harvest")
+        self._dispatch_thread.start()
+        self._harvest_thread.start()
+
+    # -- producer side -----------------------------------------------------
+    def submit(self, key, fused: List[np.ndarray], reqs: List) -> None:
+        """DynamicBatcher ``dispatch_fn``: hand over one fused batch.
+        Blocks when ``max_inflight`` batches are already queued — the
+        pipeline's backpressure toward the batcher/decoders."""
+        if self._stop.is_set():
+            raise RuntimeError("DeviceExecutor is stopped")
+        self._inbox.put((key, fused, reqs))
+
+    def busy(self) -> bool:
+        """True while any batch is dispatched-but-not-harvested."""
+        with self._lock:
+            return self._inflight > 0
+
+    @property
+    def inflight(self) -> int:
+        with self._lock:
+            return self._inflight
+
+    def swap_replicas(self, replicas: List) -> None:
+        """Hot reload: the new replica set takes over at the next
+        dispatch (in-flight batches finish on the old weights)."""
+        with self._lock:
+            self._swap = list(replicas)
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        self._dispatch_thread.join(timeout=timeout)
+        self._harvest_thread.join(timeout=timeout)
+
+    def is_alive(self) -> bool:
+        return (self._dispatch_thread.is_alive()
+                or self._harvest_thread.is_alive())
+
+    # -- dispatch ----------------------------------------------------------
+    def _dispatch_loop(self) -> None:
+        while True:
+            try:
+                item = self._inbox.get(timeout=0.05)
+            except pyqueue.Empty:
+                if self._stop.is_set():
+                    return  # inbox drained after stop
+                continue
+            key, fused, reqs = item
+            with self._lock:
+                if self._swap is not None:
+                    self.replicas, self._swap, self._rr = self._swap, None, 0
+                rep = self.replicas[self._rr % len(self.replicas)]
+                self._rr += 1
+                now = time.monotonic()
+                if (self._inflight == 0 and self._last_harvest_t is not None
+                        and now - self._last_harvest_t > self.IDLE_EPS_S):
+                    # the device drained before new work arrived — under
+                    # saturated load this must stay ~0 (warmup/drain gaps
+                    # are excluded: no previous harvest / no next dispatch)
+                    TIMERS.incr(f"{self.name}/device_idle_events")
+                    TIMERS.observe(f"{self.name}/device_idle",
+                                   now - self._last_harvest_t)
+                # count the batch in-flight BEFORE dispatching so even a
+                # synchronous fallback forward reads busy() == True while
+                # it computes
+                self._inflight += 1
+            try:
+                handles = self._dispatch(rep, fused)
+            except Exception as e:
+                with self._lock:
+                    self._inflight -= 1
+                for r in reqs:
+                    r.callback(None, e)
+                continue
+            TIMERS.incr(f"{self.name}/device_batches")
+            TIMERS.incr(f"{self.name}/device_rows", fused[0].shape[0])
+            self._pending.put((rep, handles, reqs, time.monotonic()))
+
+    def _dispatch(self, rep, fused: List[np.ndarray]):
+        """Pad to the bucket set and dispatch; a batch larger than the
+        biggest bucket splits into full-bucket programs (never compiles
+        a one-off shape).  Returns [(handle, rows), ...]."""
+        n = fused[0].shape[0]
+        if not rep.pads_input:  # fallback replica: predict() pads itself
+            return [(rep.dispatch(fused), n)]
+        out, s = [], 0
+        while s < n:
+            m = min(n - s, self.buckets[-1])
+            bucket = _next_bucket(m, self.buckets)
+            chunk = [x[s:s + m] for x in fused]
+            if bucket > m:
+                chunk = [np.concatenate(
+                    [c, np.repeat(c[-1:], bucket - m, axis=0)], axis=0)
+                    for c in chunk]
+            out.append((rep.dispatch(chunk), m))
+            s += m
+        return out
+
+    # -- harvest -----------------------------------------------------------
+    def _harvest_loop(self) -> None:
+        while True:
+            try:
+                item = self._pending.get(timeout=0.05)
+            except pyqueue.Empty:
+                if (self._stop.is_set()
+                        and not self._dispatch_thread.is_alive()
+                        and self._pending.empty()):
+                    return
+                continue
+            rep, handles, reqs, t0 = item
+            try:
+                parts = []
+                for h, m in handles:
+                    outs = rep.harvest(h)  # the one blocking readback
+                    parts.append([np.asarray(o)[:m] for o in outs])
+                outs = (parts[0] if len(parts) == 1 else
+                        [np.concatenate([p[i] for p in parts], axis=0)
+                         for i in range(len(parts[0]))])
+                TIMERS.observe(f"{self.name}/device",
+                               time.monotonic() - t0)
+                out = outs if len(outs) > 1 else outs[0]
+                scatter_batch_results(out, reqs)
+            except Exception as e:
+                for r in reqs:
+                    r.callback(None, e)
+            finally:
+                with self._lock:
+                    self._inflight -= 1
+                    self._last_harvest_t = time.monotonic()
+
+
+class ClusterServing:
+    """The serving worker (reference ClusterServing.scala main loop).
+
+    Default mode is the async pipeline (``ServingConfig.pipeline``)::
+
+        poller ─→ decode pool ─→ DynamicBatcher ─→ DeviceExecutor ─→ respond pool
+        (claim,    (base64/JSON    (shape buckets,   (pad, round-robin   (codec,
+         trim,      + preprocess,   full-or-deadline   replicas, async     set_result,
+         reload)    concurrent)     flush)             double-buffer)      metrics)
+
+    ``pipeline=False`` (or calling :meth:`serve_once` directly) runs the
+    original synchronous quantum.  One process per TPU chip/slice; scale
+    out by running more workers on the same queue (FileQueue/RedisQueue
+    hand each record to exactly one claimer).  Backpressure trims the
+    input stream like the reference's XTRIM-at-memory-threshold
+    (ClusterServing.scala:123-138).
     """
 
     def __init__(self, model, queue, config: Optional[ServingConfig] = None,
@@ -527,23 +775,274 @@ class ClusterServing:
         self.cfg = config or ServingConfig()
         self.preprocess = preprocess
         self._stop = threading.Event()
+        self._stopped = False
         self._thread: Optional[threading.Thread] = None
+        self._threads: List[threading.Thread] = []
+        self._executor: Optional[DeviceExecutor] = None
+        self._batcher: Optional[DynamicBatcher] = None
+        self._topn_on_device = False
         self.records_served = 0
+        self._count_lock = threading.Lock()
         self._tb = None
+        self._tb_last_t = time.monotonic()
+        self._tb_last_n = 0
         if self.cfg.tensorboard_dir:
             from analytics_zoo_tpu.core.summary import SummaryWriter
             self._tb = SummaryWriter(self.cfg.tensorboard_dir)
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> "ClusterServing":
-        self._thread = threading.Thread(target=self.run_forever, daemon=True)
-        self._thread.start()
+        if self.is_alive():
+            return self
+        self._stop.clear()
+        self._stopped = False
+        if self.cfg.pipeline:
+            self._start_pipeline()
+        else:
+            self._thread = threading.Thread(target=self.run_forever,
+                                            daemon=True, name="srv-sync")
+            self._thread.start()
         return self
 
-    def stop(self) -> None:
+    def _build_replicas(self) -> List:
+        return self.model.replica_forwards(
+            n=self.cfg.replicas, top_n=self.cfg.postprocess_top_n)
+
+    def _start_pipeline(self) -> None:
+        replicas = self._build_replicas()
+        self._topn_on_device = bool(replicas[0].on_device_topn)
+        buckets = tuple(getattr(self.model, "batch_buckets", None)
+                        or (1, self.cfg.batch_size))
+        self._executor = DeviceExecutor(
+            replicas, buckets=buckets, max_inflight=self.cfg.max_inflight)
+        self._batcher = DynamicBatcher(
+            max_batch=self.cfg.batch_size,
+            max_latency_ms=self.cfg.max_batch_delay_ms,
+            dispatch_fn=self._executor.submit)
+        self._decode_q: "pyqueue.Queue" = pyqueue.Queue(
+            maxsize=max(64, self.cfg.batch_size * 4))
+        self._respond_q: "pyqueue.Queue" = pyqueue.Queue()
+        self._poller = threading.Thread(target=self._poll_loop, daemon=True,
+                                        name="srv-poll")
+        self._decode_workers = [
+            threading.Thread(target=self._decode_loop, daemon=True,
+                             name=f"srv-decode-{i}")
+            for i in range(self.cfg.decode_workers)]
+        self._respond_workers = [
+            threading.Thread(target=self._respond_loop, daemon=True,
+                             name=f"srv-respond-{i}")
+            for i in range(max(1, self.cfg.decode_workers // 2))]
+        self._threads = ([self._poller] + self._decode_workers
+                         + self._respond_workers)
+        for t in self._threads:
+            t.start()
+
+    def is_alive(self) -> bool:
+        """True while any worker thread (pipeline stage or sync loop) is
+        running — mirror of ``PrefetchIterator``'s liveness probe."""
+        threads = list(self._threads)
+        if self._thread is not None:
+            threads.append(self._thread)
+        if self._executor is not None and self._executor.is_alive():
+            return True
+        return any(t.is_alive() for t in threads)
+
+    def stop(self, timeout: float = 5.0) -> None:
+        """Graceful, idempotent shutdown: stages drain in pipeline order
+        (claimed records are answered, not lost).  A thread that
+        outlives ``timeout`` is logged as leaked — mirroring
+        ``PrefetchIterator.close()`` — instead of silently abandoned."""
+        if self._stopped:
+            return
+        self._stopped = True
         self._stop.set()
-        if self._thread:
-            self._thread.join(timeout=5)
+        log = logging.getLogger("analytics_zoo_tpu.deploy")
+        if self._threads:  # pipeline mode
+            self._poller.join(timeout=timeout)
+            for _ in self._decode_workers:
+                self._decode_q.put(None)
+            for t in self._decode_workers:
+                t.join(timeout=timeout)
+            if self._batcher is not None:
+                self._batcher.close(flush=True)
+            if self._executor is not None:
+                self._executor.stop(timeout=timeout)
+            for _ in self._respond_workers:
+                self._respond_q.put(None)
+            for t in self._respond_workers:
+                t.join(timeout=timeout)
+        elif self._thread is not None:
+            self._thread.join(timeout=timeout)
+        if self.is_alive():
+            leaked = [t.name for t in self._threads + (
+                [self._thread] if self._thread else []) if t.is_alive()]
+            log.warning(
+                "ClusterServing.stop(): worker thread(s) %s still alive "
+                "after %.1fs — leaked (likely stuck in model forward or "
+                "backend I/O)", leaked or ["device-executor"], timeout)
+
+    # -- pipeline stages ---------------------------------------------------
+    def _poll_loop(self) -> None:
+        """Stage 1: claim records, account queue-wait, apply backpressure
+        and hot reload, feed the decode pool."""
+        log = logging.getLogger("analytics_zoo_tpu.deploy")
+        while not self._stop.is_set():
+            try:
+                if self._maybe_reload():
+                    self._executor.swap_replicas(self._build_replicas())
+                dropped = self.queue.trim(self.cfg.backpressure_maxlen)
+                if dropped:
+                    TIMERS.incr("serving/backpressure_dropped", dropped)
+                    log.warning("backpressure: dropped %d queued records",
+                                dropped)
+                batch = self.queue.pop_batch(self.cfg.batch_size,
+                                             timeout=self.cfg.poll_timeout_s)
+                now = time.time()
+                for rid, rec in batch:
+                    ts = rec.get("ts")
+                    if isinstance(ts, (int, float)):
+                        TIMERS.observe("serving/queue_wait",
+                                       max(0.0, now - ts))
+                    while not self._stop.is_set():
+                        try:
+                            self._decode_q.put((rid, rec), timeout=0.1)
+                            break
+                        except pyqueue.Full:
+                            continue
+            except Exception:
+                log.exception("serving poller failed; worker continues")
+                time.sleep(0.05)
+
+    def _decode_loop(self) -> None:
+        """Stage 2a: base64/JSON decode + host preprocess, concurrent
+        with device compute (``serving/decode_overlap`` proves it)."""
+        while True:
+            item = self._decode_q.get()
+            if item is None:
+                return
+            rid, rec = item
+            try:
+                with TIMERS.scope("serving/decode"):
+                    decoded = _decode_record(rec)
+                    x = decoded.get("image")
+                    if x is None:  # first non-image tensor
+                        x = next(iter(decoded.values()))
+                    if self.preprocess is not None:
+                        x = self.preprocess(x)
+                    x = np.asarray(x)
+                if self._executor.busy():
+                    TIMERS.incr("serving/decode_overlap")
+                self._batcher.submit(
+                    [x[None]],
+                    lambda out, err, _rid=rid, _rec=rec:
+                        self._respond_q.put((_rid, _rec, out, err)))
+            except Exception as e:
+                # a bad record answers with an error instead of poisoning
+                # the pipeline (clients see it in query(), not a hang)
+                self._respond_q.put((rid, rec, None, e))
+
+    def _respond_loop(self) -> None:
+        """Stage 4: format + write results, close the e2e span, emit
+        TensorBoard scalars."""
+        log = logging.getLogger("analytics_zoo_tpu.deploy")
+        while True:
+            item = self._respond_q.get()
+            if item is None:
+                return
+            rid, rec, out, err = item
+            try:
+                with TIMERS.scope("serving/respond"):
+                    val = self._format_result(out, err, rec)
+                    self.queue.set_result(rid, val)
+            except Exception:
+                log.exception("serving respond failed for %r", rid)
+                continue
+            ts = rec.get("ts")
+            if isinstance(ts, (int, float)):
+                TIMERS.observe("serving/e2e", max(0.0, time.time() - ts))
+            with self._count_lock:
+                self.records_served += 1
+            self._maybe_tb_flush()
+
+    def _format_result(self, out, err, rec: Dict) -> Any:
+        """One result value for the wire: error dict, top-N pairs, or the
+        raw row (tensor-codec envelope for native clients, ``tolist()``
+        for reference-wire records)."""
+        if err is not None:
+            return {"error": str(err)}
+        top_n = self.cfg.postprocess_top_n
+        outs = out if isinstance(out, list) else [out]
+        if top_n and self._topn_on_device and len(outs) == 2:
+            # the jitted forward already ran lax.top_k: outs = (idx, val)
+            idx, vals = np.asarray(outs[0])[0], np.asarray(outs[1])[0]
+            return [[int(i), float(v)] for i, v in zip(idx, vals)]
+        row = np.asarray(outs[0])
+        # pipeline requests are single-row: drop the leading batch axis so
+        # the wire value matches what serve_once returns per record
+        if row.ndim > 1 or (row.ndim == 1 and row.dtype.kind in "OUS"
+                            and row.shape[0] == 1):
+            row = row[0] if row.shape[0] == 1 else row
+        row = np.asarray(row)
+        return self._format_row(row, native=rec.get("fmt") == "tensor")
+
+    def _format_row(self, row: np.ndarray, native: bool) -> Any:
+        top_n = self.cfg.postprocess_top_n
+        if top_n and row.ndim == 1 and row.dtype.kind in "biufc":
+            # top-N (class, prob) pairs — reference PostProcessing topN
+            idx = np.argsort(row)[::-1][:top_n]
+            return [[int(j), float(row[j])] for j in idx]
+        if native and row.dtype.kind in "biufc":
+            return {"tensor": encode_tensor(row)}
+        # object/str rows (e.g. a detector forward returning JSON blobs)
+        # can't ride the tensor codec — hand the value through as-is
+        return row.tolist()
+
+    def _maybe_tb_flush(self) -> None:
+        if self._tb is None:
+            return
+        now = time.monotonic()
+        with self._count_lock:
+            n, dt = self.records_served, now - self._tb_last_t
+            if n - self._tb_last_n < 32 and dt < 1.0:
+                return
+            delta = n - self._tb_last_n
+            self._tb_last_t, self._tb_last_n = now, n
+        # reference "Serving Throughput"/"Total Records Number" scalars,
+        # plus per-stage p99 rollups so latency regressions attribute
+        self._tb.add_scalar("serving_throughput",
+                            delta / dt if dt > 0 else 0.0, n)
+        self._tb.add_scalar("total_records", n, n)
+        for stage in ("queue_wait", "decode", "batch_wait", "device",
+                      "respond", "e2e"):
+            p99 = TIMERS.percentile(f"serving/{stage}", 99)
+            if p99:
+                self._tb.add_scalar(f"serving_{stage}_p99_ms", p99 * 1e3, n)
+
+    def health(self) -> Dict[str, Any]:
+        """Liveness + per-stage latency rollups + pipeline counters."""
+        qh = (self.queue.health() if hasattr(self.queue, "health")
+              else {"ok": True})
+        stages = {}
+        for k, v in TIMERS.stats().items():
+            if k.startswith("serving/"):
+                stages[k.split("/", 1)[1]] = {
+                    "count": v["count"],
+                    "mean_ms": v["mean_s"] * 1e3,
+                    "p50_ms": v["p50_s"] * 1e3,
+                    "p99_ms": v["p99_s"] * 1e3}
+        h: Dict[str, Any] = {
+            "ok": bool(qh.get("ok", True)),
+            "running": self.is_alive(),
+            "records_served": self.records_served,
+            "queue": qh,
+            "stages": stages,
+            "counters": {k: n for k, n in TIMERS.counts().items()
+                         if k.startswith(("serving/", "inference/"))},
+        }
+        if self._executor is not None:
+            h["inflight"] = self._executor.inflight
+            h["replicas"] = len(self._executor.replicas)
+        return h
 
     # -- model hot reload (reference ClusterServingHelper.scala:185-193:
     # the config/model path is re-checked periodically and the serving
@@ -605,12 +1104,16 @@ class ClusterServing:
                 time.sleep(0.05)  # kill the worker (reference keeps its
                 #                   streaming query alive the same way)
 
-    # -- one scheduling quantum -------------------------------------------
+    # -- one scheduling quantum (sync mode / tests / bench baseline) ------
     def serve_once(self) -> int:
-        """Serve up to one batch; returns number of records served."""
+        """Serve up to one batch; returns number of records served.
+
+        Records are grouped by decoded shape/dtype and each group served
+        as its own (bucket-padded) batch — a record whose shape differs
+        from its neighbors is servable, not an error (mixed 224/299
+        traffic in one poll just becomes two programs)."""
         dropped = self.queue.trim(self.cfg.backpressure_maxlen)
         if dropped:
-            import logging
             logging.getLogger("analytics_zoo_tpu.deploy").warning(
                 "backpressure: dropped %d queued records", dropped)
         batch = self.queue.pop_batch(self.cfg.batch_size,
@@ -618,7 +1121,7 @@ class ClusterServing:
         if not batch:
             return 0
         t0 = time.perf_counter()
-        rids, arrays = [], []
+        groups: Dict[Any, List] = {}  # (shape, dtype) -> [(rid, x, native)]
         for rid, rec in batch:
             try:
                 decoded = _decode_record(rec)
@@ -628,43 +1131,35 @@ class ClusterServing:
                 if self.preprocess is not None:
                     x = self.preprocess(x)
                 x = np.asarray(x)
-                if arrays and x.shape != arrays[0].shape:
-                    raise ValueError(
-                        f"record shape {x.shape} != batch {arrays[0].shape}")
             except Exception as e:
                 # a bad record answers with an error instead of poisoning
                 # the batch (clients see it in query() rather than a hang)
                 self.queue.set_result(rid, {"error": str(e)})
                 continue
-            rids.append(rid)
-            arrays.append(x)
-        if not arrays:
-            return 0
-        x = np.stack(arrays, axis=0)
-        try:
-            out = self.model.predict(x)
-        except Exception as e:
-            # records are already destructively popped from the queue —
-            # answer every one with the error rather than losing them
-            for rid in rids:
-                self.queue.set_result(rid, {"error": str(e)})
-            return 0
-        outs = out[0] if isinstance(out, list) else out
-        for i, rid in enumerate(rids):
-            row = np.asarray(outs[i])
-            if self.cfg.postprocess_top_n and row.ndim == 1:
-                # top-N (class, prob) pairs — reference PostProcessing topN
-                idx = np.argsort(row)[::-1][: self.cfg.postprocess_top_n]
-                val = [[int(j), float(row[j])] for j in idx]
-            else:
-                val = row.tolist()
-            self.queue.set_result(rid, val)
+            groups.setdefault((x.shape, str(x.dtype)), []).append(
+                (rid, x, rec.get("fmt") == "tensor"))
+        served = 0
+        for entries in groups.values():
+            x = np.stack([e[1] for e in entries], axis=0)
+            try:
+                out = self.model.predict(x)
+            except Exception as e:
+                # records are already destructively popped from the queue —
+                # answer every one with the error rather than losing them
+                for rid, _, _ in entries:
+                    self.queue.set_result(rid, {"error": str(e)})
+                continue
+            outs = out[0] if isinstance(out, list) else out
+            for i, (rid, _, native) in enumerate(entries):
+                self.queue.set_result(
+                    rid, self._format_row(np.asarray(outs[i]), native))
+            served += len(entries)
         dt = time.perf_counter() - t0
-        self.records_served += len(rids)
-        if self._tb is not None:
+        self.records_served += served
+        if self._tb is not None and served:
             # reference "Serving Throughput"/"Total Records Number" scalars
-            self._tb.add_scalar("serving_throughput", len(rids) / dt,
+            self._tb.add_scalar("serving_throughput", served / dt,
                                 self.records_served)
             self._tb.add_scalar("total_records", self.records_served,
                                 self.records_served)
-        return len(rids)
+        return served

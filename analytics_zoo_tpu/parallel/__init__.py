@@ -7,6 +7,7 @@ from analytics_zoo_tpu.parallel.sharding import (  # noqa: F401
     ShardingStrategy,
     TensorParallel,
     make_strategy,
+    replica_devices,
 )
 from analytics_zoo_tpu.parallel.mode import (  # noqa: F401
     PipelineMode,

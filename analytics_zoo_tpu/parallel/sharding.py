@@ -333,6 +333,25 @@ def dataset_sharding(mesh, n_rows: int, ndim: int,
     return NamedSharding(mesh, P())
 
 
+def replica_devices(mesh, axis: str = "data") -> list:
+    """Devices hosting one independent serving replica each.
+
+    Serving wants full-model replicas round-robined by the device
+    executor — the inference analog of data parallelism — so the natural
+    replica set is the mesh's data axis: one device per data-axis index,
+    fixed at index 0 along every model axis (those devices hold complete
+    weight copies under DataParallel; a model-parallel serving path would
+    need a sharded forward, which is the training stack's job).  Falls
+    back to the mesh's flat device list when the axis is missing.
+    """
+    devs = np.asarray(mesh.devices)
+    if axis in mesh.axis_names and devs.ndim == len(mesh.axis_names):
+        idx = tuple(slice(None) if a == axis else 0
+                    for a in mesh.axis_names)
+        return list(np.atleast_1d(devs[idx]).ravel())
+    return list(devs.ravel())
+
+
 def make_strategy(name: str, mesh, **kw) -> ShardingStrategy:
     """String lowering (config-system entry point)."""
     name = name.lower()

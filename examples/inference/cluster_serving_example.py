@@ -83,6 +83,13 @@ def main():
         except KeyboardInterrupt:
             pass
     if serving is not None:
+        # per-stage latency attribution from the pipeline telemetry
+        # (docs/SERVING.md): queue_wait / decode / batch_wait / device /
+        # respond / e2e, p50 and p99 each
+        h = serving.health()
+        for stage, s in sorted(h["stages"].items()):
+            print(f"  {stage:<12} p50 {s['p50_ms']:7.2f}ms   "
+                  f"p99 {s['p99_ms']:7.2f}ms   (n={s['count']})")
         serving.stop()
 
 

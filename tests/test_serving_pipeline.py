@@ -1,0 +1,449 @@
+"""Pipelined serving engine contracts (docs/SERVING.md).
+
+The performance properties are asserted via counters, not eyeballed:
+- the DynamicBatcher flushes on deadline under trickle load and on
+  batch-full (preempting the deadline) under bursts, never mixing shapes;
+- the DeviceExecutor's device-idle counter stays flat under saturated
+  load (double buffering keeps the device fed) while decode provably
+  runs concurrently with device compute;
+- `_next_bucket` overflow splits into full-bucket programs instead of
+  compiling one-off shapes (compile-shape ledger);
+- `serve_once` routes mixed-shape records to their own groups instead of
+  erroring; `stop()` is idempotent and warns on leaked workers.
+"""
+
+import logging
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.core.config import ZooConfig
+from analytics_zoo_tpu.core.profiling import TIMERS
+from analytics_zoo_tpu.deploy import (
+    ClusterServing, DeviceExecutor, DynamicBatcher, InferenceModel,
+    InputQueue, MemoryQueue, OutputQueue, ServingConfig)
+from analytics_zoo_tpu.deploy.serving import encode_tensor
+from analytics_zoo_tpu.nn import Dense, Sequential, reset_name_scope
+from analytics_zoo_tpu.nn.layers.core import Activation
+from analytics_zoo_tpu.train.optimizers import Adam
+
+
+def _trained_model(in_dim=12, out_dim=4, buckets=(1, 8)):
+    reset_name_scope()
+    net = Sequential([Dense(16, input_shape=(in_dim,)), Activation("relu"),
+                      Dense(out_dim)])
+    net.compile(optimizer=Adam(1e-2), loss="mse")
+    rs = np.random.RandomState(0)
+    x = rs.randn(64, in_dim).astype(np.float32)
+    net.fit(x, rs.randn(64, out_dim).astype(np.float32), batch_size=32,
+            nb_epoch=1, verbose=False)
+    m = InferenceModel.from_keras_net(net, net.estimator.params,
+                                      net.estimator.state,
+                                      batch_buckets=buckets)
+    return m, x
+
+
+def _drain(outp, n, timeout=30.0):
+    got = {}
+    deadline = time.monotonic() + timeout
+    while len(got) < n and time.monotonic() < deadline:
+        got.update(outp.dequeue(timeout=0.5))
+    return got
+
+
+class TestDynamicBatcherContract:
+    def test_deadline_flush_under_trickle(self):
+        """A lone request is dispatched within ~max_batch_delay_ms, not
+        stranded waiting for peers."""
+        flushes = []
+        b = DynamicBatcher(max_batch=64, max_latency_ms=50,
+                           dispatch_fn=lambda k, fused, reqs: flushes.append(
+                               (time.monotonic(), fused[0].shape[0], reqs)))
+        try:
+            before = TIMERS.count("serving/flush_deadline")
+            t0 = time.monotonic()
+            b.submit(np.ones((1, 4), np.float32), lambda out, err: None)
+            deadline = time.monotonic() + 2.0
+            while not flushes and time.monotonic() < deadline:
+                time.sleep(0.005)
+            assert flushes, "trickle request never flushed"
+            dt = flushes[0][0] - t0
+            # deadline-scheduled: not before the deadline (minus sched
+            # jitter), not long after it
+            assert 0.03 <= dt <= 0.5, f"flush after {dt * 1e3:.1f}ms"
+            assert TIMERS.count("serving/flush_deadline") > before
+        finally:
+            b.close()
+
+    def test_full_batch_preempts_deadline(self):
+        """max_batch rows dispatch immediately — a hot bucket never sits
+        out a long deadline."""
+        flushes = []
+        b = DynamicBatcher(max_batch=4, max_latency_ms=2000,
+                           dispatch_fn=lambda k, fused, reqs: flushes.append(
+                               (time.monotonic(), fused[0].shape[0])))
+        try:
+            before = TIMERS.count("serving/flush_full")
+            t0 = time.monotonic()
+            for _ in range(4):
+                b.submit(np.ones((1, 3), np.float32), lambda out, err: None)
+            deadline = time.monotonic() + 2.0
+            while not flushes and time.monotonic() < deadline:
+                time.sleep(0.005)
+            assert flushes and flushes[0][1] == 4
+            assert flushes[0][0] - t0 < 1.0  # far below the 2s deadline
+            assert TIMERS.count("serving/flush_full") > before
+        finally:
+            b.close()
+
+    def test_per_bucket_grouping_never_mixes_shapes(self):
+        fused_shapes = []
+        b = DynamicBatcher(max_batch=8, max_latency_ms=20,
+                           dispatch_fn=lambda k, fused, reqs: fused_shapes
+                           .append([f.shape for f in fused]))
+        done = []
+        try:
+            for i in range(6):
+                shape = (1, 4) if i % 2 == 0 else (1, 9)
+                b.submit(np.ones(shape, np.float32),
+                         lambda out, err: done.append(err))
+            b.close(flush=True)
+        finally:
+            b.close()
+        # every fused batch is internally shape-uniform, and both shapes
+        # were served (each got >= 1 flush)
+        row_shapes = {shapes[0][1:] for shapes in fused_shapes}
+        assert row_shapes == {(4,), (9,)}
+        total = sum(s[0][0] for s in fused_shapes)
+        assert total == 6
+
+    def test_oversized_accumulation_splits_to_max_batch(self):
+        fused_rows = []
+        b = DynamicBatcher(max_batch=8, max_latency_ms=200,
+                           dispatch_fn=lambda k, fused, reqs: fused_rows
+                           .append(fused[0].shape[0]))
+        try:
+            for _ in range(10):  # 30 rows in 3-row requests
+                b.submit(np.ones((3, 2), np.float32), lambda out, err: None)
+            b.close(flush=True)
+        finally:
+            b.close()
+        assert sum(fused_rows) == 30
+        # full flushes pack request-aligned groups of <= max_batch; only
+        # the final drain may exceed it (the executor chunks that case)
+        assert all(r <= 8 for r in fused_rows[:-1])
+
+    def test_blocking_predict_parity(self):
+        m, x = _trained_model()
+        b = DynamicBatcher(m, max_batch=8, max_latency_ms=10)
+        try:
+            ref = m.predict(x[:6])
+            results = {}
+
+            def one(i):
+                results[i] = b.predict(x[i:i + 1])
+
+            ts = [threading.Thread(target=one, args=(i,)) for i in range(6)]
+            [t.start() for t in ts]
+            [t.join() for t in ts]
+            got = np.concatenate([results[i] for i in range(6)], axis=0)
+            np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+        finally:
+            b.close()
+
+
+class TestNextBucketOverflow:
+    def test_large_batch_splits_into_full_bucket_programs(self):
+        """n > largest bucket must reuse the largest-bucket program, not
+        compile a one-off n-row shape (satellite: recompile per novel
+        large batch)."""
+        calls = []
+
+        def fwd(xs):
+            calls.append(xs[0].shape[0])
+            return xs[0] * 2.0
+
+        m = InferenceModel(fwd, batch_buckets=(8, 64))
+        x = np.ones((300, 3), np.float32)
+        out = m.predict(x)
+        assert out.shape == (300, 3)
+        assert set(calls) == {64}          # only full-bucket programs
+        assert m.compile_count == 1        # ONE compiled shape total
+
+    def test_between_bucket_batch_size_snaps_down(self):
+        """An explicit batch_size between buckets (40 with (8, 64)) runs
+        bucket-shaped programs instead of compiling a 40-row one-off."""
+        calls = []
+
+        def fwd(xs):
+            calls.append(xs[0].shape[0])
+            return xs[0] * 2.0
+
+        m = InferenceModel(fwd, batch_buckets=(8, 64))
+        before = TIMERS.count("inference/novel_batch_shape")
+        out = m.predict(np.ones((80, 3), np.float32), batch_size=40)
+        assert out.shape == (80, 3)
+        assert set(calls) == {8}
+        assert m.compile_count == 1
+        assert TIMERS.count("inference/novel_batch_shape") == before + 1
+
+
+class TestServeOnceMixedShapes:
+    def test_mixed_shapes_grouped_not_errored(self):
+        """Regression (satellite): records of different shapes in one
+        poll are each servable — routed to their own shape group (the
+        224/299 case, scaled down)."""
+
+        def fwd(xs):
+            n = xs[0].shape[0]
+            return xs[0].reshape(n, -1).sum(axis=1, keepdims=True)
+
+        m = InferenceModel(fwd, batch_buckets=(1, 8))
+        q = MemoryQueue()
+        srv = ClusterServing(m, q, ServingConfig(batch_size=8,
+                                                 pipeline=False))
+        rs = np.random.RandomState(0)
+        small = rs.rand(4, 4, 3).astype(np.float32)   # "224"
+        large = rs.rand(5, 5, 3).astype(np.float32)   # "299"
+        q.push({"uri": "s0", "x": encode_tensor(small)})
+        q.push({"uri": "l0", "x": encode_tensor(large)})
+        q.push({"uri": "s1", "x": encode_tensor(small)})
+        assert srv.serve_once() == 3
+        outp = OutputQueue(q)
+        for rid, img in (("s0", small), ("l0", large), ("s1", small)):
+            res = outp.query(rid, timeout=2.0)
+            assert not (isinstance(res, dict) and "error" in res), res
+            np.testing.assert_allclose(np.asarray(res),
+                                       [img.sum()], rtol=1e-4)
+
+
+class TestStopLifecycle:
+    def test_stop_idempotent_and_is_alive(self):
+        m, x = _trained_model()
+        srv = ClusterServing(m, MemoryQueue(),
+                             ServingConfig(batch_size=8,
+                                           poll_timeout_s=0.02)).start()
+        assert srv.is_alive()
+        srv.stop()
+        assert not srv.is_alive()
+        srv.stop()          # second stop: no-op, no raise
+        srv.stop(timeout=0.01)
+
+    def test_stop_warns_on_leaked_worker(self, caplog):
+        def fwd(xs):
+            return xs[0]
+
+        m = InferenceModel(fwd, batch_buckets=(1,))
+        srv = ClusterServing(m, MemoryQueue(),
+                             ServingConfig(pipeline=False))
+        # fabricate a worker stuck in a long forward
+        srv._thread = threading.Thread(target=time.sleep, args=(0.8,),
+                                       daemon=True)
+        srv._thread.start()
+        with caplog.at_level(logging.WARNING,
+                             logger="analytics_zoo_tpu.deploy"):
+            srv.stop(timeout=0.05)
+        assert any("leaked" in r.message for r in caplog.records)
+        srv._thread.join(timeout=2.0)
+
+
+class TestPipelineOverlap:
+    def test_device_idle_flat_and_decode_overlaps_under_saturation(self):
+        """The acceptance counters: under saturated offered load the
+        executor never finds the device idle between batches (double
+        buffering), and decode provably runs while the device computes."""
+
+        def slow_fwd(xs):          # a "device" step long enough to
+            time.sleep(0.004)      # observably overlap with decode
+            return xs[0] * 2.0
+
+        m = InferenceModel(slow_fwd, batch_buckets=(1, 16))
+        q = MemoryQueue()
+        inp = InputQueue(q)
+        for i in range(200):       # saturate BEFORE starting the worker
+            inp.enqueue(uri=f"r{i}", x=np.full((6,), i, np.float32))
+        idle0 = TIMERS.count("serving/device_idle_events")
+        overlap0 = TIMERS.count("serving/decode_overlap")
+        srv = ClusterServing(m, q, ServingConfig(
+            batch_size=16, poll_timeout_s=0.02, max_batch_delay_ms=5,
+            decode_workers=4)).start()
+        try:
+            got = _drain(OutputQueue(q), 200)
+        finally:
+            srv.stop()
+        assert len(got) == 200
+        np.testing.assert_allclose(np.asarray(got["r7"]),
+                                   np.full((6,), 14.0), rtol=1e-6)
+        # device never drained mid-load (warmup/drain gaps excluded by
+        # the counter's definition)
+        assert TIMERS.count("serving/device_idle_events") - idle0 <= 2
+        # decode pool worked while the device was busy
+        assert TIMERS.count("serving/decode_overlap") - overlap0 > 0
+
+    def test_executor_double_buffers_async_replicas(self):
+        """With real (async-dispatch) replicas the pending queue holds
+        max_inflight handles: dispatch N+1 happens before N's readback."""
+        m, x = _trained_model(buckets=(1, 8))
+        reps = m.replica_forwards(n=1)
+        ex = DeviceExecutor(reps, buckets=(1, 8), max_inflight=2)
+        try:
+            outs = []
+            evt = threading.Event()
+
+            class _R:  # minimal BatchRequest stand-in
+                def __init__(self, xs):
+                    self.xs, self.n = xs, xs[0].shape[0]
+                    self.t_submit = time.monotonic()
+
+                def callback(self, out, err):
+                    outs.append((out, err))
+                    if len(outs) == 4:
+                        evt.set()
+
+            for i in range(4):
+                fused = [x[i * 8:(i + 1) * 8]]
+                ex.submit(None, fused, [_R(fused)])
+            assert evt.wait(timeout=20)
+            assert all(e is None for _, e in outs)
+            ref = m.predict(x[:8])
+            np.testing.assert_allclose(outs[0][0], ref, rtol=1e-4,
+                                       atol=1e-4)
+        finally:
+            ex.stop()
+
+
+class TestPipelineEndToEnd:
+    def test_parity_and_tensor_codec_wire(self):
+        m, x = _trained_model()
+        q = MemoryQueue()
+        srv = ClusterServing(m, q, ServingConfig(
+            batch_size=8, poll_timeout_s=0.02)).start()
+        try:
+            inp, outp = InputQueue(q), OutputQueue(q)
+            inp.enqueue(uri="a", x=x[0])
+            # wire format: native records answer with the tensor codec
+            raw = q.get_result("a", timeout=20.0)
+            assert isinstance(raw, dict) and "tensor" in raw
+            q.set_result("a", raw)  # put back for the decoded read
+            res = outp.query("a", timeout=5.0)
+            assert isinstance(res, np.ndarray) and res.dtype == np.float32
+            np.testing.assert_allclose(res, m.predict(x[:1])[0],
+                                       rtol=1e-4, atol=1e-4)
+            # reference-wire record (no fmt): plain JSON-able list
+            q.push({"uri": "ref0", "x": encode_tensor(x[1])})
+            val = q.get_result("ref0", timeout=20.0)
+            assert isinstance(val, list)
+        finally:
+            srv.stop()
+
+    def test_on_device_topn_pairs(self):
+        m, x = _trained_model()
+        q = MemoryQueue()
+        srv = ClusterServing(m, q, ServingConfig(
+            batch_size=8, poll_timeout_s=0.02,
+            postprocess_top_n=2)).start()
+        try:
+            assert srv._topn_on_device  # lax.top_k fused into the forward
+            inp, outp = InputQueue(q), OutputQueue(q)
+            inp.enqueue(uri="t", x=x[0])
+            res = outp.query("t", timeout=20.0)
+            assert len(res) == 2 and len(res[0]) == 2
+            ref = m.predict(x[:1])[0]
+            assert res[0][0] == int(np.argmax(ref))
+            assert res[0][1] == pytest.approx(float(np.max(ref)), rel=1e-4)
+        finally:
+            srv.stop()
+
+    def test_multi_replica_round_robin(self):
+        m, x = _trained_model()
+        q = MemoryQueue()
+        srv = ClusterServing(m, q, ServingConfig(
+            batch_size=4, poll_timeout_s=0.02, replicas=2)).start()
+        try:
+            assert len(srv._executor.replicas) == 2
+            devs = {r.device for r in srv._executor.replicas}
+            assert len(devs) == 2  # distinct mesh devices
+            inp, outp = InputQueue(q), OutputQueue(q)
+            for i in range(12):
+                inp.enqueue(uri=f"m{i}", x=x[i])
+            got = _drain(outp, 12)
+            assert len(got) == 12
+            ref = m.predict(x[:12])
+            for i in range(12):
+                np.testing.assert_allclose(np.asarray(got[f"m{i}"]),
+                                           ref[i], rtol=1e-4, atol=1e-4)
+        finally:
+            srv.stop()
+
+    def test_swap_replicas_hot_reload_path(self):
+        m, x = _trained_model()
+        q = MemoryQueue()
+        srv = ClusterServing(m, q, ServingConfig(
+            batch_size=4, poll_timeout_s=0.02)).start()
+        try:
+            inp, outp = InputQueue(q), OutputQueue(q)
+            inp.enqueue(uri="pre", x=x[0])
+            assert _drain(outp, 1)
+            srv._executor.swap_replicas(srv._build_replicas())
+            inp.enqueue(uri="post", x=x[1])
+            got = _drain(outp, 1)
+            np.testing.assert_allclose(np.asarray(got["post"]),
+                                       m.predict(x[1:2])[0], rtol=1e-4,
+                                       atol=1e-4)
+        finally:
+            srv.stop()
+
+    def test_bad_record_answers_error_in_pipeline(self):
+        m, x = _trained_model()
+        q = MemoryQueue()
+        srv = ClusterServing(m, q, ServingConfig(
+            batch_size=8, poll_timeout_s=0.02)).start()
+        try:
+            inp, outp = InputQueue(q), OutputQueue(q)
+            q.push({"uri": "bad", "image": "!!!not-base64", "codec": "file"})
+            inp.enqueue(uri="good", x=x[0])
+            got = _drain(outp, 2)
+            assert isinstance(got["bad"], dict) and "error" in got["bad"]
+            np.testing.assert_allclose(np.asarray(got["good"]),
+                                       m.predict(x[:1])[0], rtol=1e-4,
+                                       atol=1e-4)
+        finally:
+            srv.stop()
+
+    def test_health_reports_stages_and_counters(self):
+        m, x = _trained_model()
+        q = MemoryQueue()
+        srv = ClusterServing(m, q, ServingConfig(
+            batch_size=8, poll_timeout_s=0.02)).start()
+        try:
+            inp, outp = InputQueue(q), OutputQueue(q)
+            for i in range(8):
+                inp.enqueue(uri=f"h{i}", x=x[i])
+            assert len(_drain(outp, 8)) == 8
+            h = srv.health()
+            assert h["ok"] and h["running"]
+            for stage in ("queue_wait", "decode", "batch_wait", "device",
+                          "respond", "e2e"):
+                assert stage in h["stages"], h["stages"].keys()
+                assert h["stages"][stage]["p99_ms"] >= 0.0
+            assert h["counters"].get("serving/device_batches", 0) > 0
+            assert h["replicas"] == 1
+        finally:
+            srv.stop()
+        assert srv.health()["running"] is False
+
+
+class TestServingConfigFromZoo:
+    def test_from_zoo_maps_serving_knobs(self):
+        zc = ZooConfig(serving_batch_size=7, serving_max_batch_delay_ms=3.5,
+                       serving_decode_workers=2, serving_replicas=3,
+                       serving_max_inflight=4)
+        sc = ServingConfig.from_zoo(zc, postprocess_top_n=5)
+        assert sc.batch_size == 7
+        assert sc.max_batch_delay_ms == 3.5
+        assert sc.decode_workers == 2
+        assert sc.replicas == 3
+        assert sc.max_inflight == 4
+        assert sc.postprocess_top_n == 5
