@@ -85,6 +85,29 @@ class ZooConfig:
     # Batches in flight per executor (2 = double buffering: batch N+1 is
     # enqueued while N computes; also the backpressure bound).
     serving_max_inflight: int = 2
+    # Self-healing serving (docs/SERVING.md "Failure semantics"): each
+    # replica's circuit breaker quarantines it after this many
+    # CONSECUTIVE dispatch/harvest failures...
+    serving_breaker_threshold: int = 3
+    # ...and lets one half-open probe through after this cooldown; a
+    # quarantined replica still open past the cooldown is rebuilt by
+    # the supervisor and hot-swapped in.
+    serving_breaker_cooldown_s: float = 2.0
+    # How often the supervisor thread runs its repair checks (replica
+    # rebuild, harvest watchdog, stage restarts, health gauges).
+    serving_supervisor_interval_s: float = 0.25
+    # A pipeline stage whose heartbeat is older than this while the
+    # worker runs is treated as wedged and restarted.
+    serving_stage_stall_s: float = 10.0
+    # A device harvest readback blocking longer than this is a hung
+    # dispatch: the replica is quarantined, its in-flight records are
+    # requeued, and the harvest stage restarts.
+    serving_harvest_deadline_s: float = 30.0
+    # Default client TTL applied to records that don't carry their own
+    # ``ttl_ms`` (None = records without a TTL never expire).  Expired
+    # work is shed with a structured "expired" error before paying
+    # decode/dispatch cost.
+    serving_default_ttl_ms: Optional[float] = None
 
     # --- robustness ------------------------------------------------------
     # What a non-finite training loss does (docs/ROBUSTNESS.md):

@@ -73,6 +73,7 @@ class Timers:
         self._lock = threading.Lock()
         self._stats: Dict[str, _Stat] = {}
         self._counts: Dict[str, int] = {}
+        self._gauges: Dict[str, float] = {}
 
     @contextlib.contextmanager
     def scope(self, name: str, log: bool = False) -> Iterator[None]:
@@ -112,6 +113,21 @@ class Timers:
         with self._lock:
             return dict(self._counts)
 
+    def set_gauge(self, name: str, value: float) -> None:
+        """Record a point-in-time level (replicas healthy, heartbeat
+        age, queue depth) — unlike counters these overwrite, so the
+        reader always sees the current state, not an accumulation."""
+        with self._lock:
+            self._gauges[name] = float(value)
+
+    def gauge(self, name: str, default: float = 0.0) -> float:
+        with self._lock:
+            return self._gauges.get(name, default)
+
+    def gauges(self) -> Dict[str, float]:
+        with self._lock:
+            return dict(self._gauges)
+
     def stats(self) -> Dict[str, Dict[str, float]]:
         with self._lock:
             return {k: {"count": v.count, "total_s": v.total_s,
@@ -123,6 +139,7 @@ class Timers:
         with self._lock:
             self._stats.clear()
             self._counts.clear()
+            self._gauges.clear()
 
     def report(self) -> str:
         lines = ["name count total_s mean_ms p50_ms p99_ms max_ms"]
@@ -136,6 +153,11 @@ class Timers:
             lines.append("-- counters --")
             for k, n in sorted(counts.items()):
                 lines.append(f"{k} {n}")
+        gauges = self.gauges()
+        if gauges:
+            lines.append("-- gauges --")
+            for k, v in sorted(gauges.items()):
+                lines.append(f"{k} {v:g}")
         return "\n".join(lines)
 
 

@@ -13,4 +13,4 @@ from analytics_zoo_tpu.deploy.inference import (  # noqa: F401
 from analytics_zoo_tpu.deploy.serving import (  # noqa: F401
     ClusterServing, DeviceExecutor, FileQueue, InputQueue, MemoryQueue,
     OutputQueue, RedisQueue, ServingConfig, decode_image, decode_tensor,
-    encode_image, encode_tensor, make_queue)
+    encode_image, encode_tensor, error_payload, make_queue)

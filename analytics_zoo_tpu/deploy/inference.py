@@ -516,15 +516,19 @@ class InferenceModel:
 class BatchRequest:
     """One queued request inside the DynamicBatcher: ``xs`` keep their
     leading batch dim (``n`` rows); ``callback(out, error)`` fires with
-    the request's slice of the fused output (or the batch error)."""
+    the request's slice of the fused output (or the batch error).
+    ``deadline`` (monotonic seconds, optional) is the record's client
+    TTL: a request still unflushed past it is shed with a typed
+    ``DeadlineExpired`` instead of wasting a device slot."""
 
-    __slots__ = ("xs", "n", "callback", "t_submit")
+    __slots__ = ("xs", "n", "callback", "t_submit", "deadline")
 
-    def __init__(self, xs, callback):
+    def __init__(self, xs, callback, deadline=None):
         self.xs = xs
         self.n = xs[0].shape[0]
         self.callback = callback
         self.t_submit = time.monotonic()
+        self.deadline = deadline
 
 
 def scatter_batch_results(out, reqs: List[BatchRequest]) -> None:
@@ -562,7 +566,8 @@ class DynamicBatcher:
     def __init__(self, model: Optional[InferenceModel] = None,
                  max_batch: int = 64, max_latency_ms: float = 5.0,
                  dispatch_fn: Optional[Callable] = None,
-                 name: str = "serving"):
+                 name: str = "serving",
+                 heartbeat: Optional[Callable[[], None]] = None):
         if model is None and dispatch_fn is None:
             raise ValueError("DynamicBatcher needs a model or a "
                              "dispatch_fn")
@@ -571,6 +576,7 @@ class DynamicBatcher:
         self.max_latency = max_latency_ms / 1e3
         self.name = name
         self._dispatch_fn = dispatch_fn
+        self._heartbeat = heartbeat
         self._cv = threading.Condition()
         self._buckets: Dict[Any, List[BatchRequest]] = {}
         self._rows: Dict[Any, int] = {}
@@ -584,20 +590,45 @@ class DynamicBatcher:
         return tuple((tuple(x.shape[1:]), str(x.dtype)) for x in xs)
 
     # -- front doors -------------------------------------------------------
-    def submit(self, inputs, callback: Callable) -> None:
+    def submit(self, inputs, callback: Callable,
+               deadline: Optional[float] = None) -> None:
         """Async enqueue; ``callback(out, error)`` fires from the
-        dispatch side when this request's slice is ready."""
+        dispatch side when this request's slice is ready.  ``deadline``
+        (monotonic) sheds the request with ``DeadlineExpired`` if it is
+        still queued when the bucket flushes past it."""
         if self._stop.is_set():
             raise RuntimeError("DynamicBatcher is closed")
         xs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
         xs = [np.asarray(x) for x in xs]
-        req = BatchRequest(xs, callback)
+        req = BatchRequest(xs, callback, deadline=deadline)
         key = self._key(xs)
+        full_reqs = None
         with self._cv:
             self._buckets.setdefault(key, []).append(req)
             self._rows[key] = self._rows.get(key, 0) + req.n
             self._deadline.setdefault(key, req.t_submit + self.max_latency)
+            if self._rows[key] >= self.max_batch:
+                # batch-full preempts the dispatcher thread: flush from
+                # the submitting thread NOW rather than after the loop's
+                # next GIL slot, so the device starts on batch N while
+                # later requests are still being decoded/submitted
+                full_reqs = self._buckets.pop(key)
+                self._rows.pop(key, None)
+                self._deadline.pop(key, None)
             self._cv.notify_all()
+        if full_reqs is None:
+            return
+        groups, leftover = self._take(full_reqs, False)
+        if leftover:
+            with self._cv:
+                self._buckets.setdefault(key, [])[:0] = leftover
+                self._rows[key] = self._rows.get(key, 0) + sum(
+                    r.n for r in leftover)
+                self._deadline[key] = min(
+                    self._deadline.get(key, float("inf")),
+                    leftover[0].t_submit + self.max_latency)
+        for g, full in groups:
+            self._flush(key, g, full)
 
     def predict(self, inputs) -> Any:
         """Enqueue one request (single example or small batch); blocks
@@ -655,6 +686,8 @@ class DynamicBatcher:
 
     def _loop(self):
         while not self._stop.is_set():
+            if self._heartbeat is not None:
+                self._heartbeat()
             flushes = []
             with self._cv:
                 now = time.monotonic()
@@ -705,10 +738,25 @@ class DynamicBatcher:
 
     def _flush(self, key, reqs: List[BatchRequest], full: bool) -> None:
         from analytics_zoo_tpu.core.profiling import TIMERS
+        from analytics_zoo_tpu.robust.errors import DeadlineExpired
 
+        now = time.monotonic()
+        expired = [r for r in reqs
+                   if r.deadline is not None and now > r.deadline]
+        if expired:
+            # shed before paying the dispatch: the client's TTL already
+            # elapsed while the request batched, so answer the typed
+            # error now and keep the device slot for live work
+            TIMERS.incr(f"{self.name}/shed_expired", len(expired))
+            err = DeadlineExpired(
+                "client TTL expired while the request batched")
+            for r in expired:
+                r.callback(None, err)
+            reqs = [r for r in reqs if r not in expired]
+            if not reqs:
+                return
         TIMERS.incr(f"{self.name}/flush_full" if full
                     else f"{self.name}/flush_deadline")
-        now = time.monotonic()
         for r in reqs:
             TIMERS.observe(f"{self.name}/batch_wait", now - r.t_submit)
         try:

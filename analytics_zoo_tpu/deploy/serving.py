@@ -51,6 +51,7 @@ import tempfile
 import threading
 import time
 import uuid
+from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -58,11 +59,30 @@ import numpy as np
 from analytics_zoo_tpu.core.profiling import TIMERS
 from analytics_zoo_tpu.deploy.inference import (
     DynamicBatcher, _next_bucket, scatter_batch_results)
-from analytics_zoo_tpu.robust import RetryPolicy, faults
+from analytics_zoo_tpu.robust import (CircuitBreaker, Heartbeat, RetryPolicy,
+                                      Supervisor, faults)
+from analytics_zoo_tpu.robust.errors import (DeadlineExpired,
+                                             MalformedRecordError,
+                                             ServingError, ServingOverloaded)
 
 __all__ = ["MemoryQueue", "FileQueue", "RedisQueue", "make_queue",
            "InputQueue", "OutputQueue", "ServingConfig", "ClusterServing",
-           "DeviceExecutor", "encode_image", "decode_image"]
+           "DeviceExecutor", "encode_image", "decode_image", "error_payload",
+           "MalformedRecordError"]
+
+
+def error_payload(code: str, message: Any, uri: Optional[str] = None
+                  ) -> Dict[str, Any]:
+    """The structured error result (docs/SERVING.md "Failure semantics").
+
+    Every record the pipeline cannot serve terminates with one of these
+    on the OutputQueue — never a silent drop: ``error`` is the human
+    message, ``code`` the stable machine class (``expired`` /
+    ``overloaded`` / ``malformed`` / ``decode_error`` / ``model_error``
+    / ``internal``), ``uri`` echoes the record id, ``ts`` stamps when
+    the error was written."""
+    return {"error": str(message), "code": str(code), "uri": uri,
+            "ts": time.time()}
 
 
 # ---------------------------------------------------------------------------
@@ -452,25 +472,64 @@ class InputQueue:
     def __init__(self, queue):
         self.queue = queue
 
-    def enqueue(self, uri: Optional[str] = None, **data) -> str:
+    @staticmethod
+    def _validated_ttl(ttl_ms) -> Optional[float]:
+        if ttl_ms is None:
+            return None
+        if (not isinstance(ttl_ms, (int, float))
+                or isinstance(ttl_ms, bool)
+                or not np.isfinite(ttl_ms) or ttl_ms <= 0):
+            raise MalformedRecordError(
+                f"ttl_ms must be a positive finite number, got {ttl_ms!r}")
+        return float(ttl_ms)
+
+    def enqueue(self, uri: Optional[str] = None,
+                ttl_ms: Optional[float] = None, **data) -> str:
         """Enqueue arbitrary named arrays (reference enqueue:58).
 
         Native-client records carry ``ts`` (enqueue wall-clock, feeding
         the ``serving/queue_wait`` / ``serving/e2e`` stage timers) and
         ``fmt: "tensor"`` — the worker answers them with the lossless
         tensor codec instead of ``tolist()`` (OutputQueue decodes
-        transparently; reference-wire records keep plain JSON lists)."""
+        transparently; reference-wire records keep plain JSON lists).
+
+        ``ttl_ms`` is the client deadline: the worker sheds the record
+        with a structured ``expired``/``overloaded`` error instead of
+        serving it after the client has given up (docs/SERVING.md).
+
+        Malformed input (no tensors, non-encodable dtype, bad TTL)
+        raises :class:`MalformedRecordError` BEFORE anything is pushed —
+        a typed client-side rejection, never a poisoned queue."""
         rec: Dict[str, Any] = {"uri": uri or uuid.uuid4().hex,
                                "ts": time.time(), "fmt": "tensor"}
+        ttl = self._validated_ttl(ttl_ms)
+        if ttl is not None:
+            rec["ttl_ms"] = ttl
+        if not data:
+            raise MalformedRecordError("record carries no tensor fields")
         for k, v in data.items():
-            rec[k] = encode_tensor(v)
+            try:
+                a = np.asarray(v)
+                if a.dtype.hasobject:
+                    raise ValueError(
+                        f"dtype {a.dtype} is not wire-encodable")
+                rec[k] = encode_tensor(a)
+            except MalformedRecordError:
+                raise
+            except Exception as e:
+                raise MalformedRecordError(
+                    f"field {k!r} is not tensor-encodable: {e}") from e
         return self.queue.push(rec)
 
-    def enqueue_image(self, uri: Optional[str] = None, image=None) -> str:
+    def enqueue_image(self, uri: Optional[str] = None, image=None,
+                      ttl_ms: Optional[float] = None) -> str:
         """Enqueue one image (path or ndarray) — reference
         enqueue_image:83 (base64 xadd)."""
         rec = {"uri": uri or uuid.uuid4().hex, "ts": time.time(),
                "fmt": "tensor", **encode_image(image)}
+        ttl = self._validated_ttl(ttl_ms)
+        if ttl is not None:
+            rec["ttl_ms"] = ttl
         return self.queue.push(rec)
 
 
@@ -522,7 +581,17 @@ class ServingConfig:
     ``max_inflight`` bounds concurrently-dispatched device batches
     (2 = double buffering).  ``pipeline=False`` falls back to the
     synchronous one-thread worker (the bench's ``serving_sync_baseline``
-    leg measures exactly that)."""
+    leg measures exactly that).
+
+    Self-healing knobs (docs/SERVING.md "Failure semantics"):
+    ``breaker_threshold`` consecutive failures quarantine a replica,
+    ``breaker_cooldown_s`` gates the half-open probe and the
+    supervisor's rebuild, ``supervisor_interval_s`` paces the repair
+    checks, ``stage_stall_s`` is the stage-heartbeat watchdog deadline,
+    ``harvest_deadline_s`` bounds one device readback before the
+    replica counts as hung, ``default_ttl_ms`` applies to records with
+    no client TTL of their own, and ``supervise=False`` turns the whole
+    supervision layer off (bare pipeline, PR-4 behaviour)."""
 
     def __init__(self, model_path: Optional[str] = None, batch_size: int = 32,
                  backpressure_maxlen: int = 10_000, poll_timeout_s: float = 0.1,
@@ -530,7 +599,13 @@ class ServingConfig:
                  tensorboard_dir: Optional[str] = None,
                  max_batch_delay_ms: float = 5.0, decode_workers: int = 4,
                  replicas: int = 1, max_inflight: int = 2,
-                 pipeline: bool = True):
+                 pipeline: bool = True, breaker_threshold: int = 3,
+                 breaker_cooldown_s: float = 2.0,
+                 supervisor_interval_s: float = 0.25,
+                 stage_stall_s: float = 10.0,
+                 harvest_deadline_s: float = 30.0,
+                 default_ttl_ms: Optional[float] = None,
+                 supervise: bool = True):
         self.model_path = model_path
         self.batch_size = batch_size
         self.backpressure_maxlen = backpressure_maxlen
@@ -543,6 +618,13 @@ class ServingConfig:
         self.replicas = max(1, int(replicas))
         self.max_inflight = max(1, int(max_inflight))
         self.pipeline = pipeline
+        self.breaker_threshold = max(1, int(breaker_threshold))
+        self.breaker_cooldown_s = float(breaker_cooldown_s)
+        self.supervisor_interval_s = float(supervisor_interval_s)
+        self.stage_stall_s = float(stage_stall_s)
+        self.harvest_deadline_s = float(harvest_deadline_s)
+        self.default_ttl_ms = default_ttl_ms
+        self.supervise = supervise
 
     @classmethod
     def from_yaml(cls, path: str) -> "ServingConfig":
@@ -562,6 +644,12 @@ class ServingConfig:
             decode_workers=zoo_cfg.serving_decode_workers,
             replicas=zoo_cfg.serving_replicas,
             max_inflight=zoo_cfg.serving_max_inflight,
+            breaker_threshold=zoo_cfg.serving_breaker_threshold,
+            breaker_cooldown_s=zoo_cfg.serving_breaker_cooldown_s,
+            supervisor_interval_s=zoo_cfg.serving_supervisor_interval_s,
+            stage_stall_s=zoo_cfg.serving_stage_stall_s,
+            harvest_deadline_s=zoo_cfg.serving_harvest_deadline_s,
+            default_ttl_ms=zoo_cfg.serving_default_ttl_ms,
             tensorboard_dir=zoo_cfg.tensorboard_dir)
         kw.update(overrides)
         return cls(**kw)
@@ -575,6 +663,44 @@ def _decode_record(rec: Dict) -> Dict[str, np.ndarray]:
         if k != "image" and isinstance(v, dict) and "b64" in v:
             out[k] = decode_tensor(v)
     return out
+
+
+class _ReplicaSlot:
+    """One supervised replica position: the replica object, its circuit
+    breaker, and the rebuild bookkeeping."""
+
+    __slots__ = ("replica", "breaker", "index", "rebuilt")
+
+    def __init__(self, replica, breaker, index):
+        self.replica = replica
+        self.breaker = breaker
+        self.index = index
+        self.rebuilt = False    # set by rebuild_slot; cleared (and
+        #                         counted as restored) on first success
+
+
+class _Batch:
+    """One fused batch moving through the executor.  ``claimed`` is the
+    single-ownership flag between the harvest thread and the watchdog:
+    whoever sets it (under the executor lock) answers/requeues the
+    requests; the other side discards.  A requeue always builds a FRESH
+    _Batch so a late readback from an abandoned harvest can never
+    double-answer."""
+
+    __slots__ = ("key", "fused", "reqs", "attempt", "slot", "handles",
+                 "t_dispatch", "t_harvest", "claimed", "first_blocked_t")
+
+    def __init__(self, key, fused, reqs, attempt=0):
+        self.key = key
+        self.fused = fused
+        self.reqs = reqs
+        self.attempt = attempt
+        self.slot = None
+        self.handles = None
+        self.t_dispatch = None
+        self.t_harvest = None
+        self.claimed = False
+        self.first_blocked_t = None
 
 
 class DeviceExecutor:
@@ -594,34 +720,71 @@ class DeviceExecutor:
     ``IDLE_EPS_S`` since the previous harvest (saturated load must keep
     it ~flat), and ``busy()`` lets the decode pool prove it decodes
     while the device computes (``serving/decode_overlap``).
+
+    Self-healing (docs/SERVING.md "Failure semantics"): every replica
+    sits in a :class:`_ReplicaSlot` behind a
+    :class:`~analytics_zoo_tpu.robust.CircuitBreaker`.  The round-robin
+    skips quarantined slots; a failed dispatch/harvest requeues the
+    batch (fresh :class:`_Batch`, ``max_retries`` bound) onto healthy
+    replicas before any request sees an error.  With every slot
+    quarantined the executor degrades to the synchronous ``fallback``
+    forward (the ``serve_once`` predict path) instead of hanging, and
+    ``check_harvest`` — driven by the supervisor — abandons a readback
+    stuck past its deadline: quarantine the replica, requeue the
+    in-flight records, respawn the harvest stage.
     """
 
     IDLE_EPS_S = 0.005  # harvest→dispatch gaps above this count as idle
 
     def __init__(self, replicas: List, buckets=(1, 32),
-                 max_inflight: int = 2, name: str = "serving"):
+                 max_inflight: int = 2, name: str = "serving",
+                 breaker_threshold: int = 3, breaker_cooldown_s: float = 2.0,
+                 fallback: Optional[Callable] = None, max_retries: int = 2):
         if not replicas:
             raise ValueError("DeviceExecutor needs at least one replica")
-        self.replicas = list(replicas)
         self.buckets = tuple(sorted(buckets))
         self.max_inflight = max(1, int(max_inflight))
         self.name = name
+        self.breaker_threshold = max(1, int(breaker_threshold))
+        self.breaker_cooldown_s = float(breaker_cooldown_s)
+        self.max_retries = max(0, int(max_retries))
+        self._fallback = fallback
+        self._heartbeat: Optional[Callable[[], None]] = None
         self._inbox: "pyqueue.Queue" = pyqueue.Queue(
             maxsize=max(2, self.max_inflight * 4))
         self._pending: "pyqueue.Queue" = pyqueue.Queue(
             maxsize=self.max_inflight)
+        self._retryq: "deque[_Batch]" = deque()
         self._lock = threading.Lock()
+        self._slots: List[_ReplicaSlot] = self._make_slots(replicas)
         self._inflight = 0
         self._rr = 0
         self._last_harvest_t: Optional[float] = None
+        self._harvesting: Optional[_Batch] = None
+        self._harvest_epoch = 0
         self._swap: Optional[List] = None
         self._stop = threading.Event()
+        self._log = logging.getLogger("analytics_zoo_tpu.deploy")
         self._dispatch_thread = threading.Thread(
             target=self._dispatch_loop, daemon=True, name="srv-dispatch")
         self._harvest_thread = threading.Thread(
-            target=self._harvest_loop, daemon=True, name="srv-harvest")
+            target=self._harvest_loop, args=(0,), daemon=True,
+            name="srv-harvest")
         self._dispatch_thread.start()
         self._harvest_thread.start()
+
+    def _make_slots(self, replicas: List) -> List["_ReplicaSlot"]:
+        return [_ReplicaSlot(
+            rep, CircuitBreaker(failure_threshold=self.breaker_threshold,
+                                cooldown_s=self.breaker_cooldown_s,
+                                name=f"{self.name}_replica{i}"), i)
+            for i, rep in enumerate(replicas)]
+
+    @property
+    def replicas(self) -> List:
+        """The live replica objects (compat view over the slots)."""
+        with self._lock:
+            return [s.replica for s in self._slots]
 
     # -- producer side -----------------------------------------------------
     def submit(self, key, fused: List[np.ndarray], reqs: List) -> None:
@@ -630,7 +793,7 @@ class DeviceExecutor:
         pipeline's backpressure toward the batcher/decoders."""
         if self._stop.is_set():
             raise RuntimeError("DeviceExecutor is stopped")
-        self._inbox.put((key, fused, reqs))
+        self._inbox.put(_Batch(key, fused, reqs))
 
     def busy(self) -> bool:
         """True while any batch is dispatched-but-not-harvested."""
@@ -644,7 +807,8 @@ class DeviceExecutor:
 
     def swap_replicas(self, replicas: List) -> None:
         """Hot reload: the new replica set takes over at the next
-        dispatch (in-flight batches finish on the old weights)."""
+        dispatch (in-flight batches finish on the old weights).  The new
+        slots start with fresh (closed) breakers."""
         with self._lock:
             self._swap = list(replicas)
 
@@ -657,21 +821,198 @@ class DeviceExecutor:
         return (self._dispatch_thread.is_alive()
                 or self._harvest_thread.is_alive())
 
+    # -- supervision surface ----------------------------------------------
+    def replica_states(self) -> List[Dict[str, Any]]:
+        """Per-slot health for ``health()``: breaker state machine plus
+        device identity."""
+        with self._lock:
+            slots = list(self._slots)
+        return [dict(slot=s.index,
+                     device=str(getattr(s.replica, "device", "host")),
+                     rebuilt_pending_probe=s.rebuilt,
+                     **s.breaker.snapshot())
+                for s in slots]
+
+    def healthy_replicas(self) -> int:
+        with self._lock:
+            slots = list(self._slots)
+        return sum(1 for s in slots if s.breaker.health != "quarantined")
+
+    def quarantined_slots(self, min_open_s: float = 0.0
+                          ) -> List["_ReplicaSlot"]:
+        """Slots whose breaker is open and (open long enough OR already
+        failed a probe) — the supervisor's rebuild candidates.  The
+        ``opens >= 2`` clause matters under load: the hot dispatch loop
+        flips open → half-open at exactly the cooldown, so a
+        persistently-bad replica cycles probes without ever *aging* in
+        the open state."""
+        with self._lock:
+            slots = list(self._slots)
+        out = []
+        for s in slots:
+            snap = s.breaker.snapshot()
+            if snap["state"] == "open" and (
+                    snap["open_age_s"] >= min_open_s or snap["opens"] >= 2):
+                out.append(s)
+        return out
+
+    def rebuild_slot(self, index: int, replica) -> None:
+        """Supervisor repair: swap a fresh replica into one slot.  The
+        breaker resets to closed; the first successful harvest through
+        the slot counts ``<name>/replica_restored``."""
+        with self._lock:
+            for s in self._slots:
+                if s.index == index:
+                    s.replica = replica
+                    s.breaker.reset()
+                    s.rebuilt = True
+                    break
+            else:
+                return
+        TIMERS.incr(f"{self.name}/replica_rebuilt")
+        self._log.warning("%s: replica %d rebuilt and swapped in",
+                          self.name, index)
+
+    def ensure_threads(self) -> None:
+        """Supervisor repair: respawn a dead executor thread (the loops
+        are exception-proof, so death is unexpected — but the healer
+        assumes nothing)."""
+        if self._stop.is_set():
+            return
+        if not self._dispatch_thread.is_alive():
+            TIMERS.incr(f"{self.name}/stage_restarted")
+            self._log.warning("%s: dispatch thread died; restarting",
+                              self.name)
+            self._dispatch_thread = threading.Thread(
+                target=self._dispatch_loop, daemon=True, name="srv-dispatch")
+            self._dispatch_thread.start()
+        if not self._harvest_thread.is_alive():
+            with self._lock:
+                self._harvest_epoch += 1
+                epoch = self._harvest_epoch
+            TIMERS.incr(f"{self.name}/stage_restarted")
+            self._log.warning("%s: harvest thread died; restarting",
+                              self.name)
+            self._harvest_thread = threading.Thread(
+                target=self._harvest_loop, args=(epoch,), daemon=True,
+                name=f"srv-harvest-{epoch}")
+            self._harvest_thread.start()
+
+    def check_harvest(self, deadline_s: float) -> bool:
+        """Supervisor watchdog: a readback blocked past ``deadline_s``
+        means the replica (or its device stream) is hung.  Claim the
+        batch away from the stuck thread, quarantine the replica,
+        requeue the records, and respawn the harvest stage.  The stuck
+        thread eventually unblocks, sees its batch claimed and its epoch
+        superseded, and exits without answering anything."""
+        with self._lock:
+            batch = self._harvesting
+            now = time.monotonic()
+            if (batch is None or batch.claimed or batch.t_harvest is None
+                    or now - batch.t_harvest <= deadline_s):
+                return False
+            batch.claimed = True
+            self._harvesting = None
+            self._inflight -= 1
+            self._last_harvest_t = now
+            slot = batch.slot
+            self._harvest_epoch += 1
+            epoch = self._harvest_epoch
+        TIMERS.incr(f"{self.name}/harvest_abandoned")
+        self._log.warning(
+            "%s: harvest readback exceeded %.1fs deadline on replica %s — "
+            "abandoning, quarantining, requeueing %d request(s)",
+            self.name, deadline_s,
+            slot.index if slot is not None else "?", len(batch.reqs))
+        if slot is not None and slot.breaker.force_open():
+            TIMERS.incr(f"{self.name}/replica_quarantined")
+        self._requeue_or_fail(
+            batch, ServingError("device harvest exceeded "
+                                f"{deadline_s:.1f}s deadline",
+                                code="model_error"))
+        self._harvest_thread = threading.Thread(
+            target=self._harvest_loop, args=(epoch,), daemon=True,
+            name=f"srv-harvest-{epoch}")
+        self._harvest_thread.start()
+        return True
+
+    # -- failure plumbing --------------------------------------------------
+    def _fail_batch(self, batch: "_Batch", exc: BaseException) -> None:
+        if not isinstance(exc, ServingError):
+            try:
+                exc.code = getattr(exc, "code", "model_error")
+            except Exception:
+                pass
+        for r in batch.reqs:
+            r.callback(None, exc)
+
+    def _requeue_or_fail(self, batch: "_Batch", exc: BaseException) -> None:
+        """Retry the batch on another replica (fresh _Batch — the old
+        object stays claimed so a late abandoned readback is inert), or
+        answer typed errors once retries are spent."""
+        if batch.attempt < self.max_retries:
+            TIMERS.incr(f"{self.name}/batch_retries")
+            fresh = _Batch(batch.key, batch.fused, batch.reqs,
+                           attempt=batch.attempt + 1)
+            self._retryq.append(fresh)
+        else:
+            self._fail_batch(batch, exc)
+
+    def _replica_failed(self, slot: "_ReplicaSlot", batch: "_Batch",
+                        exc: BaseException) -> None:
+        if slot.breaker.record_failure():
+            TIMERS.incr(f"{self.name}/replica_quarantined")
+            self._log.warning(
+                "%s: replica %d quarantined after %d consecutive "
+                "failure(s); last error: %s", self.name, slot.index,
+                slot.breaker.failure_threshold, exc)
+        self._requeue_or_fail(batch, exc)
+
     # -- dispatch ----------------------------------------------------------
+    def _next_batch(self) -> Optional["_Batch"]:
+        try:
+            return self._retryq.popleft()
+        except IndexError:
+            pass
+        try:
+            return self._inbox.get(timeout=0.05)
+        except pyqueue.Empty:
+            return None
+
+    def _pick_slot_locked(self) -> Optional["_ReplicaSlot"]:
+        n = len(self._slots)
+        for k in range(n):
+            s = self._slots[(self._rr + k) % n]
+            if s.breaker.allow():
+                self._rr = (self._rr + k + 1) % n
+                return s
+        return None
+
     def _dispatch_loop(self) -> None:
         while True:
-            try:
-                item = self._inbox.get(timeout=0.05)
-            except pyqueue.Empty:
+            if self._heartbeat is not None:
+                self._heartbeat()
+            batch = self._next_batch()
+            if batch is None:
                 if self._stop.is_set():
                     return  # inbox drained after stop
                 continue
-            key, fused, reqs = item
-            with self._lock:
-                if self._swap is not None:
-                    self.replicas, self._swap, self._rr = self._swap, None, 0
-                rep = self.replicas[self._rr % len(self.replicas)]
-                self._rr += 1
+            try:
+                self._dispatch_one(batch)
+            except Exception:
+                # the loop must outlive any single batch: answer it and
+                # keep dispatching
+                self._log.exception("%s: dispatch loop error", self.name)
+                self._fail_batch(batch, ServingError(
+                    "internal dispatch error", code="internal"))
+
+    def _dispatch_one(self, batch: "_Batch") -> None:
+        with self._lock:
+            if self._swap is not None:
+                self._slots = self._make_slots(self._swap)
+                self._swap, self._rr = None, 0
+            slot = self._pick_slot_locked()
+            if slot is not None:
                 now = time.monotonic()
                 if (self._inflight == 0 and self._last_harvest_t is not None
                         and now - self._last_harvest_t > self.IDLE_EPS_S):
@@ -685,17 +1026,58 @@ class DeviceExecutor:
                 # synchronous fallback forward reads busy() == True while
                 # it computes
                 self._inflight += 1
+        if slot is None:
+            self._no_healthy_replica(batch)
+            return
+        try:
+            plan = faults.fire(f"{self.name}.replica_crash")
+            if plan is not None and plan.exc is not None:
+                raise plan.exc
+            batch.handles = self._dispatch(slot.replica, batch.fused)
+        except Exception as e:
+            with self._lock:
+                self._inflight -= 1
+            self._replica_failed(slot, batch, e)
+            return
+        batch.slot = slot
+        batch.t_dispatch = time.monotonic()
+        TIMERS.incr(f"{self.name}/device_batches")
+        TIMERS.incr(f"{self.name}/device_rows", batch.fused[0].shape[0])
+        self._pending.put(batch)
+
+    def _no_healthy_replica(self, batch: "_Batch") -> None:
+        """Every replica is quarantined.  With a ``fallback`` (the
+        owning worker's sync predict — the ``serve_once`` path) the
+        batch still serves, synchronously, while the supervisor rebuilds
+        replicas; without one, the batch waits for a half-open probe
+        window and eventually fails typed rather than hanging."""
+        if self._fallback is not None:
+            with self._lock:
+                self._inflight += 1
             try:
-                handles = self._dispatch(rep, fused)
+                out = self._fallback(batch.fused)
+                TIMERS.incr(f"{self.name}/sync_fallback_batches")
+                TIMERS.incr(f"{self.name}/device_batches")
+                TIMERS.incr(f"{self.name}/device_rows",
+                            batch.fused[0].shape[0])
+                scatter_batch_results(out, batch.reqs)
             except Exception as e:
+                self._requeue_or_fail(batch, e)
+            finally:
                 with self._lock:
                     self._inflight -= 1
-                for r in reqs:
-                    r.callback(None, e)
-                continue
-            TIMERS.incr(f"{self.name}/device_batches")
-            TIMERS.incr(f"{self.name}/device_rows", fused[0].shape[0])
-            self._pending.put((rep, handles, reqs, time.monotonic()))
+                    self._last_harvest_t = time.monotonic()
+            return
+        now = time.monotonic()
+        if batch.first_blocked_t is None:
+            batch.first_blocked_t = now
+        if (now - batch.first_blocked_t
+                > max(1.0, 4.0 * self.breaker_cooldown_s)):
+            self._fail_batch(batch, ServingError(
+                "no healthy replica available", code="model_error"))
+            return
+        time.sleep(0.01)  # wait for a probe window / supervisor rebuild
+        self._retryq.append(batch)
 
     def _dispatch(self, rep, fused: List[np.ndarray]):
         """Pad to the bucket set and dispatch; a batch larger than the
@@ -718,36 +1100,65 @@ class DeviceExecutor:
         return out
 
     # -- harvest -----------------------------------------------------------
-    def _harvest_loop(self) -> None:
+    def _harvest_loop(self, my_epoch: int) -> None:
         while True:
+            with self._lock:
+                if self._harvest_epoch != my_epoch:
+                    return  # superseded by the watchdog's respawn
             try:
-                item = self._pending.get(timeout=0.05)
+                batch = self._pending.get(timeout=0.05)
             except pyqueue.Empty:
                 if (self._stop.is_set()
                         and not self._dispatch_thread.is_alive()
                         and self._pending.empty()):
                     return
                 continue
-            rep, handles, reqs, t0 = item
-            try:
-                parts = []
-                for h, m in handles:
-                    outs = rep.harvest(h)  # the one blocking readback
-                    parts.append([np.asarray(o)[:m] for o in outs])
-                outs = (parts[0] if len(parts) == 1 else
-                        [np.concatenate([p[i] for p in parts], axis=0)
-                         for i in range(len(parts[0]))])
-                TIMERS.observe(f"{self.name}/device",
-                               time.monotonic() - t0)
-                out = outs if len(outs) > 1 else outs[0]
-                scatter_batch_results(out, reqs)
-            except Exception as e:
-                for r in reqs:
-                    r.callback(None, e)
-            finally:
-                with self._lock:
-                    self._inflight -= 1
-                    self._last_harvest_t = time.monotonic()
+            self._harvest_one(batch)
+
+    def _harvest_one(self, batch: "_Batch") -> None:
+        slot = batch.slot
+        with self._lock:
+            self._harvesting = batch
+            batch.t_harvest = time.monotonic()
+        err: Optional[BaseException] = None
+        out = None
+        try:
+            plan = faults.fire(f"{self.name}.replica_hang")
+            if plan is not None:  # simulated wedged readback
+                time.sleep(float(plan.payload or 0.5))
+                if plan.exc is not None:
+                    raise plan.exc
+            parts = []
+            for h, m in batch.handles:
+                outs = slot.replica.harvest(h)  # the one blocking readback
+                parts.append([np.asarray(o)[:m] for o in outs])
+            outs = (parts[0] if len(parts) == 1 else
+                    [np.concatenate([p[i] for p in parts], axis=0)
+                     for i in range(len(parts[0]))])
+            out = outs if len(outs) > 1 else outs[0]
+        except Exception as e:
+            err = e
+        # claim the batch: exactly one of {this thread, the watchdog}
+        # answers it
+        with self._lock:
+            if self._harvesting is batch:
+                self._harvesting = None
+            if batch.claimed:
+                return  # the watchdog took it while we were stuck
+            batch.claimed = True
+            self._inflight -= 1
+            self._last_harvest_t = time.monotonic()
+        if err is not None:
+            self._replica_failed(slot, batch, err)
+            return
+        TIMERS.observe(f"{self.name}/device",
+                       time.monotonic() - batch.t_dispatch)
+        scatter_batch_results(out, batch.reqs)
+        if slot.breaker.record_success():
+            TIMERS.incr(f"{self.name}/replica_restored")
+        if slot.rebuilt:
+            slot.rebuilt = False
+            TIMERS.incr(f"{self.name}/replica_restored")
 
 
 class ClusterServing:
@@ -780,6 +1191,8 @@ class ClusterServing:
         self._threads: List[threading.Thread] = []
         self._executor: Optional[DeviceExecutor] = None
         self._batcher: Optional[DynamicBatcher] = None
+        self._hb: Optional[Heartbeat] = None
+        self._supervisor: Optional[Supervisor] = None
         self._topn_on_device = False
         self.records_served = 0
         self._count_lock = threading.Lock()
@@ -813,12 +1226,19 @@ class ClusterServing:
         self._topn_on_device = bool(replicas[0].on_device_topn)
         buckets = tuple(getattr(self.model, "batch_buckets", None)
                         or (1, self.cfg.batch_size))
+        self._hb = Heartbeat()
         self._executor = DeviceExecutor(
-            replicas, buckets=buckets, max_inflight=self.cfg.max_inflight)
+            replicas, buckets=buckets, max_inflight=self.cfg.max_inflight,
+            breaker_threshold=self.cfg.breaker_threshold,
+            breaker_cooldown_s=self.cfg.breaker_cooldown_s,
+            fallback=lambda fused: self.model.predict(
+                fused[0] if len(fused) == 1 else fused))
+        self._executor._heartbeat = lambda: self._hb.beat("device")
         self._batcher = DynamicBatcher(
             max_batch=self.cfg.batch_size,
             max_latency_ms=self.cfg.max_batch_delay_ms,
-            dispatch_fn=self._executor.submit)
+            dispatch_fn=self._executor.submit,
+            heartbeat=lambda: self._hb.beat("batcher"))
         self._decode_q: "pyqueue.Queue" = pyqueue.Queue(
             maxsize=max(64, self.cfg.batch_size * 4))
         self._respond_q: "pyqueue.Queue" = pyqueue.Queue()
@@ -836,6 +1256,100 @@ class ClusterServing:
                          + self._respond_workers)
         for t in self._threads:
             t.start()
+        if self.cfg.supervise:
+            self._start_supervisor()
+
+    # -- supervision -------------------------------------------------------
+    def _start_supervisor(self) -> None:
+        """Background healer: replica rebuilds, the harvest watchdog,
+        stage restarts, and health gauges (docs/SERVING.md)."""
+        sup = Supervisor(interval_s=self.cfg.supervisor_interval_s,
+                         name="serving_supervisor")
+        sup.add_check("harvest_watchdog", lambda: self._executor
+                      .check_harvest(self.cfg.harvest_deadline_s))
+        sup.add_check("heal_replicas", self._heal_replicas)
+        sup.add_check("stages", self._check_stages)
+        sup.add_check("gauges", self._publish_gauges)
+        self._supervisor = sup
+        sup.start()
+
+    def _heal_replicas(self) -> None:
+        """Rebuild quarantined replicas: a breaker still open after its
+        cooldown (or re-opened by a failed probe) gets a FRESH replica —
+        new program + weights on the same device — hot-swapped into its
+        slot, mirroring the ``swap_replicas`` reload path but per-slot."""
+        ex = self._executor
+        if ex is None:
+            return
+        stale = ex.quarantined_slots(min_open_s=self.cfg.breaker_cooldown_s)
+        if not stale:
+            return
+        # one replica_forwards call rebuilds the full set; pick out the
+        # slots that need one (cheap for function-models, and for jitted
+        # forwards the compile cache makes the extra copies ~free)
+        fresh = self._build_replicas()
+        for slot in stale:
+            if slot.index < len(fresh):
+                ex.rebuild_slot(slot.index, fresh[slot.index])
+
+    def _check_stages(self) -> None:
+        """Watchdog for wedged/dead stage threads.  A dead thread is
+        restarted outright; a live thread whose heartbeat is stale past
+        ``stage_stall_s`` is only *flagged* (``serving/stage_stalled``)
+        — killing a live Python thread isn't possible, and the harvest
+        watchdog already covers the one stage that can block on a
+        device."""
+        if self._stop.is_set():
+            return
+        ex = self._executor
+        if ex is not None:
+            ex.ensure_threads()
+        log = logging.getLogger("analytics_zoo_tpu.deploy")
+        if self._poller is not None and not self._poller.is_alive():
+            TIMERS.incr("serving/stage_restarted")
+            log.warning("serving poller died; restarting")
+            self._poller = threading.Thread(
+                target=self._poll_loop, daemon=True, name="srv-poll")
+            self._threads.append(self._poller)
+            self._poller.start()
+        for i, t in enumerate(self._decode_workers):
+            if not t.is_alive():
+                TIMERS.incr("serving/stage_restarted")
+                log.warning("decode worker %d died; restarting", i)
+                nt = threading.Thread(target=self._decode_loop, daemon=True,
+                                      name=f"srv-decode-{i}")
+                self._decode_workers[i] = nt
+                self._threads.append(nt)
+                nt.start()
+        for i, t in enumerate(self._respond_workers):
+            if not t.is_alive():
+                TIMERS.incr("serving/stage_restarted")
+                log.warning("respond worker %d died; restarting", i)
+                nt = threading.Thread(target=self._respond_loop, daemon=True,
+                                      name=f"srv-respond-{i}")
+                self._respond_workers[i] = nt
+                self._threads.append(nt)
+                nt.start()
+        if self._hb is not None:
+            # an idle stage blocks on its queue with an aging heartbeat —
+            # only a stale beat WITH work pending means wedged
+            busy = (self._decode_q.qsize() > 0
+                    or self._respond_q.qsize() > 0
+                    or (ex is not None and ex.inflight > 0))
+            if busy:
+                for stage, age in self._hb.ages().items():
+                    if age > self.cfg.stage_stall_s:
+                        TIMERS.incr(f"serving/stage_stalled/{stage}")
+
+    def _publish_gauges(self) -> None:
+        ex = self._executor
+        if ex is not None:
+            TIMERS.set_gauge("serving/replicas_healthy",
+                             ex.healthy_replicas())
+            TIMERS.set_gauge("serving/inflight", ex.inflight)
+        if self._hb is not None:
+            for stage, age in self._hb.ages().items():
+                TIMERS.set_gauge(f"serving/heartbeat_age_s/{stage}", age)
 
     def is_alive(self) -> bool:
         """True while any worker thread (pipeline stage or sync loop) is
@@ -857,6 +1371,10 @@ class ClusterServing:
         self._stopped = True
         self._stop.set()
         log = logging.getLogger("analytics_zoo_tpu.deploy")
+        if self._supervisor is not None:
+            # the healer goes down FIRST so it can't resurrect stages
+            # that are draining on purpose
+            self._supervisor.stop(timeout=timeout)
         if self._threads:  # pipeline mode
             self._poller.join(timeout=timeout)
             for _ in self._decode_workers:
@@ -881,13 +1399,46 @@ class ClusterServing:
                 "after %.1fs — leaked (likely stuck in model forward or "
                 "backend I/O)", leaked or ["device-executor"], timeout)
 
+    # -- deadline-aware admission (docs/SERVING.md "Failure semantics") ----
+    def _record_ttl_s(self, rec: Dict) -> Optional[float]:
+        """Remaining time budget in seconds for a claimed record, from
+        its enqueue timestamp + client TTL (or the config default).
+        None = no deadline; <= 0 = already expired."""
+        ttl_ms = rec.get("ttl_ms")
+        if ttl_ms is None:
+            ttl_ms = self.cfg.default_ttl_ms
+        if ttl_ms is None:
+            return None
+        try:
+            ttl_ms = float(ttl_ms)
+        except (TypeError, ValueError):
+            return None
+        ts = rec.get("ts")
+        age = (time.time() - ts) if isinstance(ts, (int, float)) else 0.0
+        return ttl_ms / 1e3 - age
+
+    def _shed(self, rid: str, rec: Dict, code: str, msg: str) -> None:
+        """Answer a shed record with a structured error — every claimed
+        record terminates in a result or a typed error payload, never
+        silence."""
+        TIMERS.incr(f"serving/shed_{'expired' if code == 'expired' else 'early'}")
+        TIMERS.incr("serving/errors_returned")
+        try:
+            self.queue.set_result(
+                rid, error_payload(code, msg, uri=rec.get("uri")))
+        except Exception:
+            logging.getLogger("analytics_zoo_tpu.deploy").exception(
+                "failed to write shed-error result for %r", rid)
+
     # -- pipeline stages ---------------------------------------------------
     def _poll_loop(self) -> None:
-        """Stage 1: claim records, account queue-wait, apply backpressure
-        and hot reload, feed the decode pool."""
+        """Stage 1: claim records, account queue-wait, shed expired /
+        hopeless work before it costs decode+dispatch, apply
+        backpressure and hot reload, feed the decode pool."""
         log = logging.getLogger("analytics_zoo_tpu.deploy")
         while not self._stop.is_set():
             try:
+                self._hb.beat("poller")
                 if self._maybe_reload():
                     self._executor.swap_replicas(self._build_replicas())
                 dropped = self.queue.trim(self.cfg.backpressure_maxlen)
@@ -903,6 +1454,24 @@ class ClusterServing:
                     if isinstance(ts, (int, float)):
                         TIMERS.observe("serving/queue_wait",
                                        max(0.0, now - ts))
+                    remaining = self._record_ttl_s(rec)
+                    if remaining is not None:
+                        if remaining <= 0:
+                            self._shed(rid, rec, "expired",
+                                       "client TTL expired before decode")
+                            continue
+                        # estimated time-to-answer from recent e2e p50:
+                        # if the pipeline can't plausibly make the
+                        # deadline, failing fast beats a late answer
+                        est = TIMERS.percentile("serving/e2e", 50)
+                        if est > 0 and est > remaining:
+                            self._shed(
+                                rid, rec, "overloaded",
+                                f"estimated service time {est * 1e3:.0f}ms "
+                                f"exceeds remaining TTL "
+                                f"{remaining * 1e3:.0f}ms")
+                            continue
+                        rec["_deadline_mono"] = time.monotonic() + remaining
                     while not self._stop.is_set():
                         try:
                             self._decode_q.put((rid, rec), timeout=0.1)
@@ -920,41 +1489,80 @@ class ClusterServing:
             item = self._decode_q.get()
             if item is None:
                 return
+            self._hb.beat("decode")
             rid, rec = item
+            deadline = rec.get("_deadline_mono")
             try:
+                faults.inject("serving.decode_error")
                 with TIMERS.scope("serving/decode"):
                     decoded = _decode_record(rec)
                     x = decoded.get("image")
                     if x is None:  # first non-image tensor
-                        x = next(iter(decoded.values()))
+                        it = iter(decoded.values())
+                        x = next(it, None)
+                    if x is None:
+                        raise MalformedRecordError(
+                            "record decoded to no tensor fields")
                     if self.preprocess is not None:
                         x = self.preprocess(x)
                     x = np.asarray(x)
+                # the decode itself may have eaten the rest of the budget
+                if deadline is not None and time.monotonic() > deadline:
+                    raise DeadlineExpired(
+                        "client TTL expired during decode")
                 if self._executor.busy():
                     TIMERS.incr("serving/decode_overlap")
                 self._batcher.submit(
                     [x[None]],
                     lambda out, err, _rid=rid, _rec=rec:
-                        self._respond_q.put((_rid, _rec, out, err)))
+                        self._respond_q.put((_rid, _rec, out, err)),
+                    deadline=deadline)
             except Exception as e:
                 # a bad record answers with an error instead of poisoning
                 # the pipeline (clients see it in query(), not a hang)
+                if isinstance(e, DeadlineExpired):
+                    TIMERS.incr("serving/shed_expired")
+                elif not isinstance(e, ServingError):
+                    try:
+                        e.code = getattr(e, "code", "decode_error")
+                    except Exception:
+                        pass
                 self._respond_q.put((rid, rec, None, e))
 
     def _respond_loop(self) -> None:
         """Stage 4: format + write results, close the e2e span, emit
-        TensorBoard scalars."""
+        TensorBoard scalars.  Transient result-store failures retry
+        (above the backend's own I/O retries); a formatting failure
+        degrades to a typed internal-error payload — the record still
+        terminates."""
         log = logging.getLogger("analytics_zoo_tpu.deploy")
+        retry = _io_retry("serving_respond", retry_on=(Exception,))
         while True:
             item = self._respond_q.get()
             if item is None:
                 return
+            self._hb.beat("respond")
             rid, rec, out, err = item
             try:
                 with TIMERS.scope("serving/respond"):
-                    val = self._format_result(out, err, rec)
-                    self.queue.set_result(rid, val)
+                    try:
+                        faults.inject("serving.respond_error")
+                        val = self._format_result(out, err, rec)
+                    except Exception as fe:
+                        log.exception("result formatting failed for %r", rid)
+                        val = error_payload(
+                            "internal", f"result formatting failed: {fe}",
+                            uri=rec.get("uri"))
+                    if isinstance(val, dict) and "error" in val:
+                        TIMERS.incr("serving/errors_returned")
+
+                    def _write(_rid=rid, _val=val):
+                        faults.inject("serving.queue_io")
+                        self.queue.set_result(_rid, _val)
+
+                    retry.call(_write)
             except Exception:
+                TIMERS.incr("serving/respond_failed")
                 log.exception("serving respond failed for %r", rid)
                 continue
             ts = rec.get("ts")
@@ -965,11 +1573,12 @@ class ClusterServing:
             self._maybe_tb_flush()
 
     def _format_result(self, out, err, rec: Dict) -> Any:
-        """One result value for the wire: error dict, top-N pairs, or the
-        raw row (tensor-codec envelope for native clients, ``tolist()``
-        for reference-wire records)."""
+        """One result value for the wire: typed error payload, top-N
+        pairs, or the raw row (tensor-codec envelope for native clients,
+        ``tolist()`` for reference-wire records)."""
         if err is not None:
-            return {"error": str(err)}
+            code = getattr(err, "code", None) or "internal"
+            return error_payload(code, err, uri=rec.get("uri"))
         top_n = self.cfg.postprocess_top_n
         outs = out if isinstance(out, list) else [out]
         if top_n and self._topn_on_device and len(outs) == 2:
@@ -1017,6 +1626,9 @@ class ClusterServing:
             p99 = TIMERS.percentile(f"serving/{stage}", 99)
             if p99:
                 self._tb.add_scalar(f"serving_{stage}_p99_ms", p99 * 1e3, n)
+        if self._executor is not None:
+            self._tb.add_scalar("serving_replicas_healthy",
+                                self._executor.healthy_replicas(), n)
 
     def health(self) -> Dict[str, Any]:
         """Liveness + per-stage latency rollups + pipeline counters."""
@@ -1042,6 +1654,16 @@ class ClusterServing:
         if self._executor is not None:
             h["inflight"] = self._executor.inflight
             h["replicas"] = len(self._executor.replicas)
+            h["replicas_healthy"] = self._executor.healthy_replicas()
+            h["replica_states"] = self._executor.replica_states()
+        if self._hb is not None:
+            h["stage_heartbeat_age_s"] = self._hb.ages()
+        if self._supervisor is not None:
+            h["supervisor"] = self._supervisor.is_alive()
+        gauges = {k: v for k, v in TIMERS.gauges().items()
+                  if k.startswith("serving/")}
+        if gauges:
+            h["gauges"] = gauges
         return h
 
     # -- model hot reload (reference ClusterServingHelper.scala:185-193:
@@ -1123,21 +1745,32 @@ class ClusterServing:
         t0 = time.perf_counter()
         groups: Dict[Any, List] = {}  # (shape, dtype) -> [(rid, x, native)]
         for rid, rec in batch:
+            remaining = self._record_ttl_s(rec)
+            if remaining is not None and remaining <= 0:
+                self._shed(rid, rec, "expired",
+                           "client TTL expired before decode")
+                continue
             try:
                 decoded = _decode_record(rec)
                 x = decoded.get("image")
                 if x is None:  # first non-image tensor
-                    x = next(iter(decoded.values()))
+                    x = next(iter(decoded.values()), None)
+                if x is None:
+                    raise MalformedRecordError(
+                        "record decoded to no tensor fields")
                 if self.preprocess is not None:
                     x = self.preprocess(x)
                 x = np.asarray(x)
             except Exception as e:
                 # a bad record answers with an error instead of poisoning
                 # the batch (clients see it in query() rather than a hang)
-                self.queue.set_result(rid, {"error": str(e)})
+                code = getattr(e, "code", None) or "decode_error"
+                TIMERS.incr("serving/errors_returned")
+                self.queue.set_result(
+                    rid, error_payload(code, e, uri=rec.get("uri")))
                 continue
             groups.setdefault((x.shape, str(x.dtype)), []).append(
-                (rid, x, rec.get("fmt") == "tensor"))
+                (rid, x, rec.get("fmt") == "tensor", rec))
         served = 0
         for entries in groups.values():
             x = np.stack([e[1] for e in entries], axis=0)
@@ -1146,11 +1779,13 @@ class ClusterServing:
             except Exception as e:
                 # records are already destructively popped from the queue —
                 # answer every one with the error rather than losing them
-                for rid, _, _ in entries:
-                    self.queue.set_result(rid, {"error": str(e)})
+                for rid, _, _, rec in entries:
+                    TIMERS.incr("serving/errors_returned")
+                    self.queue.set_result(rid, error_payload(
+                        "model_error", e, uri=rec.get("uri")))
                 continue
             outs = out[0] if isinstance(out, list) else out
-            for i, (rid, _, native) in enumerate(entries):
+            for i, (rid, _, native, _rec) in enumerate(entries):
                 self.queue.set_result(
                     rid, self._format_row(np.asarray(outs[i]), native))
             served += len(entries)

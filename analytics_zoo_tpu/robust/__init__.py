@@ -13,16 +13,31 @@ equivalent machinery lives here, as three reusable pieces:
   simulated preemptions), the substrate of the chaos test suite.
 - :class:`TrainingPreempted` — raised by ``Estimator.fit`` after the
   preemption handler has flushed its final synchronous checkpoint.
+- :class:`CircuitBreaker` — consecutive-failure health state machine
+  (closed → open → half-open probe) guarding each serving model replica.
+- :class:`Supervisor` / :class:`Heartbeat` — the serving pipeline's
+  self-healing loop: background repair checks (replica rebuild, harvest
+  watchdog, stage restart) plus per-stage liveness stamps.
+- The :class:`ServingError` family — the typed error codes riding the
+  serving pipeline's structured error payloads.
 
 See docs/ROBUSTNESS.md for the end-to-end guarantees.
 """
 
-from analytics_zoo_tpu.robust.errors import TrainingPreempted
+from analytics_zoo_tpu.robust.breaker import CircuitBreaker
+from analytics_zoo_tpu.robust.errors import (DeadlineExpired,
+                                             MalformedRecordError,
+                                             ServingError, ServingOverloaded,
+                                             TrainingPreempted)
 from analytics_zoo_tpu.robust.faults import FaultInjector, fire, inject
 from analytics_zoo_tpu.robust.retry import (RetryDeadlineExceeded,
                                             RetryPolicy, RetryState)
+from analytics_zoo_tpu.robust.supervisor import Heartbeat, Supervisor
 
 __all__ = [
     "RetryPolicy", "RetryState", "RetryDeadlineExceeded",
     "FaultInjector", "fire", "inject", "TrainingPreempted",
+    "CircuitBreaker", "Supervisor", "Heartbeat",
+    "ServingError", "DeadlineExpired", "ServingOverloaded",
+    "MalformedRecordError",
 ]

@@ -3,6 +3,45 @@
 from __future__ import annotations
 
 
+class ServingError(Exception):
+    """Base of the serving pipeline's typed error contract: every error
+    carries a stable ``code`` that rides the structured error payload
+    (docs/SERVING.md "Failure semantics") so clients can branch on the
+    failure class instead of parsing messages.  Codes in use:
+    ``expired``, ``overloaded``, ``malformed``, ``decode_error``,
+    ``model_error``, ``internal``."""
+
+    code = "internal"
+
+    def __init__(self, message: str, code: str = None):
+        super().__init__(message)
+        if code is not None:
+            self.code = code
+
+
+class DeadlineExpired(ServingError):
+    """The record's client TTL elapsed before (or while) the pipeline
+    could serve it — the work was shed, not attempted and failed."""
+
+    code = "expired"
+
+
+class ServingOverloaded(ServingError):
+    """Shed at admission: the estimated pipeline wait already exceeds
+    the record's remaining TTL, so serving it would only waste device
+    time on an answer the client will have given up on."""
+
+    code = "overloaded"
+
+
+class MalformedRecordError(ServingError, ValueError):
+    """The record cannot be decoded/encoded for serving (no tensor
+    fields, non-encodable dtype, invalid TTL).  Raised client-side by
+    ``InputQueue`` validation and worker-side by the decode stage."""
+
+    code = "malformed"
+
+
 class TrainingPreempted(Exception):
     """Raised by ``Estimator.fit`` after a preemption (SIGTERM or an
     injected fault) has been handled: the final synchronous checkpoint

@@ -16,6 +16,16 @@ Sites wired into the stack (call granularity in parentheses):
 - ``estimator.resident_nan_rows`` — one per device-resident epoch fit
                             (payload: row indices to poison)
 - ``queue.io``            — one per retried serving-queue I/O operation
+- ``serving.replica_crash``  — one per device-executor batch dispatch
+                            (raise → breaker failure → quarantine)
+- ``serving.replica_hang``   — one per harvest readback (payload:
+                            seconds to wedge; the harvest watchdog must
+                            abandon + requeue + respawn)
+- ``serving.decode_error``   — one per record in the decode pool
+- ``serving.queue_io``       — one per respond-stage ``set_result``
+                            (above the backend's own ``queue.io`` site;
+                            absorbed by the respond retry policy)
+- ``serving.respond_error``  — one per respond-stage result format
 
 Usage::
 

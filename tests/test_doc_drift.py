@@ -1,0 +1,119 @@
+"""docs/PERFORMANCE.md must not drift from the committed artifact.
+
+r5 shipped a doc quoting flash "8.29x at 1024" while BENCH_r05.json
+said 1.13x — interactive-probe numbers leaked into the doc of record.
+The doc now pins its numeric claims in a marker-delimited table; this
+test resolves each dotted key into the NEWEST BENCH_*.json and fails
+tier-1 when they disagree, so regenerating the artifact without
+regenerating the doc is a red build, not silent drift.
+
+Also guards the instrument itself: the bench ratio/sanitize helpers
+must never let Infinity/NaN reach an emitted report again.
+"""
+
+import importlib.util
+import json
+import re
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+DOC = REPO / "docs" / "PERFORMANCE.md"
+
+_TABLE_RE = re.compile(
+    r"<!--\s*BENCH_TABLE:BEGIN([^>]*)-->(.*?)<!--\s*BENCH_TABLE:END\s*-->",
+    re.S)
+
+
+def _newest_artifact():
+    arts = sorted(REPO.glob("BENCH_*.json"))
+    if not arts:
+        pytest.skip("no BENCH_*.json artifact in repo root")
+    return arts[-1]
+
+
+def _pinned_claims():
+    m = _TABLE_RE.search(DOC.read_text())
+    assert m, "PERFORMANCE.md lost its BENCH_TABLE markers"
+    attrs = dict(re.findall(r"(\w+)=(\S+)", m.group(1)))
+    tol = float(attrs.get("tolerance", 0.02))
+    claims = []
+    for line in m.group(2).splitlines():
+        cells = [c.strip() for c in line.strip().strip("|").split("|")]
+        if len(cells) != 2 or cells[0] in ("key", "") or "---" in cells[0]:
+            continue
+        claims.append((cells[0], float(cells[1])))
+    assert claims, "pinned-claims table is empty"
+    return claims, tol
+
+
+def _resolve(doc, dotted):
+    cur = {"parsed": doc.get("parsed", doc)}
+    for part in dotted.split("."):
+        assert isinstance(cur, dict) and part in cur, \
+            f"artifact has no key {dotted!r} (stopped at {part!r})"
+        cur = cur[part]
+    return cur
+
+
+class TestDocDrift:
+    def test_pinned_claims_match_newest_artifact(self):
+        art = _newest_artifact()
+        doc = json.loads(art.read_text())
+        claims, tol = _pinned_claims()
+        bad = []
+        for key, claimed in claims:
+            actual = _resolve(doc, key)
+            assert isinstance(actual, (int, float)), \
+                f"{key} resolves to non-numeric {actual!r}"
+            if actual != pytest.approx(claimed, rel=tol):
+                bad.append(f"{key}: doc={claimed} artifact={actual}")
+        assert not bad, (f"PERFORMANCE.md drifted from {art.name}:\n  "
+                         + "\n  ".join(bad))
+
+    def test_pinned_claims_are_finite(self):
+        import math
+        claims, _ = _pinned_claims()
+        for key, v in claims:
+            assert math.isfinite(v), f"{key} pins a non-finite value"
+
+
+def _bench():
+    spec = importlib.util.spec_from_file_location("bench", REPO / "bench.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestBenchNonFiniteGuards:
+    """The helpers that keep Infinity/NaN out of future artifacts."""
+
+    def test_safe_ratio_refuses_degenerate_operands(self):
+        b = _bench()
+        assert b._safe_ratio(2.0, 1.0) == 2.0
+        assert b._safe_ratio(1.13, 1.0, nd=3) == 1.13
+        for num, den in [(1.0, 0.0), (1.0, -1.0), (0.0, 1.0),
+                         (None, 1.0), (1.0, None),
+                         (float("inf"), 1.0), (1.0, float("nan")),
+                         ("fast", 1.0)]:
+            assert b._safe_ratio(num, den) is None, (num, den)
+
+    def test_sanitize_json_strips_non_finite(self):
+        b = _bench()
+        report = {"a": float("inf"),
+                  "b": {"c": float("nan"), "d": 1.5},
+                  "e": [1.0, float("-inf"), "x"]}
+        clean = b._sanitize_json(report)
+        assert clean == {"a": None, "b": {"c": None, "d": 1.5},
+                         "e": [1.0, None, "x"]}
+        json.dumps(clean, allow_nan=False)   # strict JSON round-trips
+
+    def test_measure_scan_returns_none_below_resolution(self):
+        import numpy as np
+        b = _bench()
+        # an instant program has no measurable slope: the old code
+        # clamped to ~0 and downstream ratios minted Infinity
+        r = b._measure_scan(lambda c, n: c, np.zeros(4), K=16,
+                            rounds=2, probe=False)
+        assert r is None

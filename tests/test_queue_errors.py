@@ -1,0 +1,146 @@
+"""Structured error payloads + malformed-record rejection
+(docs/SERVING.md "Failure semantics").
+
+The error payload schema ({error, code, uri, ts}) must survive a
+round-trip through every queue backend unchanged — clients switch
+backends without changing their error handling — and the InputQueue
+must reject malformed input with a typed client-side error BEFORE
+anything reaches the stream (never a poisoned queue)."""
+
+import sys
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.deploy import (ClusterServing, FileQueue, InputQueue,
+                                      MemoryQueue, OutputQueue, RedisQueue,
+                                      ServingConfig, error_payload)
+from analytics_zoo_tpu.deploy.inference import InferenceModel
+from analytics_zoo_tpu.robust import (DeadlineExpired, MalformedRecordError,
+                                      ServingError, ServingOverloaded)
+
+
+@pytest.fixture
+def fake_redis(monkeypatch):
+    from tests import fake_redis as fr
+
+    fr._Server.reset()
+    monkeypatch.setitem(sys.modules, "redis", fr)
+    yield fr
+    fr._Server.reset()
+
+
+def _backends(tmp_path, fake_redis):
+    return [MemoryQueue(),
+            FileQueue(str(tmp_path / "spool")),
+            RedisQueue(host="fake", port=1)]
+
+
+class TestErrorPayloadRoundTrip:
+    def test_schema(self):
+        p = error_payload("expired", ValueError("too late"), uri="r1")
+        assert p["error"] == "too late"
+        assert p["code"] == "expired"
+        assert p["uri"] == "r1"
+        assert isinstance(p["ts"], float)
+
+    def test_round_trips_every_backend(self, tmp_path, fake_redis):
+        for q in _backends(tmp_path, fake_redis):
+            payload = error_payload("model_error",
+                                    RuntimeError("chip fell over"),
+                                    uri="rid-1")
+            q.set_result("rid-1", payload)
+            got = OutputQueue(q).query("rid-1", timeout=2.0)
+            assert got["error"] == "chip fell over", type(q).__name__
+            assert got["code"] == "model_error"
+            assert got["uri"] == "rid-1"
+            assert got["ts"] == pytest.approx(payload["ts"], abs=1e-3)
+
+    def test_dequeue_carries_error_payloads(self, tmp_path, fake_redis):
+        for q in _backends(tmp_path, fake_redis):
+            q.set_result("bad", error_payload("decode_error", "boom",
+                                              uri="bad"))
+            q.set_result("good", [1, 2, 3])
+            got = OutputQueue(q).dequeue(timeout=2.0)
+            assert got["bad"]["code"] == "decode_error", type(q).__name__
+            assert got["good"] == [1, 2, 3]
+
+
+class TestInputQueueValidation:
+    def test_no_tensor_fields_rejected(self):
+        q = MemoryQueue()
+        with pytest.raises(MalformedRecordError):
+            InputQueue(q).enqueue(uri="r1")
+        assert len(q) == 0          # nothing reached the stream
+
+    def test_object_dtype_rejected(self):
+        q = MemoryQueue()
+        with pytest.raises(MalformedRecordError) as ei:
+            InputQueue(q).enqueue(uri="r1", x=[object()])
+        assert "x" in str(ei.value)
+        assert len(q) == 0
+
+    @pytest.mark.parametrize("ttl", [-5, 0, float("nan"), float("inf"),
+                                     "soon", True])
+    def test_bad_ttl_rejected(self, ttl):
+        q = MemoryQueue()
+        with pytest.raises(MalformedRecordError):
+            InputQueue(q).enqueue(uri="r1", ttl_ms=ttl,
+                                  x=np.zeros(3, np.float32))
+        assert len(q) == 0
+
+    def test_valid_ttl_stamped(self):
+        q = MemoryQueue()
+        InputQueue(q).enqueue(uri="r1", ttl_ms=250,
+                              x=np.zeros(3, np.float32))
+        [(rid, rec)] = q.pop_batch(1)
+        assert rid == "r1" and rec["ttl_ms"] == 250.0
+
+    def test_malformed_is_both_servingerror_and_valueerror(self):
+        # client code catching either class keeps working
+        assert issubclass(MalformedRecordError, ServingError)
+        assert issubclass(MalformedRecordError, ValueError)
+        assert MalformedRecordError("x").code == "malformed"
+        assert DeadlineExpired("x").code == "expired"
+        assert ServingOverloaded("x").code == "overloaded"
+        assert ServingError("x").code == "internal"
+        assert ServingError("x", code="custom").code == "custom"
+
+
+class TestWorkerAnswersUndecodable:
+    def test_undecodable_record_gets_typed_payload(self):
+        """A record that passes client validation but fails to decode at
+        the worker terminates with a typed error payload (sync path)."""
+        q = MemoryQueue()
+        q.push({"uri": "garbled", "ts": 0.0, "fmt": "tensor",
+                "image": {"b64": "!!!not-base64!!!"}})
+        m = InferenceModel(lambda xs: xs[0], batch_buckets=(1, 8))
+        srv = ClusterServing(m, q, ServingConfig(pipeline=False,
+                                                 poll_timeout_s=0.05))
+        srv.serve_once()
+        val = OutputQueue(q).query("garbled", timeout=2.0)
+        assert isinstance(val, dict)
+        assert val["code"] in ("decode_error", "malformed")
+        assert val["uri"] == "garbled"
+
+    def test_empty_record_gets_malformed_payload(self):
+        q = MemoryQueue()
+        q.push({"uri": "hollow", "ts": 0.0})
+        m = InferenceModel(lambda xs: xs[0], batch_buckets=(1, 8))
+        srv = ClusterServing(m, q, ServingConfig(pipeline=False,
+                                                 poll_timeout_s=0.05))
+        srv.serve_once()
+        val = OutputQueue(q).query("hollow", timeout=2.0)
+        assert val["code"] == "malformed"
+
+    def test_expired_record_shed_in_sync_path(self):
+        q = MemoryQueue()
+        import time
+        q.push({"uri": "stale", "ts": time.time() - 60.0, "ttl_ms": 10.0,
+                "fmt": "tensor"})
+        m = InferenceModel(lambda xs: xs[0], batch_buckets=(1, 8))
+        srv = ClusterServing(m, q, ServingConfig(pipeline=False,
+                                                 poll_timeout_s=0.05))
+        srv.serve_once()
+        val = OutputQueue(q).query("stale", timeout=2.0)
+        assert val["code"] == "expired"

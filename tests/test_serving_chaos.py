@@ -1,0 +1,448 @@
+"""Self-healing serving contracts (docs/SERVING.md "Failure semantics").
+
+Recovery is counter-verified, never eyeballed:
+- CircuitBreaker state machine: closed → open at the consecutive-failure
+  threshold, one half-open probe per cooldown, probe outcome closes or
+  re-opens; ``force_open`` covers hung (not just failing) replicas.
+- Supervisor: checks run on an interval, a throwing check never kills
+  the healer, stop() is idempotent.
+- DeviceExecutor: a crashing replica is quarantined and its batch
+  retried on healthy peers before any client sees an error; with every
+  replica quarantined the executor degrades to the synchronous fallback
+  forward instead of hanging; a harvest readback stuck past its
+  deadline is abandoned by the watchdog (records requeued, replica
+  quarantined, harvest stage respawned — the late readback is inert).
+- ClusterServing chaos soak: all five ``serving.*`` fault sites fire
+  under saturated load and every record still terminates in a result or
+  a typed error payload (zero lost), with post-chaos throughput intact.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.core.profiling import TIMERS
+from analytics_zoo_tpu.deploy import (ClusterServing, DeviceExecutor,
+                                      InferenceModel, InputQueue, MemoryQueue,
+                                      OutputQueue, ServingConfig)
+from analytics_zoo_tpu.deploy.inference import ModelReplica
+from analytics_zoo_tpu.robust import (CircuitBreaker, FaultInjector,
+                                      Heartbeat, Supervisor)
+
+
+def _drain(outp, n, timeout=30.0):
+    got = {}
+    deadline = time.monotonic() + timeout
+    while len(got) < n and time.monotonic() < deadline:
+        got.update(outp.dequeue(timeout=0.5))
+    return got
+
+
+def _sync_replica(fn):
+    """A shared-forward replica (the function-model shape): dispatch
+    computes synchronously, harvest just unwraps."""
+    return ModelReplica(lambda xs, _f=fn: _f(xs),
+                        lambda h: h if isinstance(h, list) else [h],
+                        device=None, pads_input=False)
+
+
+class _FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+class TestCircuitBreaker:
+    def test_opens_at_consecutive_threshold_only(self):
+        clk = _FakeClock()
+        br = CircuitBreaker(failure_threshold=3, cooldown_s=1.0,
+                            name="t1", clock=clk)
+        assert br.health == "healthy" and br.allow()
+        assert not br.record_failure()
+        assert not br.record_failure()
+        br.record_success()           # success resets the streak
+        assert br.health == "healthy"
+        assert not br.record_failure()
+        assert not br.record_failure()
+        assert br.record_failure()    # third CONSECUTIVE → newly opened
+        assert br.health == "quarantined" and not br.allow()
+
+    def test_half_open_single_probe_then_close(self):
+        clk = _FakeClock()
+        br = CircuitBreaker(failure_threshold=1, cooldown_s=2.0,
+                            name="t2", clock=clk)
+        br.record_failure()
+        assert not br.allow()
+        clk.t = 1.0
+        assert not br.allow()         # still cooling down
+        clk.t = 2.5
+        assert br.allow()             # the single half-open probe
+        assert not br.allow()         # second caller is NOT let through
+        assert br.health == "quarantined"   # probing still counts as such
+        assert br.record_success()    # probe succeeded → closed
+        assert br.health == "healthy" and br.allow()
+
+    def test_failed_probe_reopens(self):
+        clk = _FakeClock()
+        br = CircuitBreaker(failure_threshold=1, cooldown_s=1.0,
+                            name="t3", clock=clk)
+        br.record_failure()
+        clk.t = 1.5
+        assert br.allow()
+        assert br.record_failure()    # probe failed → newly opened again
+        assert not br.allow()
+        assert br.snapshot()["opens"] == 2
+
+    def test_force_open_and_snapshot(self):
+        br = CircuitBreaker(failure_threshold=5, name="t4")
+        assert br.force_open()        # hung replica: open regardless of
+        assert not br.force_open()    # the failure count; idempotent
+        snap = br.snapshot()
+        assert snap["state"] == "open"
+        assert snap["health"] == "quarantined"
+        assert snap["opens"] == 1 and snap["open_age_s"] >= 0.0
+
+
+class TestSupervisor:
+    def test_checks_run_and_throwing_check_survives(self):
+        hits = []
+        sup = Supervisor(interval_s=0.01, name="sup_t")
+
+        def bad():
+            raise RuntimeError("check exploded")
+
+        sup.add_check("bad", bad)
+        sup.add_check("good", lambda: hits.append(1))
+        err0 = TIMERS.count("robust/supervisor_check_error/bad")
+        sup.start()
+        try:
+            deadline = time.monotonic() + 5.0
+            while len(hits) < 3 and time.monotonic() < deadline:
+                time.sleep(0.01)
+        finally:
+            sup.stop()
+        assert len(hits) >= 3          # good ran despite bad throwing
+        assert TIMERS.count("robust/supervisor_check_error/bad") - err0 >= 3
+        assert not sup.is_alive()
+        sup.stop()                     # idempotent
+
+    def test_heartbeat_ages(self):
+        clk = _FakeClock()
+        hb = Heartbeat(clock=clk)
+        assert hb.age("poller") == 0.0       # never beaten → not stale
+        hb.beat("poller")
+        clk.t = 3.0
+        assert hb.age("poller") == pytest.approx(3.0)
+        assert hb.ages() == {"poller": pytest.approx(3.0)}
+
+
+class TestExecutorSelfHealing:
+    def test_crashing_replica_quarantined_and_batch_retried(self):
+        """The client never sees the bad chip: its batch is retried on
+        the healthy peer and the breaker quarantines the crasher."""
+        calls = {"bad": 0}
+
+        def bad(xs):
+            calls["bad"] += 1
+            raise RuntimeError("chip fell over")
+
+        reps = [_sync_replica(bad),
+                _sync_replica(lambda xs: xs[0] * 2.0)]
+        ex = DeviceExecutor(reps, buckets=(1, 8), name="chaos_crash",
+                            breaker_threshold=1, breaker_cooldown_s=30.0,
+                            max_retries=2)
+        try:
+            got = {}
+            done = threading.Event()
+
+            class _Req:
+                def __init__(self):
+                    self.xs = [np.full((1, 4), 3.0, np.float32)]
+                    self.n = 1
+
+                def callback(self, out, err):
+                    got["out"], got["err"] = out, err
+                    done.set()
+
+            for _ in range(3):   # several batches: round-robin hits bad
+                done.clear()
+                ex.submit("k", [np.full((1, 4), 3.0, np.float32)], [_Req()])
+                assert done.wait(5.0)
+                assert got["err"] is None
+                np.testing.assert_allclose(np.asarray(got["out"]),
+                                           np.full((1, 4), 6.0), rtol=1e-6)
+        finally:
+            ex.stop()
+        assert calls["bad"] == 1   # quarantined after its first failure
+        assert TIMERS.count("chaos_crash/replica_quarantined") == 1
+        assert TIMERS.count("chaos_crash/batch_retries") >= 1
+        states = ex.replica_states()
+        assert [s["health"] for s in states].count("quarantined") == 1
+
+    def test_all_quarantined_degrades_to_sync_fallback(self):
+        def bad(xs):
+            raise RuntimeError("no chips left")
+
+        ex = DeviceExecutor([_sync_replica(bad), _sync_replica(bad)],
+                            buckets=(1, 8), name="chaos_fb",
+                            breaker_threshold=1, breaker_cooldown_s=30.0,
+                            fallback=lambda fused: fused[0] * 2.0,
+                            max_retries=3)
+        try:
+            results = []
+            done = threading.Event()
+
+            class _Req:
+                def __init__(self):
+                    self.xs = [np.full((1, 4), 5.0, np.float32)]
+                    self.n = 1
+
+                def callback(self, out, err):
+                    results.append((out, err))
+                    if len(results) == 2:
+                        done.set()
+
+            for _ in range(2):
+                ex.submit("k", [np.full((1, 4), 5.0, np.float32)], [_Req()])
+            assert done.wait(5.0)
+        finally:
+            ex.stop()
+        for out, err in results:
+            assert err is None
+            np.testing.assert_allclose(np.asarray(out),
+                                       np.full((1, 4), 10.0), rtol=1e-6)
+        assert TIMERS.count("chaos_fb/sync_fallback_batches") >= 1
+        assert ex.healthy_replicas() == 0
+
+    def test_harvest_hang_watchdog_abandons_and_recovers(self):
+        """A readback wedged past the deadline: the watchdog claims the
+        batch, quarantines the replica, requeues onto the healthy peer,
+        and respawns the harvest stage — the late readback answers
+        nothing (no double-answer)."""
+        fi = FaultInjector()
+        fi.plan("chaos_hang.replica_hang", at=0, payload=1.0)
+        ex = DeviceExecutor(
+            [_sync_replica(lambda xs: xs[0] + 1.0),
+             _sync_replica(lambda xs: xs[0] + 1.0)],
+            buckets=(1, 8), name="chaos_hang",
+            breaker_threshold=3, breaker_cooldown_s=30.0, max_retries=2)
+        answers = []
+        done = threading.Event()
+
+        class _Req:
+            def __init__(self):
+                self.xs = [np.full((1, 4), 1.0, np.float32)]
+                self.n = 1
+
+            def callback(self, out, err):
+                answers.append((out, err))
+                done.set()
+
+        try:
+            with fi:
+                ex.submit("k", [np.full((1, 4), 1.0, np.float32)], [_Req()])
+                # poll the watchdog the way the supervisor does
+                deadline = time.monotonic() + 5.0
+                abandoned = False
+                while time.monotonic() < deadline and not abandoned:
+                    abandoned = ex.check_harvest(0.2)
+                    time.sleep(0.02)
+                assert abandoned
+                assert done.wait(5.0)
+            time.sleep(1.2)  # let the stuck thread wake and discard
+            alive_after_abandon = ex.is_alive()
+        finally:
+            ex.stop()
+        assert alive_after_abandon     # the respawned harvest stage ran
+        assert len(answers) == 1       # exactly one answer, not two
+        out, err = answers[0]
+        assert err is None
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.full((1, 4), 2.0), rtol=1e-6)
+        assert fi.fired["chaos_hang.replica_hang"] == 1
+        assert TIMERS.count("chaos_hang/harvest_abandoned") == 1
+        assert TIMERS.count("chaos_hang/replica_quarantined") == 1
+
+    def test_ensure_threads_respawns_dead_stage(self):
+        ex = DeviceExecutor([_sync_replica(lambda xs: xs[0])],
+                            buckets=(1, 8), name="chaos_threads")
+        try:
+            dead = threading.Thread(target=lambda: None)
+            dead.start()
+            dead.join()
+            ex._dispatch_thread = dead
+            n0 = TIMERS.count("chaos_threads/stage_restarted")
+            ex.ensure_threads()
+            assert ex._dispatch_thread.is_alive()
+            assert TIMERS.count("chaos_threads/stage_restarted") == n0 + 1
+        finally:
+            ex.stop()
+
+    def test_rebuild_slot_resets_breaker(self):
+        def bad(xs):
+            raise RuntimeError("boom")
+
+        ex = DeviceExecutor([_sync_replica(bad)], buckets=(1, 8),
+                            name="chaos_rebuild", breaker_threshold=1,
+                            breaker_cooldown_s=0.05)
+        try:
+            slot = ex._slots[0]
+            slot.breaker.record_failure()
+            assert slot.breaker.health == "quarantined"
+            time.sleep(0.1)
+            assert len(ex.quarantined_slots(min_open_s=0.05)) == 1
+            ex.rebuild_slot(0, _sync_replica(lambda xs: xs[0]))
+            assert ex.healthy_replicas() == 1
+            assert ex._slots[0].rebuilt
+            assert TIMERS.count("chaos_rebuild/replica_rebuilt") == 1
+        finally:
+            ex.stop()
+
+
+@pytest.mark.slow
+class TestServingChaosSoak:
+    def test_soak_all_sites_zero_lost(self):
+        """Saturated load with every serving fault site armed: all
+        records terminate (result or typed error), recovery counters
+        move, health() exposes the replica state machine, and fault-free
+        throughput afterwards is within tolerance of before."""
+
+        def fwd(xs):
+            time.sleep(0.001)
+            return xs[0] * 2.0
+
+        m = InferenceModel(fwd, batch_buckets=(1, 8))
+        q = MemoryQueue()
+        inp, outp = InputQueue(q), OutputQueue(q)
+        cfg = ServingConfig(batch_size=8, poll_timeout_s=0.02,
+                            max_batch_delay_ms=3, decode_workers=2,
+                            replicas=2, breaker_threshold=1,
+                            breaker_cooldown_s=0.15,
+                            supervisor_interval_s=0.05,
+                            harvest_deadline_s=0.3)
+        srv = ClusterServing(m, q, cfg).start()
+        c0 = TIMERS.counts()
+
+        def delta(name):
+            return TIMERS.count(name) - c0.get(name, 0)
+
+        try:
+            # ---- phase 1: fault-free baseline throughput -------------
+            t0 = time.monotonic()
+            for i in range(100):
+                inp.enqueue(uri=f"pre{i}", x=np.full((6,), i, np.float32))
+            pre = _drain(outp, 100)
+            rate_pre = 100 / (time.monotonic() - t0)
+            assert len(pre) == 100
+
+            # ---- phase 2: chaos ------------------------------------
+            fi = FaultInjector()
+            fi.plan("serving.replica_crash", at=(2, 5),
+                    exc=RuntimeError("chip fell over"))
+            fi.plan("serving.replica_hang", at=3, payload=1.0)
+            fi.plan("serving.decode_error", at=(4, 30),
+                    exc=ValueError("bad pixels"))
+            fi.plan("serving.queue_io", at=10,
+                    exc=ConnectionError("result store blip"))
+            fi.plan("serving.respond_error", at=20,
+                    exc=RuntimeError("formatter bug"))
+            # pre-expired records: pushed raw with an old timestamp so
+            # the poller must shed them (typed "expired" errors)
+            from analytics_zoo_tpu.deploy.serving import encode_tensor
+            with fi:
+                for i in range(5):
+                    q.push({"uri": f"old{i}", "ts": time.time() - 10.0,
+                            "ttl_ms": 50.0, "fmt": "tensor",
+                            "x": encode_tensor(
+                                np.zeros((6,), np.float32))})
+                for i in range(150):
+                    inp.enqueue(uri=f"c{i}",
+                                x=np.full((6,), i, np.float32))
+                got = _drain(outp, 155, timeout=60.0)
+            # zero lost: EVERY record answered, result or typed error
+            assert len(got) == 155
+            for i in range(5):
+                v = got[f"old{i}"]
+                assert isinstance(v, dict) and v["code"] == "expired"
+                assert v["uri"] == f"old{i}"
+            errs = {u: v for u, v in got.items()
+                    if isinstance(v, dict) and "error" in v}
+            # planned decode faults produce typed decode errors (the
+            # respond-stage fault may land on one of them and rewrite
+            # its code to "internal", so >= 1, not == 2)
+            assert sum(1 for v in errs.values()
+                       if v["code"] == "decode_error") >= 1
+            # everything else served correctly despite the chaos
+            for u, v in got.items():
+                if u not in errs:
+                    i = int(u[1:]) if u[0] == "c" else int(u[3:])
+                    np.testing.assert_allclose(
+                        np.asarray(v), np.full((6,), 2.0 * i), rtol=1e-6)
+            # counter-verified recovery
+            for site in ("serving.replica_crash", "serving.replica_hang",
+                         "serving.decode_error", "serving.queue_io",
+                         "serving.respond_error"):
+                assert fi.fired.get(site, 0) >= 1, site
+            assert delta("serving/replica_quarantined") >= 1
+            assert delta("serving/shed_expired") >= 5
+            assert delta("serving/errors_returned") >= 7
+            assert delta("serving/batch_retries") >= 1
+
+            # ---- phase 3: fault-free again --------------------------
+            t0 = time.monotonic()
+            for i in range(100):
+                inp.enqueue(uri=f"post{i}", x=np.full((6,), i, np.float32))
+            post = _drain(outp, 100)
+            rate_post = 100 / (time.monotonic() - t0)
+            assert len(post) == 100
+            # the supervisor healed the quarantined replicas: traffic
+            # flowed through a restored replica again
+            deadline = time.monotonic() + 5.0
+            while (delta("serving/replica_restored") < 1
+                   and time.monotonic() < deadline):
+                time.sleep(0.05)
+            assert delta("serving/replica_restored") >= 1
+            # post-chaos throughput within tolerance of pre-chaos
+            assert rate_post >= 0.3 * rate_pre
+
+            h = srv.health()
+            assert h["running"] and h["supervisor"]
+            assert h["replicas"] == 2
+            assert len(h["replica_states"]) == 2
+            assert {s["health"] for s in h["replica_states"]} <= {
+                "healthy", "degraded", "quarantined"}
+            assert "poller" in h["stage_heartbeat_age_s"]
+        finally:
+            srv.stop()
+        assert not srv.is_alive()
+
+
+class TestStageRestart:
+    def test_decode_worker_death_restarted_by_supervisor(self):
+        """A decode worker killed mid-flight is detected and respawned;
+        traffic keeps flowing."""
+        m = InferenceModel(lambda xs: xs[0] * 2.0, batch_buckets=(1, 8))
+        q = MemoryQueue()
+        inp, outp = InputQueue(q), OutputQueue(q)
+        srv = ClusterServing(m, q, ServingConfig(
+            batch_size=8, poll_timeout_s=0.02, decode_workers=2,
+            supervisor_interval_s=0.05)).start()
+        n0 = TIMERS.count("serving/stage_restarted")
+        try:
+            # poison pill: the worker's loop treats None as shutdown
+            srv._decode_q.put(None)
+            deadline = time.monotonic() + 5.0
+            while (TIMERS.count("serving/stage_restarted") <= n0
+                   and time.monotonic() < deadline):
+                time.sleep(0.02)
+            assert TIMERS.count("serving/stage_restarted") > n0
+            for i in range(20):
+                inp.enqueue(uri=f"d{i}", x=np.full((4,), i, np.float32))
+            got = _drain(outp, 20)
+            assert len(got) == 20
+        finally:
+            srv.stop()
