@@ -1,0 +1,30 @@
+"""zoolint — JAX-aware static analyzer + concurrency lint.
+
+Stdlib-``ast`` only (no new dependencies).  Two rule families:
+
+- **JG-\\*** — tracer discipline: impure calls / global mutation / host
+  syncs / Python branches inside jitted scopes, jit-in-loop recompile
+  hazards, unhashable static args, implicit transfers in hot per-batch
+  loops, donated-buffer use-after-dispatch.
+- **THR-\\*** — lock discipline over the threaded serving/robust/train
+  layers: guarded-by inference, blocking calls under a lock,
+  inconsistent lock order, unguarded cross-thread mutation.
+
+Entry points: ``python -m analytics_zoo_tpu.analysis`` (CLI; see
+``--help``) and :func:`analyze` (the pytest gate uses this).  Rule
+catalog and workflow: docs/ANALYSIS.md.
+"""
+
+from analytics_zoo_tpu.analysis.findings import (Finding, Rule,  # noqa: F401
+                                                 all_rules, get_rule)
+from analytics_zoo_tpu.analysis.runner import (analyze,  # noqa: F401
+                                               analyze_file,
+                                               default_root, repo_root)
+from analytics_zoo_tpu.analysis.baseline import (  # noqa: F401
+    diff_against_baseline, findings_to_baseline, load_baseline,
+    save_baseline)
+
+__all__ = ["Finding", "Rule", "all_rules", "get_rule", "analyze",
+           "analyze_file", "default_root", "repo_root",
+           "diff_against_baseline", "findings_to_baseline",
+           "load_baseline", "save_baseline"]

@@ -1,0 +1,120 @@
+"""zoolint CLI — ``python -m analytics_zoo_tpu.analysis``.
+
+Modes:
+
+- (default)            report every finding; exit 1 if any
+- ``--check``          diff against the committed baseline; exit 1 only
+                       on NEW findings (the CI gate)
+- ``--write-baseline`` accept the current findings as the new baseline
+- ``--json``           strict-JSON output for tooling
+- ``--list-rules``     print the rule catalog
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import List
+
+from analytics_zoo_tpu.analysis import baseline as baseline_mod
+from analytics_zoo_tpu.analysis import runner
+from analytics_zoo_tpu.analysis.findings import Finding, all_rules
+
+
+def _render_text(findings: List[Finding], elapsed_s: float,
+                 n_files: int) -> str:
+    lines = [f.render() + (f"\n    fix: {f.hint}" if f.hint else "")
+             for f in findings]
+    counts = {}
+    for f in findings:
+        counts[f.rule] = counts.get(f.rule, 0) + 1
+    summary = ", ".join(f"{r}={n}" for r, n in sorted(counts.items())) \
+        or "clean"
+    lines.append(f"zoolint: {len(findings)} finding(s) in {n_files} "
+                 f"file(s) [{summary}] ({elapsed_s:.2f}s)")
+    return "\n".join(lines)
+
+
+def _render_json(findings: List[Finding], elapsed_s: float,
+                 n_files: int) -> str:
+    counts = {}
+    for f in findings:
+        counts[f.rule] = counts.get(f.rule, 0) + 1
+    return json.dumps({"version": 1,
+                       "files": n_files,
+                       "elapsed_s": round(elapsed_s, 3),
+                       "counts": {k: counts[k] for k in sorted(counts)},
+                       "findings": [f.to_json() for f in findings]},
+                      indent=2, sort_keys=False)
+
+
+def main(argv: List[str] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m analytics_zoo_tpu.analysis",
+        description="zoolint: JAX-aware static analyzer + concurrency "
+                    "lint for analytics_zoo_tpu")
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to lint (default: the installed "
+                         "analytics_zoo_tpu package)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit strict JSON instead of human text")
+    ap.add_argument("--check", action="store_true",
+                    help="gate mode: fail only on findings NOT in the "
+                         "baseline")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline path (default: <repo>/"
+                         "lint_baseline.json)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="accept current findings as the new baseline")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for r in all_rules():
+            print(f"{r.id}: {r.summary}\n    fix: {r.hint}")
+        return 0
+
+    paths = args.paths or [runner.default_root()]
+    baseline_path = args.baseline or os.path.join(runner.repo_root(),
+                                                  "lint_baseline.json")
+    t0 = time.monotonic()
+    files = runner.iter_py_files(paths)
+    findings = runner.analyze(paths)
+    elapsed = time.monotonic() - t0
+
+    if args.write_baseline:
+        baseline_mod.save_baseline(baseline_path, findings)
+        print(f"zoolint: wrote {len(findings)} accepted finding(s) to "
+              f"{baseline_path}")
+        return 0
+
+    if args.check:
+        accepted = baseline_mod.load_baseline(baseline_path)
+        new, stale = baseline_mod.diff_against_baseline(findings, accepted)
+        if args.as_json:
+            print(_render_json(new, elapsed, len(files)))
+        else:
+            if new:
+                print(_render_text(new, elapsed, len(files)))
+            for k in stale:
+                print(f"zoolint: stale baseline entry (no longer "
+                      f"produced): {k}", file=sys.stderr)
+            if not new:
+                print(f"zoolint: OK — no findings beyond baseline "
+                      f"({len(findings)} accepted, {len(files)} files, "
+                      f"{elapsed:.2f}s)")
+        return 1 if new else 0
+
+    if args.as_json:
+        print(_render_json(findings, elapsed, len(files)))
+    else:
+        print(_render_text(findings, elapsed, len(files)))
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,67 @@
+"""zoolint baseline: the committed debt ledger and the diff gate.
+
+``lint_baseline.json`` holds the findings the repo has explicitly
+accepted (ideally: almost none).  Keys are line-number-free —
+``rule :: path :: scope :: message`` with a count — so moving code
+around a file doesn't invalidate entries, but changing the violation
+itself (or adding another of the same shape) does.
+
+``--check`` (the CI gate) fails on any finding not covered by the
+baseline, and *warns* on stale entries so the ledger shrinks as debt
+is paid instead of silently rotting.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Tuple
+
+from analytics_zoo_tpu.analysis.findings import Finding
+
+BASELINE_VERSION = 1
+
+
+def _key_str(key: Tuple[str, str, str, str]) -> str:
+    return " :: ".join(key)
+
+
+def findings_to_baseline(findings: List[Finding]) -> Dict[str, object]:
+    counts: Dict[str, int] = {}
+    for f in findings:
+        k = _key_str(f.key())
+        counts[k] = counts.get(k, 0) + 1
+    return {"version": BASELINE_VERSION,
+            "accepted": {k: counts[k] for k in sorted(counts)}}
+
+
+def load_baseline(path: str) -> Dict[str, int]:
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            data = json.load(fh)
+    except FileNotFoundError:
+        return {}
+    accepted = data.get("accepted", {})
+    return {str(k): int(v) for k, v in accepted.items()}
+
+
+def save_baseline(path: str, findings: List[Finding]) -> None:
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(findings_to_baseline(findings), fh, indent=2,
+                  sort_keys=True)
+        fh.write("\n")
+
+
+def diff_against_baseline(findings: List[Finding], accepted: Dict[str, int]
+                          ) -> Tuple[List[Finding], List[str]]:
+    """(new_findings, stale_keys): findings beyond the accepted counts,
+    and accepted entries the code no longer produces."""
+    remaining = dict(accepted)
+    new: List[Finding] = []
+    for f in findings:
+        k = _key_str(f.key())
+        if remaining.get(k, 0) > 0:
+            remaining[k] -= 1
+        else:
+            new.append(f)
+    stale = [k for k, v in sorted(remaining.items()) if v > 0]
+    return new, stale

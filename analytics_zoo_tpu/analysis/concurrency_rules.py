@@ -1,0 +1,517 @@
+"""zoolint THR-* rules: lock discipline for the threaded layers.
+
+The model is per-class.  A *lock field* is any attribute assigned a
+``threading.Lock/RLock/Condition``; a *held set* is computed for every
+statement by walking function bodies and tracking both acquisition
+forms the repo uses (``with self._lock:`` blocks and linear
+``acquire()``/``release()`` pairs).  Three pieces of inference keep the
+repo's real idioms quiet without weakening the rules:
+
+- methods named ``*_locked`` are contract-documented as "caller holds
+  the lock" and analyzed with the class's locks held;
+- a private method whose every intra-class call site holds lock L is
+  analyzed with L held (``DynamicBatcher._ready`` is only called inside
+  ``with self._cv``);
+- fields of intrinsically thread-safe types (Queue, Event, Condition,
+  Thread, deque...) are exempt from guard inference — their safety is
+  the type's, not a lock's.
+
+Rules:
+
+- **THR-GUARD** — guarded-by: a field written at least once under lock
+  L (outside ``__init__`` construction) is inferred guarded by L; any
+  non-init access without L is flagged.
+- **THR-BLOCK** — blocking call (sleep, Thread.join, queue get/put,
+  Event.wait, device_get/block_until_ready) while holding a lock.
+  ``Condition.wait()`` on the *held* condition is exempt (wait releases
+  it); plain filesystem ops are deliberately out of the default set
+  (the checkpoint manager serializes fs mutation under ``_fs_lock`` by
+  design).
+- **THR-ORDER** — the same two locks nested in opposite orders anywhere
+  in one module.
+- **THR-SHARED-MUT** — a plain field written from a thread-target
+  function (``threading.Thread(target=...)`` / executor ``submit``)
+  with no lock, and accessed from non-thread code: readers can see
+  stale state and compound updates race.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from analytics_zoo_tpu.analysis.findings import Finding
+from analytics_zoo_tpu.analysis.scopes import (FunctionInfo, ModuleModel,
+                                               dotted_name)
+
+LockId = Tuple[str, str]  # (class qualname or '' for module-level, name)
+
+_LOCK_TAILS = {"Lock", "RLock", "Condition"}
+_SAFE_TAILS = {"Queue", "SimpleQueue", "LifoQueue", "PriorityQueue",
+               "Event", "Semaphore", "BoundedSemaphore", "Barrier",
+               "Thread", "Timer", "deque", "local", "ThreadPoolExecutor"}
+_QUEUE_TAILS = {"Queue", "SimpleQueue", "LifoQueue", "PriorityQueue"}
+_EVENT_TAILS = {"Event"}
+_THREAD_TAILS = {"Thread", "Timer"}
+
+
+def _ctor_tail(value: ast.AST) -> str:
+    if isinstance(value, ast.Call):
+        return dotted_name(value.func).rpartition(".")[2]
+    return ""
+
+
+@dataclasses.dataclass
+class Access:
+    attr: str
+    is_store: bool
+    node: ast.AST
+    func_qual: str
+    held: FrozenSet[LockId]
+    is_init: bool
+    is_thread_ctx: bool
+
+
+@dataclasses.dataclass
+class BlockingCall:
+    node: ast.AST
+    func_qual: str
+    held: FrozenSet[LockId]
+    what: str
+
+
+@dataclasses.dataclass
+class OrderEdge:
+    outer: LockId
+    inner: LockId
+    node: ast.AST
+    func_qual: str
+
+
+class ClassModel:
+    def __init__(self, qual: str, node: ast.ClassDef):
+        self.qual = qual
+        self.node = node
+        self.methods: Set[str] = set()
+        self.locks: Set[str] = set()
+        self.safe_attrs: Set[str] = set()
+        self.queue_attrs: Set[str] = set()
+        self.event_attrs: Set[str] = set()
+        self.thread_attrs: Set[str] = set()
+        self.accesses: Dict[str, List[Access]] = {}
+
+
+class ConcurrencyAnalyzer:
+    def __init__(self, model: ModuleModel):
+        self.model = model
+        self.classes: Dict[str, ClassModel] = {}
+        self.module_locks: Set[str] = set()
+        self.thread_ctx: Set[str] = set()       # function qualnames
+        self.base_held: Dict[str, FrozenSet[LockId]] = {}
+        self.call_sites: Dict[str, List[FrozenSet[LockId]]] = {}
+        self.blocking: List[BlockingCall] = []
+        self.order_edges: List[OrderEdge] = []
+        self._build_class_models()
+        self._find_thread_contexts()
+        self._infer_base_held()
+
+    # -- model building ------------------------------------------------------
+
+    def _build_class_models(self) -> None:
+        for stmt in self.model.tree.body:
+            if isinstance(stmt, ast.Assign) and \
+                    _ctor_tail(stmt.value) in _LOCK_TAILS:
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        self.module_locks.add(t.id)
+        for qual, cnode in self.model.classes.items():
+            cm = ClassModel(qual, cnode)
+            self.classes[qual] = cm
+            for fq, info in self.model.functions.items():
+                if info.parent_qual == qual:
+                    cm.methods.add(info.name)
+            for node in ast.walk(cnode):
+                if not isinstance(node, ast.Assign):
+                    continue
+                tail = _ctor_tail(node.value)
+                if not tail:
+                    continue
+                for t in node.targets:
+                    if isinstance(t, ast.Attribute) and \
+                            isinstance(t.value, ast.Name) and \
+                            t.value.id == "self":
+                        if tail in _LOCK_TAILS:
+                            cm.locks.add(t.attr)
+                        elif tail in _SAFE_TAILS:
+                            cm.safe_attrs.add(t.attr)
+                            if tail in _QUEUE_TAILS:
+                                cm.queue_attrs.add(t.attr)
+                            elif tail in _EVENT_TAILS:
+                                cm.event_attrs.add(t.attr)
+                            elif tail in _THREAD_TAILS:
+                                cm.thread_attrs.add(t.attr)
+
+    def _find_thread_contexts(self) -> None:
+        for node in ast.walk(self.model.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            tail = dotted_name(node.func).rpartition(".")[2]
+            target: Optional[ast.AST] = None
+            if tail in _THREAD_TAILS:
+                for kw in node.keywords:
+                    if kw.arg == "target":
+                        target = kw.value
+            elif tail == "submit" and node.args:
+                target = node.args[0]
+            if target is None:
+                continue
+            q = self.model.resolve_callable(target,
+                                            self.model.qualname_of(node))
+            if q:
+                self.thread_ctx.add(q)
+        # nested defs inside a thread target run on that thread too
+        changed = True
+        while changed:
+            changed = False
+            for fq, info in self.model.functions.items():
+                if fq not in self.thread_ctx and \
+                        info.parent_qual in self.thread_ctx:
+                    self.thread_ctx.add(fq)
+                    changed = True
+
+    def _class_of(self, info: FunctionInfo) -> Optional[ClassModel]:
+        return self.classes.get(info.class_qual)
+
+    def _infer_base_held(self) -> None:
+        for fq, info in self.model.functions.items():
+            cm = self._class_of(info)
+            if cm and info.name.endswith("_locked"):
+                self.base_held[fq] = frozenset(
+                    (cm.qual, lk) for lk in cm.locks)
+            else:
+                self.base_held[fq] = frozenset()
+        # fixpoint: a private method whose every intra-class call site
+        # holds L runs with L held
+        for _ in range(3):
+            self.call_sites = {}
+            self._walk_all(collect_events=False)
+            changed = False
+            for fq, sites in self.call_sites.items():
+                info = self.model.functions.get(fq)
+                if info is None or not info.name.startswith("_") or \
+                        info.name.startswith("__") or not sites:
+                    continue
+                common = frozenset.intersection(*sites)
+                if common - self.base_held[fq]:
+                    self.base_held[fq] = self.base_held[fq] | common
+                    changed = True
+            if not changed:
+                break
+
+    # -- the walk --------------------------------------------------------------
+
+    def run(self) -> None:
+        self.blocking = []
+        self.order_edges = []
+        self._walk_all(collect_events=True)
+
+    def _walk_all(self, collect_events: bool) -> None:
+        self._collect = collect_events
+        for fq, info in self.model.functions.items():
+            self._cur_fq = fq
+            self._cur_info = info
+            self._cur_cm = self._class_of(info)
+            self._cur_init = (self._cur_cm is not None and
+                              fq == f"{self._cur_cm.qual}.__init__")
+            self._cur_thread = fq in self.thread_ctx
+            self._aliases = self._local_aliases(info)
+            self._walk_stmts(info.node.body, self.base_held[fq])
+
+    def _local_aliases(self, info: FunctionInfo) -> Dict[str, Tuple[str,
+                                                                    str]]:
+        """name -> ('lock', id) / ('queue'|'event'|'thread', '') for
+        simple local binds (``t = self._thread``, ``q = queue.Queue()``)."""
+        out: Dict[str, Tuple[str, str]] = {}
+        cm = self._cur_cm
+        for node in ast.walk(info.node):
+            if not (isinstance(node, ast.Assign) and
+                    len(node.targets) == 1 and
+                    isinstance(node.targets[0], ast.Name)):
+                continue
+            name = node.targets[0].id
+            v = node.value
+            tail = _ctor_tail(v)
+            if tail in _LOCK_TAILS:
+                out[name] = ("lock", f"local:{name}")
+            elif tail in _QUEUE_TAILS:
+                out[name] = ("queue", "")
+            elif tail in _EVENT_TAILS:
+                out[name] = ("event", "")
+            elif tail in _THREAD_TAILS:
+                out[name] = ("thread", "")
+            elif cm and isinstance(v, ast.Attribute) and \
+                    isinstance(v.value, ast.Name) and v.value.id == "self":
+                if v.attr in cm.locks:
+                    out[name] = ("lock", v.attr)
+                elif v.attr in cm.queue_attrs:
+                    out[name] = ("queue", "")
+                elif v.attr in cm.event_attrs:
+                    out[name] = ("event", "")
+                elif v.attr in cm.thread_attrs:
+                    out[name] = ("thread", "")
+        return out
+
+    def _resolve_lock(self, expr: ast.AST) -> Optional[LockId]:
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name) and \
+                expr.value.id == "self" and self._cur_cm and \
+                expr.attr in self._cur_cm.locks:
+            return (self._cur_cm.qual, expr.attr)
+        if isinstance(expr, ast.Name):
+            if expr.id in self.module_locks:
+                return ("", expr.id)
+            alias = self._aliases.get(expr.id)
+            if alias and alias[0] == "lock":
+                cm = self._cur_cm
+                if cm and alias[1] in cm.locks:
+                    return (cm.qual, alias[1])
+                return ("", alias[1])
+        return None
+
+    def _obj_kind(self, expr: ast.AST) -> str:
+        """'queue' / 'event' / 'thread' / 'lock' / '' for a call
+        receiver."""
+        cm = self._cur_cm
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name) and expr.value.id == "self" \
+                and cm:
+            if expr.attr in cm.queue_attrs:
+                return "queue"
+            if expr.attr in cm.event_attrs:
+                return "event"
+            if expr.attr in cm.thread_attrs:
+                return "thread"
+            if expr.attr in cm.locks:
+                return "lock"
+        if isinstance(expr, ast.Name):
+            alias = self._aliases.get(expr.id)
+            if alias:
+                return alias[0]
+        return ""
+
+    def _acq_rel(self, stmt: ast.AST) -> Tuple[Optional[LockId],
+                                               Optional[str]]:
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call) \
+                and isinstance(stmt.value.func, ast.Attribute) and \
+                stmt.value.func.attr in ("acquire", "release"):
+            lock = self._resolve_lock(stmt.value.func.value)
+            if lock is not None:
+                return lock, stmt.value.func.attr
+        return None, None
+
+    def _walk_stmts(self, body: List[ast.stmt],
+                    held: FrozenSet[LockId]) -> None:
+        extra: FrozenSet[LockId] = frozenset()
+        for stmt in body:
+            cur = held | extra
+            lock, op = self._acq_rel(stmt)
+            self._visit(stmt, cur)
+            if lock is not None and op == "acquire":
+                for outer in cur:
+                    if outer != lock:
+                        self.order_edges.append(
+                            OrderEdge(outer, lock, stmt, self._cur_fq))
+                extra = extra | {lock}
+            elif lock is not None and op == "release":
+                extra = extra - {lock}
+                if lock in held:
+                    held = held - {lock}
+
+    def _visit(self, node: ast.AST, held: FrozenSet[LockId]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Lambda)):
+            return  # separate execution context
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            new: Set[LockId] = set()
+            for item in node.items:
+                lock = self._resolve_lock(item.context_expr)
+                self._scan_expr(item.context_expr, held)
+                if lock is not None:
+                    for outer in (held | new):
+                        if outer != lock:
+                            self.order_edges.append(
+                                OrderEdge(outer, lock, item.context_expr,
+                                          self._cur_fq))
+                    new.add(lock)
+            self._walk_stmts(node.body, held | frozenset(new))
+            return
+        self._event(node, held)
+        for _field, value in ast.iter_fields(node):
+            if isinstance(value, list):
+                if value and isinstance(value[0], ast.stmt):
+                    self._walk_stmts(value, held)
+                else:
+                    for v in value:
+                        if isinstance(v, ast.AST):
+                            self._visit(v, held)
+            elif isinstance(value, ast.AST):
+                self._visit(value, held)
+
+    def _scan_expr(self, node: ast.AST, held: FrozenSet[LockId]) -> None:
+        for n in ast.walk(node):
+            self._event(n, held)
+
+    # -- event recording ---------------------------------------------------------
+
+    def _event(self, node: ast.AST, held: FrozenSet[LockId]) -> None:
+        cm = self._cur_cm
+        if self._collect and isinstance(node, ast.Attribute) and \
+                isinstance(node.value, ast.Name) and \
+                node.value.id == "self" and cm is not None:
+            attr = node.attr
+            if attr not in cm.methods and attr not in cm.locks:
+                cm.accesses.setdefault(attr, []).append(Access(
+                    attr, isinstance(node.ctx, (ast.Store, ast.Del)),
+                    node, self._cur_fq, held, self._cur_init,
+                    self._cur_thread))
+        if isinstance(node, ast.Call):
+            if not self._collect:
+                q = self.model.resolve_callable(node.func, self._cur_fq)
+                if q is not None:
+                    self.call_sites.setdefault(q, []).append(held)
+            elif held:
+                self._check_blocking(node, held)
+
+    def _check_blocking(self, node: ast.Call,
+                        held: FrozenSet[LockId]) -> None:
+        dn = dotted_name(node.func)
+        what = ""
+        if dn in ("time.sleep", "sleep"):
+            what = "time.sleep"
+        elif dn in ("jax.device_get", "device_get"):
+            what = "jax.device_get (device sync)"
+        elif isinstance(node.func, ast.Attribute):
+            attr = node.func.attr
+            kind = self._obj_kind(node.func.value)
+            if attr == "block_until_ready":
+                what = ".block_until_ready() (device sync)"
+            elif attr == "join" and kind == "thread":
+                what = "Thread.join"
+            elif attr in ("get", "put") and kind == "queue":
+                what = f"queue.{attr}"
+            elif attr in ("wait", "wait_for"):
+                if kind == "event":
+                    what = "Event.wait"
+                elif kind == "lock":
+                    # Condition.wait on the HELD condition releases it —
+                    # the one blocking call that is correct under a lock
+                    lock = self._resolve_lock(node.func.value)
+                    if lock is not None and lock not in held:
+                        what = f"wait on {node.func.attr}"
+        if what:
+            self.blocking.append(BlockingCall(node, self._cur_fq, held,
+                                              what))
+
+    # -- findings ------------------------------------------------------------------
+
+    def findings(self) -> List[Finding]:
+        out: List[Finding] = []
+        self._guard_findings(out)
+        self._block_findings(out)
+        self._order_findings(out)
+        self._shared_mut_findings(out)
+        return out
+
+    def _mk(self, rule: str, node: ast.AST, fq: str,
+            message: str) -> Finding:
+        return Finding(rule, self.model.relpath,
+                       getattr(node, "lineno", 0),
+                       getattr(node, "col_offset", 0), fq, message)
+
+    @staticmethod
+    def _lockname(lock: LockId) -> str:
+        return f"{lock[0]}.{lock[1]}" if lock[0] else lock[1]
+
+    def _guards(self, cm: ClassModel) -> Dict[str, LockId]:
+        """attr -> inferred guarding lock (written >=1x under it)."""
+        guards: Dict[str, LockId] = {}
+        for attr, accs in cm.accesses.items():
+            if attr in cm.safe_attrs:
+                continue
+            writes = [a for a in accs if a.is_store and not a.is_init]
+            counts: Dict[LockId, int] = {}
+            for a in writes:
+                for lk in a.held:
+                    if lk[0] == cm.qual:  # own-class lock only
+                        counts[lk] = counts.get(lk, 0) + 1
+            if counts:
+                guards[attr] = max(counts, key=lambda k: counts[k])
+        return guards
+
+    def _guard_findings(self, out: List[Finding]) -> None:
+        for cm in self.classes.values():
+            if not cm.locks:
+                continue
+            for attr, guard in sorted(self._guards(cm).items()):
+                for a in cm.accesses[attr]:
+                    if a.is_init or guard in a.held:
+                        continue
+                    verb = "written" if a.is_store else "read"
+                    out.append(self._mk(
+                        "THR-GUARD", a.node, a.func_qual,
+                        f"`self.{attr}` is guarded by "
+                        f"`{self._lockname(guard)}` elsewhere but "
+                        f"{verb} here without it"))
+
+    def _block_findings(self, out: List[Finding]) -> None:
+        for b in self.blocking:
+            locks = ", ".join(sorted(self._lockname(lk) for lk in b.held))
+            out.append(self._mk(
+                "THR-BLOCK", b.node, b.func_qual,
+                f"blocking call {b.what} while holding `{locks}`"))
+
+    def _order_findings(self, out: List[Finding]) -> None:
+        pairs: Dict[Tuple[LockId, LockId], List[OrderEdge]] = {}
+        for e in self.order_edges:
+            pairs.setdefault((e.outer, e.inner), []).append(e)
+        seen: Set[int] = set()
+        for (a, b), edges in sorted(pairs.items(),
+                                    key=lambda kv: str(kv[0])):
+            if (b, a) not in pairs:
+                continue
+            for e in edges:
+                if id(e.node) in seen:
+                    continue
+                seen.add(id(e.node))
+                out.append(self._mk(
+                    "THR-ORDER", e.node, e.func_qual,
+                    f"acquires `{self._lockname(b)}` while holding "
+                    f"`{self._lockname(a)}`; another path nests them in "
+                    f"the opposite order (deadlock risk)"))
+
+    def _shared_mut_findings(self, out: List[Finding]) -> None:
+        for cm in self.classes.values():
+            guards = self._guards(cm)
+            for attr, accs in sorted(cm.accesses.items()):
+                if attr in cm.safe_attrs or attr in guards:
+                    continue
+                thread_writes = [a for a in accs if a.is_store and
+                                 a.is_thread_ctx and not a.held]
+                outside = [a for a in accs if not a.is_thread_ctx and
+                           not a.is_init]
+                if not thread_writes or not outside:
+                    continue
+                where = sorted({a.func_qual for a in outside})
+                for a in thread_writes:
+                    out.append(self._mk(
+                        "THR-SHARED-MUT", a.node, a.func_qual,
+                        f"`self.{attr}` is written on a background "
+                        f"thread with no lock but accessed from "
+                        f"{', '.join(where[:3])}"))
+
+
+def check_concurrency(model: ModuleModel) -> List[Finding]:
+    ana = ConcurrencyAnalyzer(model)
+    ana.run()
+    return ana.findings()

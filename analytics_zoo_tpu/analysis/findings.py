@@ -1,0 +1,212 @@
+"""zoolint data model: rules, findings, inline suppressions.
+
+A *rule* is a static check with a stable id (``JG-*`` for JAX/tracer
+rules, ``THR-*`` for concurrency rules), a one-line description and a
+fix-it hint.  A *finding* is one concrete violation: rule + location +
+scope + message.  Findings are plain data so every consumer (human
+report, strict JSON, baseline diff, the pytest gate) works off the same
+objects.
+
+Suppressions are inline comments on the offending line::
+
+    self.records_served += n  # zoolint: disable=THR-GUARD(sampled stat)
+
+Multiple rules separate with commas; the parenthesized reason is
+required — an unexplained suppression is itself a finding
+(``LINT-BARE-DISABLE``), because "why is this OK?" is exactly what the
+next reader needs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Dict, List, Optional, Tuple
+
+# --------------------------------------------------------------------------
+# rules
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    id: str
+    summary: str
+    hint: str
+
+
+_RULES: Dict[str, Rule] = {}
+
+
+def register(rule: Rule) -> Rule:
+    _RULES[rule.id] = rule
+    return rule
+
+
+def all_rules() -> List[Rule]:
+    return [_RULES[k] for k in sorted(_RULES)]
+
+
+def get_rule(rule_id: str) -> Optional[Rule]:
+    return _RULES.get(rule_id)
+
+
+# JAX / tracer rules -------------------------------------------------------
+JG_IMPURE_CALL = register(Rule(
+    "JG-IMPURE-CALL",
+    "side-effecting host call inside a jitted/traced scope",
+    "side effects run once at trace time, not per step; move the call "
+    "outside the jitted function or use jax.debug.print/jax.debug.callback"))
+JG_GLOBAL_MUT = register(Rule(
+    "JG-GLOBAL-MUT",
+    "global-state mutation inside a jitted/traced scope",
+    "tracer functions must be pure; thread the value through the carry "
+    "or return it instead of mutating a global"))
+JG_HOST_SYNC = register(Rule(
+    "JG-HOST-SYNC",
+    "host materialization of a traced value inside a jitted scope",
+    "float()/int()/.item()/np.asarray() on a tracer aborts tracing or "
+    "forces a device sync; keep the value as a jnp array and convert "
+    "after the jitted call returns"))
+JG_TRACED_BRANCH = register(Rule(
+    "JG-TRACED-BRANCH",
+    "Python control flow on a traced value inside a jitted scope",
+    "`if`/`while` on a tracer raises ConcretizationTypeError or bakes "
+    "the branch at trace time; use jax.lax.cond / jnp.where / "
+    "lax.while_loop, or mark the argument static"))
+JG_JIT_IN_LOOP = register(Rule(
+    "JG-JIT-IN-LOOP",
+    "jax.jit(...) constructed inside a loop body",
+    "a fresh jit wrapper per iteration recompiles every time; hoist the "
+    "jax.jit call out of the loop and reuse the compiled handle"))
+JG_STATIC_UNSTABLE = register(Rule(
+    "JG-STATIC-UNSTABLE",
+    "unhashable literal passed in a static_argnums position",
+    "static args are hashed into the compilation cache key; lists/dicts/"
+    "sets are unhashable (TypeError) — pass a tuple or a hashable config"))
+JG_TRANSFER_HOT = register(Rule(
+    "JG-TRANSFER-HOT",
+    "implicit/blocking device->host transfer inside a hot per-batch loop",
+    "device_get/np.asarray/float()/block_until_ready inside the per-batch "
+    "loop serializes host and device; batch the sync at epoch granularity "
+    "or keep the value on device"))
+JG_DONATE_REUSE = register(Rule(
+    "JG-DONATE-REUSE",
+    "donated buffer read after being passed to a donating jitted call",
+    "donate_argnums invalidates the argument's buffer at dispatch; "
+    "rebind the name from the call's result (x, ... = step(x, ...)) "
+    "before reading it again"))
+
+# concurrency rules --------------------------------------------------------
+THR_GUARD = register(Rule(
+    "THR-GUARD",
+    "field accessed without the lock that guards its other accesses",
+    "every access to a lock-guarded field must hold the same lock; wrap "
+    "the access in `with self.<lock>:` (or document why the race is "
+    "benign with a zoolint disable + reason)"))
+THR_BLOCK = register(Rule(
+    "THR-BLOCK",
+    "blocking call while holding a lock",
+    "sleep/join/queue I/O/device sync under a lock stalls every other "
+    "thread contending for it; move the blocking call outside the "
+    "critical section and re-acquire afterwards"))
+THR_ORDER = register(Rule(
+    "THR-ORDER",
+    "locks acquired in inconsistent order across the module",
+    "two code paths nesting the same locks in opposite order can "
+    "deadlock; pick one global order and re-nest the later site"))
+THR_SHARED_MUT = register(Rule(
+    "THR-SHARED-MUT",
+    "plain field shared between a background thread and other methods "
+    "with no lock",
+    "a field written from a Thread target and read elsewhere needs a "
+    "lock (or an Event/Queue) — CPython won't tear the write, but "
+    "readers can see arbitrarily stale state and compound updates race"))
+
+# meta rule ----------------------------------------------------------------
+LINT_BARE_DISABLE = register(Rule(
+    "LINT-BARE-DISABLE",
+    "zoolint disable comment without a reason",
+    "write `# zoolint: disable=RULE(why this is safe)` — the reason is "
+    "the documentation the next reader needs"))
+
+
+# --------------------------------------------------------------------------
+# findings
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str          # repo-relative, forward slashes
+    line: int
+    col: int
+    scope: str         # dotted qualname of the enclosing def/class ('' = module)
+    message: str
+
+    @property
+    def hint(self) -> str:
+        r = get_rule(self.rule)
+        return r.hint if r else ""
+
+    def key(self) -> Tuple[str, str, str, str]:
+        """Line-number-free identity used by the baseline, so unrelated
+        edits moving code up/down don't invalidate baseline entries."""
+        return (self.rule, self.path, self.scope, self.message)
+
+    def to_json(self) -> Dict[str, object]:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "col": self.col, "scope": self.scope,
+                "message": self.message, "hint": self.hint}
+
+    def render(self) -> str:
+        where = f"{self.path}:{self.line}:{self.col}"
+        scope = f" [{self.scope}]" if self.scope else ""
+        return f"{where}: {self.rule}{scope}: {self.message}"
+
+
+# --------------------------------------------------------------------------
+# suppressions
+# --------------------------------------------------------------------------
+
+_DISABLE_RE = re.compile(r"#\s*zoolint:\s*disable=([^#\n]*)")
+_RULE_WITH_REASON_RE = re.compile(  # reason may nest one paren level
+    r"\s*([A-Z][A-Z0-9-]*)\s*"
+    r"(?:\(((?:[^()]|\([^()]*\))*)\))?\s*(?:,|$)")
+
+
+class Suppressions:
+    """Per-line ``# zoolint: disable=RULE(reason)`` map for one file."""
+
+    def __init__(self, source: str):
+        # line number (1-based) -> {rule_id: reason or None}
+        self.by_line: Dict[int, Dict[str, Optional[str]]] = {}
+        for i, text in enumerate(source.splitlines(), start=1):
+            m = _DISABLE_RE.search(text)
+            if not m:
+                continue
+            rules: Dict[str, Optional[str]] = {}
+            for rm in _RULE_WITH_REASON_RE.finditer(m.group(1)):
+                rid, reason = rm.group(1), rm.group(2)
+                rules[rid] = reason.strip() if reason else None
+            if rules:
+                self.by_line[i] = rules
+
+    def suppressed(self, finding: Finding) -> bool:
+        rules = self.by_line.get(finding.line)
+        if not rules:
+            return False
+        return finding.rule in rules or "ALL" in rules
+
+    def bare_disable_findings(self, path: str) -> List[Finding]:
+        """A disable without a reason is itself reported."""
+        out = []
+        for line, rules in sorted(self.by_line.items()):
+            for rid, reason in rules.items():
+                if not reason:
+                    out.append(Finding(
+                        LINT_BARE_DISABLE.id, path, line, 0, "",
+                        f"disable={rid} has no reason; write "
+                        f"disable={rid}(<why this is safe>)"))
+        return out

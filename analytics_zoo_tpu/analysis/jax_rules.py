@@ -1,0 +1,408 @@
+"""zoolint JG-* rules: tracer purity, recompile hazards, host transfers.
+
+All rules key off :class:`~analytics_zoo_tpu.analysis.scopes.ModuleModel`:
+the jitted-scope fixpoint says *where* tracer semantics apply, and a
+lightweight per-function taint pass says *which names* hold tracers
+(params minus static_argnums, propagated through assignments;
+``.shape``/``.dtype``/``len()`` un-taint because they are static at
+trace time — ``np.sqrt(head_dim)`` must stay quiet).
+
+JG-TRANSFER-HOT applies outside jitted scopes, but only in *hot
+modules* — the per-batch/per-request paths (estimator, prefetch,
+serving) where one implicit sync per iteration serializes host and
+device.  A file can also opt in with a ``# zoolint: hot-path`` comment
+(the fixture corpus uses this).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set
+
+from analytics_zoo_tpu.analysis.findings import Finding
+from analytics_zoo_tpu.analysis.scopes import (ModuleModel, dotted_name,
+                                               walk_own)
+
+# modules whose per-batch loops are performance-critical by construction
+HOT_SUFFIXES = ("train/estimator.py", "train/prefetch.py",
+                "deploy/serving.py")
+_HOT_MARKER = re.compile(r"#\s*zoolint:\s*hot-path")
+
+# step-handle names the estimator/serving layers bind compiled fns to
+_STEP_NAME_RE = re.compile(
+    r"^(_train_step|_multi_step|_eval_step|_predict_step|_resident_epoch"
+    r"|step_fn|epoch_fn)$")
+
+_IMPURE_EXACT = {"print", "input", "open", "breakpoint", "exec", "eval"}
+_IMPURE_PREFIXES = ("time.", "logging.", "logger.", "os.", "sys.",
+                    "random.", "np.random.", "numpy.random.", "TIMERS.",
+                    "count_event", "warnings.warn")
+_PURE_EXEMPT_PREFIXES = ("jax.debug.",)
+
+_SYNC_FUNCS = {"float", "int", "bool", "complex",
+               "np.asarray", "np.array", "numpy.asarray", "numpy.array",
+               "onp.asarray", "onp.array", "jax.device_get", "device_get"}
+_SYNC_METHODS = {"item", "tolist", "numpy", "block_until_ready"}
+
+# attribute reads that are static at trace time (break the taint chain)
+_STATIC_ATTRS = {"shape", "dtype", "ndim", "size", "aval", "sharding",
+                 "weak_type"}
+_UNTAINT_CALLS = {"len", "isinstance", "type", "hasattr", "getattr",
+                  "id", "repr", "str"}
+
+
+def is_hot_module(model: ModuleModel) -> bool:
+    rel = model.relpath.replace("\\", "/")
+    return rel.endswith(HOT_SUFFIXES) or \
+        bool(_HOT_MARKER.search(model.source))
+
+
+# --------------------------------------------------------------------------
+# taint
+# --------------------------------------------------------------------------
+
+
+def _target_names(target: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    for n in ast.walk(target):
+        if isinstance(n, ast.Name):
+            out.add(n.id)
+    return out
+
+
+_RETURNS_TAINTED_CACHE = "_zoolint_returns_tainted"
+
+
+def _returns_tainted(model: ModuleModel, qual: str) -> bool:
+    """Does a traced callee's return value carry taint when its params
+    do?  A predicate like ``_is_qleaf`` returns a static bool however
+    traced its argument is, so its callers' branches stay quiet."""
+    cache: Dict[str, bool] = getattr(model, _RETURNS_TAINTED_CACHE, None)
+    if cache is None:
+        cache = {}
+        setattr(model, _RETURNS_TAINTED_CACHE, cache)
+    if qual in cache:
+        return cache[qual]
+    cache[qual] = True  # cycle guard: assume tainted while computing
+    taint = _Taint(model, qual)
+    info = model.functions[qual]
+    tainted = False
+    for n in walk_own(info.node):
+        if isinstance(n, ast.Return) and n.value is not None and \
+                taint.expr_tainted(n.value):
+            tainted = True
+            break
+    cache[qual] = tainted
+    return tainted
+
+
+class _Taint:
+    """Names holding traced values inside one jitted function."""
+
+    def __init__(self, model: ModuleModel, qual: str):
+        self.model = model
+        self.qual = qual
+        self.info = model.functions[qual]
+        self.names: Set[str] = model.traced_params(qual)
+        self._propagate()
+
+    def expr_tainted(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in self.names
+        if isinstance(node, ast.Attribute):
+            if node.attr in _STATIC_ATTRS:
+                return False
+            return self.expr_tainted(node.value)
+        if isinstance(node, ast.Call):
+            dn = dotted_name(node.func)
+            if dn in _UNTAINT_CALLS:
+                return False
+            if isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in _SYNC_METHODS:
+                return False  # result lives on host (the sync rule fires)
+            # taint flows through array ops and through defs we know are
+            # traced; NOT through arbitrary helpers (a pytree-structure
+            # predicate like `_is_qleaf(x)` returns a static Python bool
+            # even when x is a tracer) — precision over recall here
+            if not dn.startswith(("jnp.", "jax.", "lax.")):
+                target = self.model.resolve_callable(node.func, self.qual)
+                if target not in self.model.jitted or \
+                        not _returns_tainted(self.model, target):
+                    return False
+            return any(self.expr_tainted(a) for a in node.args) or \
+                any(self.expr_tainted(k.value) for k in node.keywords)
+        if isinstance(node, (ast.BinOp,)):
+            return self.expr_tainted(node.left) or \
+                self.expr_tainted(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self.expr_tainted(node.operand)
+        if isinstance(node, ast.BoolOp):
+            return any(self.expr_tainted(v) for v in node.values)
+        if isinstance(node, ast.Compare):
+            return self.expr_tainted(node.left) or \
+                any(self.expr_tainted(c) for c in node.comparators)
+        if isinstance(node, ast.IfExp):
+            return self.expr_tainted(node.body) or \
+                self.expr_tainted(node.orelse)
+        if isinstance(node, ast.Subscript):
+            return self.expr_tainted(node.value)
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return any(self.expr_tainted(e) for e in node.elts)
+        if isinstance(node, ast.Starred):
+            return self.expr_tainted(node.value)
+        return False
+
+    def _propagate(self) -> None:
+        # fixpoint over assignments (loops create forward references)
+        for _ in range(8):
+            changed = False
+            for node in walk_own(self.info.node):
+                tgts: Set[str] = set()
+                if isinstance(node, ast.Assign) and \
+                        self.expr_tainted(node.value):
+                    for t in node.targets:
+                        tgts |= _target_names(t)
+                elif isinstance(node, ast.AugAssign) and \
+                        (self.expr_tainted(node.value) or
+                         self.expr_tainted(node.target)):
+                    tgts |= _target_names(node.target)
+                elif isinstance(node, ast.AnnAssign) and node.value is not \
+                        None and self.expr_tainted(node.value):
+                    tgts |= _target_names(node.target)
+                elif isinstance(node, (ast.For, ast.AsyncFor)) and \
+                        self.expr_tainted(node.iter):
+                    tgts |= _target_names(node.target)
+                new = tgts - self.names
+                if new:
+                    self.names |= new
+                    changed = True
+            if not changed:
+                break
+
+
+# --------------------------------------------------------------------------
+# rule passes
+# --------------------------------------------------------------------------
+
+
+def _finding(model: ModuleModel, rule: str, node: ast.AST, scope: str,
+             message: str) -> Finding:
+    return Finding(rule, model.relpath, getattr(node, "lineno", 0),
+                   getattr(node, "col_offset", 0), scope, message)
+
+
+def _check_jitted_scope(model: ModuleModel, qual: str,
+                        out: List[Finding]) -> None:
+    info = model.functions[qual]
+    jit = model.jitted[qual]
+    taint = _Taint(model, qual)
+
+    for node in walk_own(info.node):
+        # JG-GLOBAL-MUT -----------------------------------------------------
+        if isinstance(node, ast.Global):
+            out.append(_finding(
+                model, "JG-GLOBAL-MUT", node, qual,
+                f"`global {', '.join(node.names)}` inside jitted scope "
+                f"({jit.reason}); tracer functions must be pure"))
+            continue
+
+        # JG-TRACED-BRANCH ---------------------------------------------------
+        if isinstance(node, (ast.If, ast.While)) and \
+                taint.expr_tainted(node.test):
+            kw = "while" if isinstance(node, ast.While) else "if"
+            out.append(_finding(
+                model, "JG-TRACED-BRANCH", node, qual,
+                f"Python `{kw}` on a traced value inside jitted scope "
+                f"({jit.reason}); use lax.cond/jnp.where"))
+
+        if not isinstance(node, ast.Call):
+            continue
+        dn = dotted_name(node.func)
+
+        # JG-IMPURE-CALL ------------------------------------------------------
+        if dn and not dn.startswith(_PURE_EXEMPT_PREFIXES):
+            impure = dn in _IMPURE_EXACT or dn.startswith(_IMPURE_PREFIXES)
+            if impure:
+                out.append(_finding(
+                    model, "JG-IMPURE-CALL", node, qual,
+                    f"call to `{dn}` inside jitted scope ({jit.reason}) "
+                    f"runs at trace time only"))
+                continue
+
+        # JG-HOST-SYNC ---------------------------------------------------------
+        if dn in _SYNC_FUNCS and node.args and \
+                taint.expr_tainted(node.args[0]):
+            out.append(_finding(
+                model, "JG-HOST-SYNC", node, qual,
+                f"`{dn}()` on a traced value inside jitted scope "
+                f"({jit.reason})"))
+        elif isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _SYNC_METHODS and \
+                taint.expr_tainted(node.func.value):
+            out.append(_finding(
+                model, "JG-HOST-SYNC", node, qual,
+                f"`.{node.func.attr}()` on a traced value inside jitted "
+                f"scope ({jit.reason})"))
+
+
+def _check_jit_in_loop(model: ModuleModel, out: List[Finding]) -> None:
+    for node in ast.walk(model.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if model._is_jit_expr(node) is not node:
+            continue
+        cur = model.parents.get(node)
+        while cur is not None and not isinstance(
+                cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef,
+                      ast.Module)):
+            if isinstance(cur, (ast.For, ast.AsyncFor, ast.While)):
+                out.append(_finding(
+                    model, "JG-JIT-IN-LOOP", node,
+                    model.qualname_of(node),
+                    "jax.jit(...) constructed inside a loop body "
+                    "recompiles every iteration"))
+                break
+            cur = model.parents.get(cur)
+
+
+def _check_static_unstable(model: ModuleModel, out: List[Finding]) -> None:
+    unhashable = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp,
+                  ast.SetComp, ast.GeneratorExp)
+    by_name: Dict[str, Set[int]] = {}
+    for h in model.handles:
+        if h.static:
+            by_name.setdefault(h.name, set()).update(h.static)
+    if not by_name:
+        return
+    for node in ast.walk(model.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        tail = dotted_name(node.func).rpartition(".")[2]
+        static = by_name.get(tail)
+        if not static:
+            continue
+        for i in static:
+            if i < len(node.args) and isinstance(node.args[i], unhashable):
+                out.append(_finding(
+                    model, "JG-STATIC-UNSTABLE", node.args[i],
+                    model.qualname_of(node),
+                    f"unhashable literal passed to `{tail}` at static "
+                    f"position {i}; static args must hash into the "
+                    f"compile cache key"))
+
+
+def _enclosing_loop(model: ModuleModel, node: ast.AST) -> bool:
+    cur = model.parents.get(node)
+    while cur is not None and not isinstance(
+            cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef,
+                  ast.Module)):
+        if isinstance(cur, (ast.For, ast.AsyncFor, ast.While)):
+            return True
+        cur = model.parents.get(cur)
+    return False
+
+
+def _check_transfer_hot(model: ModuleModel, out: List[Finding]) -> None:
+    if not is_hot_module(model):
+        return
+    handle_names = {h.name for h in model.handles} | \
+        {h for h in (f.name for f in model.functions.values())
+         if _STEP_NAME_RE.match(h)}
+
+    for qual, info in model.functions.items():
+        if qual in model.jitted:
+            continue
+        # names assigned from a compiled-step dispatch hold device values
+        device_names: Set[str] = set()
+        for node in walk_own(info.node):
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call):
+                tail = dotted_name(node.value.func).rpartition(".")[2]
+                if tail in handle_names or _STEP_NAME_RE.match(tail):
+                    for t in node.targets:
+                        device_names |= _target_names(t)
+
+        for node in walk_own(info.node):
+            if not isinstance(node, ast.Call) or \
+                    not _enclosing_loop(model, node):
+                continue
+            dn = dotted_name(node.func)
+            if dn in ("jax.device_get", "device_get"):
+                out.append(_finding(
+                    model, "JG-TRANSFER-HOT", node, qual,
+                    "jax.device_get inside a hot-path loop forces a "
+                    "device->host sync every iteration"))
+            elif isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "block_until_ready":
+                out.append(_finding(
+                    model, "JG-TRANSFER-HOT", node, qual,
+                    ".block_until_ready() inside a hot-path loop "
+                    "serializes dispatch"))
+            elif dn in ("float", "int", "np.asarray", "np.array",
+                        "numpy.asarray", "numpy.array") and node.args and \
+                    isinstance(node.args[0], ast.Name) and \
+                    node.args[0].id in device_names:
+                out.append(_finding(
+                    model, "JG-TRANSFER-HOT", node, qual,
+                    f"`{dn}()` on step output `{node.args[0].id}` inside "
+                    f"a hot-path loop blocks on the device every "
+                    f"iteration"))
+
+
+def _check_donate_reuse(model: ModuleModel, out: List[Finding]) -> None:
+    donating = {h.name: h.donate for h in model.handles if h.donate}
+    if not donating:
+        return
+    for qual, info in model.functions.items():
+        if qual in model.jitted:
+            continue
+        for node in walk_own(info.node):
+            if not isinstance(node, ast.Call):
+                continue
+            tail = dotted_name(node.func).rpartition(".")[2]
+            donate = donating.get(tail)
+            if not donate:
+                continue
+            donated_names = {node.args[i].id for i in donate
+                             if i < len(node.args)
+                             and isinstance(node.args[i], ast.Name)}
+            if not donated_names:
+                continue
+            # names rebound by the call's own assignment are safe
+            parent = model.parents.get(node)
+            if isinstance(parent, ast.Assign):
+                for t in parent.targets:
+                    donated_names -= _target_names(t)
+            if not donated_names:
+                continue
+            # first subsequent Store per name ends the danger window
+            first_store: Dict[str, int] = {}
+            loads: List[ast.Name] = []
+            for n in walk_own(info.node):
+                if isinstance(n, ast.Name) and n.id in donated_names and \
+                        n.lineno > node.lineno:
+                    if isinstance(n.ctx, ast.Store):
+                        first_store[n.id] = min(
+                            first_store.get(n.id, n.lineno), n.lineno)
+                    else:
+                        loads.append(n)
+            for n in sorted(loads, key=lambda x: (x.lineno, x.col_offset)):
+                if n.lineno < first_store.get(n.id, 10 ** 9):
+                    out.append(_finding(
+                        model, "JG-DONATE-REUSE", n, qual,
+                        f"`{n.id}` was donated to `{tail}` (buffer "
+                        f"invalidated at dispatch) and read before being "
+                        f"rebound"))
+
+
+def check_jax(model: ModuleModel) -> List[Finding]:
+    out: List[Finding] = []
+    for qual in sorted(model.jitted):
+        if qual in model.functions:
+            _check_jitted_scope(model, qual, out)
+    _check_jit_in_loop(model, out)
+    _check_static_unstable(model, out)
+    _check_transfer_hot(model, out)
+    _check_donate_reuse(model, out)
+    return out

@@ -1,0 +1,70 @@
+"""zoolint driver: walk files, build models, run both rule families."""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Iterable, List, Optional
+
+from analytics_zoo_tpu.analysis import concurrency_rules, jax_rules
+from analytics_zoo_tpu.analysis.findings import Finding, Suppressions
+from analytics_zoo_tpu.analysis.scopes import ModuleModel
+
+_SKIP_DIRS = {"__pycache__", ".git", ".pytest_cache", "build", "dist",
+              ".eggs"}
+
+
+def default_root() -> str:
+    """The package directory — `python -m analytics_zoo_tpu.analysis`
+    with no paths lints the library itself."""
+    import analytics_zoo_tpu
+    return os.path.dirname(os.path.abspath(analytics_zoo_tpu.__file__))
+
+
+def repo_root() -> str:
+    return os.path.dirname(default_root())
+
+
+def iter_py_files(paths: Iterable[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isfile(p) and p.endswith(".py"):
+            out.append(os.path.abspath(p))
+        elif os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = [d for d in sorted(dirnames)
+                               if d not in _SKIP_DIRS]
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        out.append(os.path.abspath(
+                            os.path.join(dirpath, fn)))
+    return out
+
+
+def analyze_file(path: str, rel_to: Optional[str] = None) -> List[Finding]:
+    rel_to = rel_to or repo_root()
+    relpath = os.path.relpath(path, rel_to).replace(os.sep, "/")
+    with open(path, "r", encoding="utf-8") as fh:
+        source = fh.read()
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [Finding("LINT-SYNTAX", relpath, e.lineno or 0, 0, "",
+                        f"file does not parse: {e.msg}")]
+    model = ModuleModel(path, relpath, source, tree)
+    findings = jax_rules.check_jax(model) + \
+        concurrency_rules.check_concurrency(model)
+    sup = Suppressions(source)
+    kept = [f for f in findings if not sup.suppressed(f)]
+    kept.extend(sup.bare_disable_findings(relpath))
+    kept.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return kept
+
+
+def analyze(paths: Iterable[str],
+            rel_to: Optional[str] = None) -> List[Finding]:
+    out: List[Finding] = []
+    for path in iter_py_files(paths):
+        out.extend(analyze_file(path, rel_to=rel_to))
+    out.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return out

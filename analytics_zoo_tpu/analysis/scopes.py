@@ -1,0 +1,369 @@
+"""zoolint scope resolution: which code runs under a JAX tracer?
+
+The JG-* rules only make sense inside *jitted scopes* — function bodies
+that execute at trace time rather than per call.  This repo reaches jit
+four ways, and the resolver understands all of them:
+
+- decorator form: ``@jax.jit`` / ``@partial(jax.jit, ...)``
+- call form: ``self._train_step = jax.jit(step, donate_argnums=...)``
+  where ``step`` is a nested def (the Estimator idiom)
+- structured control flow: a def passed to ``lax.scan`` / ``fori_loop``
+  / ``while_loop`` / ``cond`` / ``jax.checkpoint`` is traced
+- transitive calls: a def called *by name* from a jitted scope is
+  itself traced (``single(...)`` inside ``_multi_step``'s scan body)
+
+Propagation is a fixpoint over those edges.  It deliberately does NOT
+follow attribute calls on arbitrary objects (``self.model.apply``,
+``optimizer.update``) — those targets live in other modules and
+flagging their bodies from here would be guesswork; each module is
+analyzed with its own jit roots instead.
+
+The resolver also records per-jit-handle metadata the rules need:
+``donate_argnums`` (for JG-DONATE-REUSE) and ``static_argnums`` (for
+JG-STATIC-UNSTABLE, and to exclude static params from taint).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Set, Tuple
+
+# --------------------------------------------------------------------------
+# small AST helpers (shared by the rule modules)
+# --------------------------------------------------------------------------
+
+
+def dotted_name(node: ast.AST) -> str:
+    """'jax.lax.scan' for a Name/Attribute chain, '' if not a pure chain."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def attach_parents(tree: ast.AST) -> Dict[ast.AST, ast.AST]:
+    parents: Dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    return parents
+
+
+def walk_own(node: ast.AST):
+    """Walk a def's body but stop at nested def/class boundaries (the
+    nested scopes are visited separately with their own context)."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        n = stack.pop()
+        yield n
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.ClassDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def int_values(node: Optional[ast.AST]) -> Set[int]:
+    """Integer literals inside a (possibly tuple/list) static/donate
+    argnums expression; empty set when the value isn't literal."""
+    if node is None:
+        return set()
+    out: Set[int] = set()
+    for n in ast.walk(node):
+        if isinstance(n, ast.Constant) and isinstance(n.value, int) \
+                and not isinstance(n.value, bool):
+            out.add(n.value)
+    return out
+
+
+# --------------------------------------------------------------------------
+# module model
+# --------------------------------------------------------------------------
+
+_JIT_NAMES = {"jax.jit", "jit", "jax.pmap", "pmap"}
+# callable-position args of the structured-control-flow primitives
+_TRACED_ARG_POSITIONS = {
+    "scan": (0,), "fori_loop": (2,), "while_loop": (0, 1), "cond": (1, 2),
+    "switch": None,  # every arg after the index is a branch
+    "checkpoint": (0,), "remat": (0,),
+}
+
+
+@dataclasses.dataclass
+class FunctionInfo:
+    node: ast.AST                   # FunctionDef / AsyncFunctionDef
+    name: str
+    qualname: str
+    parent_qual: str                # '' for module level
+    class_qual: str                 # nearest enclosing class ('' if none)
+    param_names: List[str] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class JitInfo:
+    reason: str                     # human-readable why this scope is traced
+    donate: Set[int] = dataclasses.field(default_factory=set)
+    static: Set[int] = dataclasses.field(default_factory=set)
+    static_names: Set[str] = dataclasses.field(default_factory=set)
+
+
+@dataclasses.dataclass
+class JitHandle:
+    """A name a compiled callable was bound to (``h = jax.jit(f)`` or
+    ``self._step = jax.jit(f)``) — call-site rules key off these."""
+    name: str                       # local name or attribute tail
+    is_attr: bool
+    donate: Set[int]
+    static: Set[int]
+    target_qual: str                # '' when the wrapped fn wasn't resolved
+    line: int
+
+
+class ModuleModel:
+    """Parsed file + function registry + jitted-scope fixpoint."""
+
+    def __init__(self, path: str, relpath: str, source: str,
+                 tree: ast.Module):
+        self.path = path
+        self.relpath = relpath
+        self.source = source
+        self.tree = tree
+        self.parents = attach_parents(tree)
+        self.functions: Dict[str, FunctionInfo] = {}
+        self.classes: Dict[str, ast.ClassDef] = {}
+        self.jitted: Dict[str, JitInfo] = {}
+        self.handles: List[JitHandle] = []
+        self._collect_defs()
+        self._mark_jitted()
+
+    # -- registry ----------------------------------------------------------
+
+    def _collect_defs(self) -> None:
+        def visit(node: ast.AST, qual: str, class_qual: str) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    q = f"{qual}.{child.name}" if qual else child.name
+                    a = child.args
+                    params = ([p.arg for p in a.posonlyargs] +
+                              [p.arg for p in a.args] +
+                              ([a.vararg.arg] if a.vararg else []) +
+                              [p.arg for p in a.kwonlyargs] +
+                              ([a.kwarg.arg] if a.kwarg else []))
+                    self.functions[q] = FunctionInfo(
+                        child, child.name, q, qual, class_qual, params)
+                    visit(child, q, class_qual)
+                elif isinstance(child, ast.ClassDef):
+                    q = f"{qual}.{child.name}" if qual else child.name
+                    self.classes[q] = child
+                    visit(child, q, q)
+                else:
+                    visit(child, qual, class_qual)
+
+        visit(self.tree, "", "")
+
+    def qualname_of(self, node: ast.AST) -> str:
+        """Dotted qualname of the def/class chain enclosing *node*."""
+        parts: List[str] = []
+        cur = self.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.ClassDef)):
+                parts.append(cur.name)
+            cur = self.parents.get(cur)
+        return ".".join(reversed(parts))
+
+    def enclosing_function(self, node: ast.AST) -> Optional[FunctionInfo]:
+        cur = self.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return self.functions.get(self.qualname_of(cur) and
+                                          f"{self.qualname_of(cur)}.{cur.name}"
+                                          or cur.name)
+            cur = self.parents.get(cur)
+        return None
+
+    def resolve_callable(self, expr: ast.AST,
+                         from_qual: str) -> Optional[str]:
+        """Resolve a callable expression at a call/pass site to a def's
+        qualname: bare names search enclosing scopes then module level;
+        ``self.X`` searches the enclosing class."""
+        if isinstance(expr, ast.Name):
+            scope = from_qual
+            while True:
+                cand = f"{scope}.{expr.id}" if scope else expr.id
+                if cand in self.functions:
+                    return cand
+                if not scope:
+                    return None
+                scope = scope.rpartition(".")[0]
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name) and \
+                expr.value.id in ("self", "cls"):
+            info = self.functions.get(from_qual)
+            cls = info.class_qual if info else ""
+            if cls:
+                cand = f"{cls}.{expr.attr}"
+                if cand in self.functions:
+                    return cand
+        return None
+
+    # -- jit fixpoint --------------------------------------------------------
+
+    def _jit_call_kwargs(self, call: ast.Call) -> Tuple[Set[int], Set[int],
+                                                        Set[str]]:
+        donate: Set[int] = set()
+        static: Set[int] = set()
+        static_names: Set[str] = set()
+        for kw in call.keywords:
+            if kw.arg in ("donate_argnums", "donate_argnames"):
+                donate |= int_values(kw.value)
+            elif kw.arg == "static_argnums":
+                static |= int_values(kw.value)
+            elif kw.arg == "static_argnames":
+                for n in ast.walk(kw.value):
+                    if isinstance(n, ast.Constant) and \
+                            isinstance(n.value, str):
+                        static_names.add(n.value)
+        return donate, static, static_names
+
+    def _is_jit_expr(self, node: ast.AST) -> Optional[ast.Call]:
+        """jax.jit / partial(jax.jit, ...) as an expression; returns the
+        Call carrying the jit kwargs."""
+        if isinstance(node, ast.Call):
+            dn = dotted_name(node.func)
+            if dn in _JIT_NAMES:
+                return node
+            if dn in ("partial", "functools.partial") and node.args and \
+                    dotted_name(node.args[0]) in _JIT_NAMES:
+                return node
+        return None
+
+    def _mark(self, qual: str, reason: str, donate: Set[int] = frozenset(),
+              static: Set[int] = frozenset(),
+              static_names: Set[str] = frozenset()) -> bool:
+        if qual in self.jitted:
+            self.jitted[qual].donate |= set(donate)
+            self.jitted[qual].static |= set(static)
+            self.jitted[qual].static_names |= set(static_names)
+            return False
+        self.jitted[qual] = JitInfo(reason, set(donate), set(static),
+                                    set(static_names))
+        return True
+
+    def _mark_jitted(self) -> None:
+        # seed 1: decorators
+        for qual, info in self.functions.items():
+            for dec in getattr(info.node, "decorator_list", []):
+                if dotted_name(dec) in _JIT_NAMES:
+                    self._mark(qual, "@jit decorator")
+                else:
+                    call = self._is_jit_expr(dec)
+                    if call is not None:
+                        d, s, sn = self._jit_call_kwargs(call)
+                        self._mark(qual, "@jit decorator", d, s, sn)
+
+        # seed 2: call forms — jax.jit(f, ...) and lax.scan/fori/... bodies
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            from_qual = self.qualname_of(node)
+            call = self._is_jit_expr(node)
+            if call is node and node.args:
+                d, s, sn = self._jit_call_kwargs(node)
+                target = self.resolve_callable(node.args[0], from_qual)
+                if target:
+                    self._mark(target, "passed to jax.jit", d, s, sn)
+                self._record_handle(node, target, d, s)
+                continue
+            dn = dotted_name(node.func)
+            tail = dn.rpartition(".")[2]
+            positions = _TRACED_ARG_POSITIONS.get(tail)
+            if tail in _TRACED_ARG_POSITIONS and \
+                    ("lax" in dn or "jax" in dn or dn == tail):
+                idxs = (range(1, len(node.args)) if positions is None
+                        else positions)
+                for i in idxs:
+                    if i < len(node.args):
+                        t = self.resolve_callable(node.args[i], from_qual)
+                        if t:
+                            self._mark(t, f"traced by {tail}")
+
+        # propagate: nesting + direct calls from jitted scopes
+        changed = True
+        while changed:
+            changed = False
+            for qual, info in self.functions.items():
+                if qual in self.jitted:
+                    continue
+                parent = info.parent_qual
+                if parent in self.jitted and \
+                        parent in self.functions:  # nested def, not method
+                    changed |= self._mark(qual,
+                                          f"nested in jitted {parent}")
+            for qual in list(self.jitted):
+                info = self.functions.get(qual)
+                if info is None:
+                    continue
+                for node in walk_own(info.node):
+                    if isinstance(node, ast.Call):
+                        t = self.resolve_callable(node.func, qual)
+                        if t and t not in self.jitted:
+                            changed |= self._mark(
+                                t, f"called from jitted {qual}")
+
+    def _record_handle(self, call: ast.Call, target_qual: Optional[str],
+                       donate: Set[int], static: Set[int]) -> None:
+        """``X = jax.jit(f, ...)`` / ``self.X = jax.jit(f, ...)`` — note
+        the bound name so call-site rules can find dispatches."""
+        parent = self.parents.get(call)
+        if not isinstance(parent, ast.Assign) or len(parent.targets) != 1:
+            return
+        tgt = parent.targets[0]
+        if isinstance(tgt, ast.Name):
+            self.handles.append(JitHandle(tgt.id, False, donate, static,
+                                          target_qual or "", call.lineno))
+        elif isinstance(tgt, ast.Attribute):
+            self.handles.append(JitHandle(tgt.attr, True, donate, static,
+                                          target_qual or "", call.lineno))
+
+    # -- taint: which params are traced --------------------------------------
+
+    def traced_params(self, qual: str) -> Set[str]:
+        """Parameter names that hold tracers when *qual* runs traced:
+        everything except self/cls, static_argnums positions, and
+        params whose annotation/default says "Python config, not
+        array" (``n: int``, ``shuffle: bool = True`` — static at trace
+        time, so branching on them is fine)."""
+        info = self.functions.get(qual)
+        jit = self.jitted.get(qual)
+        if info is None or jit is None:
+            return set()
+        a = info.node.args
+        static_typed: Set[str] = set()
+        pos_args = list(a.posonlyargs) + list(a.args)
+        for arg in pos_args + list(a.kwonlyargs):
+            ann = dotted_name(arg.annotation) if arg.annotation else ""
+            if ann in ("int", "bool", "str"):
+                static_typed.add(arg.arg)
+        for arg, default in list(zip(reversed(pos_args),
+                                     reversed(a.defaults))) + \
+                list(zip(a.kwonlyargs, a.kw_defaults)):
+            if isinstance(default, ast.Constant) and \
+                    isinstance(default.value, (bool, str)):
+                static_typed.add(arg.arg)
+        params = list(info.param_names)
+        offset = 0
+        if params and params[0] in ("self", "cls"):
+            offset = 1
+        traced = set()
+        for i, p in enumerate(params[offset:]):
+            if i in jit.static or p in jit.static_names or \
+                    p in static_typed:
+                continue
+            traced.add(p)
+        return traced

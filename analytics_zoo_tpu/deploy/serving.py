@@ -1642,10 +1642,12 @@ class ClusterServing:
                     "mean_ms": v["mean_s"] * 1e3,
                     "p50_ms": v["p50_s"] * 1e3,
                     "p99_ms": v["p99_s"] * 1e3}
+        with self._count_lock:
+            records_served = self.records_served
         h: Dict[str, Any] = {
             "ok": bool(qh.get("ok", True)),
             "running": self.is_alive(),
-            "records_served": self.records_served,
+            "records_served": records_served,
             "queue": qh,
             "stages": stages,
             "counters": {k: n for k, n in TIMERS.counts().items()
@@ -1790,11 +1792,14 @@ class ClusterServing:
                     rid, self._format_row(np.asarray(outs[i]), native))
             served += len(entries)
         dt = time.perf_counter() - t0
-        self.records_served += served
+        # serve_once can run concurrently with a started pipeline's
+        # respond pool, which bumps this counter under _count_lock —
+        # an unlocked += here would lose increments (THR-GUARD)
+        with self._count_lock:
+            self.records_served += served
+            total = self.records_served
         if self._tb is not None and served:
             # reference "Serving Throughput"/"Total Records Number" scalars
-            self._tb.add_scalar("serving_throughput", served / dt,
-                                self.records_served)
-            self._tb.add_scalar("total_records", self.records_served,
-                                self.records_served)
+            self._tb.add_scalar("serving_throughput", served / dt, total)
+            self._tb.add_scalar("total_records", total, total)
         return served

@@ -20,6 +20,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import jax.numpy as jnp
 import numpy as np
 
+from analytics_zoo_tpu.core.context import explicit_prng_key
 from analytics_zoo_tpu.models.common import ZooModel, register_model
 from analytics_zoo_tpu.nn import Input, Model
 from analytics_zoo_tpu.nn.layers.core import Dense, Dropout, Flatten
@@ -394,5 +395,5 @@ def presample_implicit_epochs(user_ids, item_ids, item_count: int, *,
         perm = jax.random.permutation(k_perm, users.shape[0])[:s_out]
         return users[perm], items[perm], labels[perm]
 
-    keys = jax.random.split(jax.random.PRNGKey(seed), epochs)
+    keys = jax.random.split(explicit_prng_key(seed), epochs)
     return jax.jit(jax.vmap(one_epoch))(keys)

@@ -18,7 +18,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from analytics_zoo_tpu.core.context import get_zoo_context
+from analytics_zoo_tpu.core.context import (explicit_prng_key,
+                                             get_zoo_context)
 from analytics_zoo_tpu.train import optimizers as optim_lib
 
 __all__ = ["GANEstimator"]
@@ -73,7 +74,7 @@ class GANEstimator:
 
     # ------------------------------------------------------------------
     def _build(self, batch_shape: Tuple[int, ...]):
-        rng = jax.random.PRNGKey(self.ctx.config.seed)
+        rng = explicit_prng_key(self.ctx.config.seed)
         kg, kd = jax.random.split(rng)
         noise_shape = (2, self.noise_dim)
         self.g_params, self.g_state = self.g.init(kg, noise_shape)
@@ -121,7 +122,7 @@ class GANEstimator:
         self._d_step = jax.jit(d_step, donate_argnums=(2, 4, 5))
         self._g_step = jax.jit(g_step, donate_argnums=(0, 4, 5),
                                static_argnums=(6,))
-        self._rng = jax.random.PRNGKey(self.ctx.config.seed + 1)
+        self._rng = explicit_prng_key(self.ctx.config.seed + 1)
         self._steps_built = True
 
     # ------------------------------------------------------------------
@@ -162,7 +163,7 @@ class GANEstimator:
         return self.history
 
     def generate(self, n: int, seed: int = 0) -> np.ndarray:
-        z = jax.random.normal(jax.random.PRNGKey(seed), (n, self.noise_dim))
+        z = jax.random.normal(explicit_prng_key(seed), (n, self.noise_dim))
         out, _ = self.g.call(self.g_params, self.g_state, z,
                              training=False, rng=None)
         return np.asarray(out)

@@ -247,7 +247,7 @@ class CheckpointManager:
                     self._retry.call(save_pytree, path, host_tree)
                 self._gc()
             except BaseException as e:
-                self._writer_err = e
+                self._writer_err = e  # zoolint: disable=THR-SHARED-MUT(wait() joins the writer thread before reading _writer_err; join() is the happens-before edge)
 
         self._writer = threading.Thread(target=write, daemon=True)
         self._writer.start()

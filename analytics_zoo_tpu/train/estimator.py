@@ -48,7 +48,9 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from analytics_zoo_tpu.core.context import ZooContext, get_zoo_context
+from analytics_zoo_tpu.core.context import (ZooContext,
+                                             explicit_prng_key,
+                                             get_zoo_context)
 from analytics_zoo_tpu.core.profiling import TIMERS, timeit
 from analytics_zoo_tpu.core.triggers import (EveryEpoch, Trigger, TriggerState)
 from analytics_zoo_tpu.nn import metrics as metrics_lib
@@ -161,7 +163,7 @@ class Estimator:
         self._last_val_iter = -1
         self._last_val_result: Optional[Dict[str, float]] = None
         self._tb_writer = None
-        self._rng = jax.random.PRNGKey(self.ctx.config.seed)
+        self._rng = explicit_prng_key(self.ctx.config.seed)
         # resilience state (docs/ROBUSTNESS.md): the host-side shuffle rng
         # is an attribute (not a fit() local) so checkpoints can capture it
         # and fit(resume=True) can continue the exact shuffle stream
@@ -259,7 +261,14 @@ class Estimator:
             return
         self._rng, init_rng = jax.random.split(self._rng)
         shapes = [(2,) + tuple(x.shape[1:]) for x in inputs]
-        self.params, self.state = self.model.init(init_rng, *shapes)
+        # jit the one-time build: layer initializers create constants
+        # (jnp.zeros biases, glorot scale factors) that are implicit
+        # host->device transfers when run eagerly; inside jit they are
+        # baked into the executable, so the build is silent under
+        # jax.transfer_guard("disallow") and the params never bounce
+        # through host numpy.  PRNG results are bit-identical either way.
+        self.params, self.state = jax.jit(
+            lambda r: self.model.init(r, *shapes))(init_rng)
         pending = getattr(self, "_initial_weights", None)
         if pending is not None:
             # merge by layer name so a superset (e.g. the full model a
@@ -287,6 +296,11 @@ class Estimator:
         rep = self.ctx.replicated_sharding()
         self.params = jax.device_put(self.params, self._param_shardings(self.params))
         self.state = jax.device_put(self.state, rep)
+        # the step carry also includes the PRNG key: replicate it
+        # EXPLICITLY here, or the first jitted step does an implicit
+        # single-device -> mesh reshard (a hidden d2d transfer that
+        # jax.transfer_guard("disallow") rejects)
+        self._rng = jax.device_put(self._rng, rep)
         self.opt_state = jax.jit(
             self.tx.init, out_shardings=self._opt_shardings())(self.params)
 
@@ -299,11 +313,15 @@ class Estimator:
         carry so the happy path costs ZERO extra host syncs — the host
         reads it back once per epoch (``_check_nan_guard``)."""
         rep = self.ctx.replicated_sharding()
+        # host numpy scalars + ONE explicit device_put: eager jnp.zeros
+        # would be an implicit h2d transfer per leaf (trips
+        # jax.transfer_guard("disallow") — the runtime twin of
+        # zoolint JG-TRANSFER-HOT)
         return jax.device_put(
-            {"bad": jnp.zeros((), jnp.int32),
-             "consec": jnp.zeros((), jnp.int32),
-             "max_consec": jnp.zeros((), jnp.int32),
-             "lr_scale": jnp.asarray(self._lr_scale, jnp.float32)}, rep)
+            {"bad": np.zeros((), np.int32),
+             "consec": np.zeros((), np.int32),
+             "max_consec": np.zeros((), np.int32),
+             "lr_scale": np.float32(self._lr_scale)}, rep)
 
     @staticmethod
     def _guard_step(guard, finite):
@@ -880,6 +898,40 @@ class Estimator:
                 bx, by = poisoned[:-1], poisoned[-1]
         return bx, by
 
+    def _dispatch_step(self, kind, batch_x, batch_y, *, epoch_fn=None,
+                       epoch_steps=None):
+        """THE training dispatch point — every fit path funnels here.
+
+        All three compiled step shapes share one calling convention (a
+        6-tuple donated carry in, the advanced carry + loss out), so
+        folding them lets both humans and static analysis reason about
+        one step-fn dispatch instead of three:
+
+        - ``"1"``     — one jitted train step on a (B, ...) batch
+        - ``"K"``     — the lax.scan multi-step on a (K, B, ...)
+                        superbatch (``steps_per_execution``)
+        - ``"epoch"`` — the device-resident whole-epoch program
+                        (caller supplies ``epoch_fn`` + ``epoch_steps``)
+
+        Returns ``(advanced_steps, loss)`` with ``loss`` still on
+        device: per-step losses for "1"/"K", the epoch mean for
+        "epoch".  ``global_step`` advances here and nowhere else during
+        fit.
+        """
+        if kind == "epoch":
+            fn, k = epoch_fn, int(epoch_steps)
+        elif kind == "K":
+            # the superbatch leading axis IS the step count (tail
+            # chunks shorter than steps_per_execution included)
+            fn, k = self._multi_step, int(batch_y.shape[0])
+        else:
+            fn, k = self._train_step, 1
+        (self.params, self.state, self.opt_state, self._rng,
+         self._guard, loss) = fn(self.params, self.state, self.opt_state,
+                                 self._rng, self._guard, batch_x, batch_y)
+        self.global_step += k
+        return k, loss
+
     def _fit_arrays(self, x, y, batch_size, epochs, validation_data,
                     end_trigger, shuffle, verbose):
         xs = _as_list(x)
@@ -992,7 +1044,7 @@ class Estimator:
                     perm = None         # contiguous slices in both modes
                 elif device_resident and pair_structured:
                     pairs = jax.random.permutation(
-                        jax.random.PRNGKey(cfg.seed + 7919 * epoch), n // 2)
+                        explicit_prng_key(cfg.seed + 7919 * epoch), n // 2)
                     perm = jnp.stack([pairs * 2, pairs * 2 + 1],
                                      axis=1).reshape(-1)
                     if n % 2:
@@ -1000,7 +1052,7 @@ class Estimator:
                             [perm, jnp.asarray([n - 1])])
                 elif device_resident:
                     perm = jax.random.permutation(
-                        jax.random.PRNGKey(cfg.seed + 7919 * epoch), n)
+                        explicit_prng_key(cfg.seed + 7919 * epoch), n)
                 elif pair_structured:
                     perm = _pair_perm_np(self._host_rng)
                 else:
@@ -1046,14 +1098,7 @@ class Estimator:
                     # fully-trained epoch as mid-epoch (in_epoch stays
                     # strictly below steps_per_epoch)
                     self._maybe_preempt(epoch, in_epoch, epoch_rng_state)
-                    step_fn = (self._multi_step if kind == "K"
-                               else self._train_step)
-                    (self.params, self.state, self.opt_state, self._rng,
-                     self._guard, loss) = step_fn(
-                         self.params, self.state, self.opt_state,
-                         self._rng, self._guard, batch_x, batch_y)
-                    k = K if kind == "K" else 1
-                    self.global_step += k
+                    k, loss = self._dispatch_step(kind, batch_x, batch_y)
                     in_epoch += k
                     losses.append(loss)
                     self._maybe_midepoch_validation(validation_data,
@@ -1266,12 +1311,13 @@ class Estimator:
                 y_e = _poison(y)
             t0 = time.time()
             with timeit("estimator/resident_epoch"):
-                (self.params, self.state, self.opt_state, self._rng,
-                 self._guard, mean_loss) = epoch_fn(
-                     self.params, self.state, self.opt_state, self._rng,
-                     self._guard, xs_e, y_e)
-                mean_loss = float(mean_loss)    # epoch-granular sync
-            self.global_step += steps
+                _, mean_loss = self._dispatch_step(
+                    "epoch", xs_e, y_e, epoch_fn=epoch_fn,
+                    epoch_steps=steps)
+                # epoch-granular sync: the entire epoch is ONE jitted
+                # dispatch, so this float() blocks once per epoch, not
+                # per batch — exactly the granularity we want
+                mean_loss = float(mean_loss)  # zoolint: disable=JG-TRANSFER-HOT(one sync per epoch by design; the loop variable here is epochs, not batches)
             if self._check_nan_guard(steps):
                 epoch = self.finished_epochs    # rolled back
                 continue
@@ -1359,14 +1405,7 @@ class Estimator:
             try:
                 for kind, batch_x, batch_y, bn in batches:
                     self._maybe_preempt(epoch, in_epoch)
-                    step_fn = (self._multi_step if kind == "K"
-                               else self._train_step)
-                    (self.params, self.state, self.opt_state, self._rng,
-                     self._guard, loss) = step_fn(
-                         self.params, self.state, self.opt_state,
-                         self._rng, self._guard, batch_x, batch_y)
-                    k = K if kind == "K" else 1
-                    self.global_step += k
+                    k, loss = self._dispatch_step(kind, batch_x, batch_y)
                     in_epoch += k
                     count += bn
                     losses.append(loss)
@@ -1416,13 +1455,22 @@ class Estimator:
                                     self._shard_batch(bx_p),
                                     self._shard_batch(by_p)[0],
                                     self._shard_batch(mask_p)[0])
-            stats = jax.device_get(stats)
+            # accumulate ON DEVICE (async dispatch) — device_get here
+            # would force a host sync every batch (JG-TRANSFER-HOT)
             agg = stats if agg is None else jax.tree_util.tree_map(
-                np.add, agg, stats)
-        out = {"loss": float(agg["__loss"]["loss_sum"] / agg["__loss"]["count"])}
-        for m in self.metrics:
-            out[m.name] = float(m.finalize(agg[m.name]))
-        return out
+                jnp.add, agg, stats)
+        # finalize ON DEVICE in one jitted call (metrics are
+        # jit-friendly by design; eager finalize would re-upload its
+        # scalar constants), then ONE device->host transfer for the
+        # whole evaluation pass
+        def _finalize(a):
+            out = {"loss": a["__loss"]["loss_sum"] / a["__loss"]["count"]}
+            for m in self.metrics:
+                out[m.name] = m.finalize(a[m.name])
+            return out
+
+        finals = jax.device_get(jax.jit(_finalize)(agg))
+        return {k: float(v) for k, v in finals.items()}
 
     def predict(self, x, batch_size: int = 32) -> np.ndarray:
         out = self.predict_raw(x, batch_size=batch_size)
@@ -1468,7 +1516,9 @@ class Estimator:
             bx_p, real = self._pad_to_devices(bx, eff_batch)
             preds = self._predict_step(self.params, self.state,
                                        self._shard_batch(bx_p))
-            preds = jax.device_get(preds)
+            # predictions ARE the output: they must land on host, and
+            # fetching per batch bounds peak HBM for arbitrarily large n
+            preds = jax.device_get(preds)  # zoolint: disable=JG-TRANSFER-HOT(outputs must reach the host; per-batch readback bounds device memory for large inputs)
             if not isinstance(preds, (list, tuple)):
                 preds = [preds]
             if outs is None:
@@ -1556,7 +1606,7 @@ class Estimator:
             # pre-rng-meta checkpoint: the live key may be a donated
             # (deleted) buffer after a failed step — re-seed so retry works
             self._rng = jax.random.fold_in(
-                jax.random.PRNGKey(self.ctx.config.seed), step)
+                explicit_prng_key(self.ctx.config.seed), step)
         if "lr_scale" in meta:
             self._lr_scale = float(meta["lr_scale"])
         if "host_rng" in meta and np.asarray(meta["host_rng"]).size:

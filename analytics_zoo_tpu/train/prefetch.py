@@ -43,6 +43,11 @@ class PrefetchIterator:
     def __init__(self, it: Iterable, transform: Optional[Callable] = None,
                  depth: int = 2):
         self._q: "queue.Queue" = queue.Queue(maxsize=max(1, depth))
+        # producer thread writes _err, the consumer polls it from
+        # __next__/_get while the producer may still be running — a
+        # plain unlocked field here is the THR-SHARED-MUT race zoolint
+        # flags (the reader could act on a half-observed error state)
+        self._err_lock = threading.Lock()
         self._err: Optional[BaseException] = None
         self._stop = threading.Event()
         self._closed = False
@@ -69,7 +74,8 @@ class PrefetchIterator:
                     if not put_retry(item):
                         return
             except BaseException as e:  # propagate to consumer
-                self._err = e
+                with self._err_lock:
+                    self._err = e
             finally:
                 # The sentinel must NEVER be dropped: with a short epoch
                 # the whole dataset fits in the queue while the consumer
@@ -95,10 +101,15 @@ class PrefetchIterator:
             item = self._get()
         if item is _SENTINEL:
             self._thread.join()
-            if self._err is not None:
-                raise self._err
+            err = self._error()
+            if err is not None:
+                raise err
             raise StopIteration
         return item
+
+    def _error(self) -> Optional[BaseException]:
+        with self._err_lock:
+            return self._err
 
     def _get(self) -> Any:
         while True:
@@ -109,8 +120,9 @@ class PrefetchIterator:
                     try:
                         return self._q.get_nowait()
                     except queue.Empty:
-                        if self._err is not None:
-                            raise self._err
+                        err = self._error()
+                        if err is not None:
+                            raise err
                         raise StopIteration from None
 
     def close(self, timeout: float = 5.0) -> None:

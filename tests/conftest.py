@@ -48,6 +48,23 @@ def pytest_runtest_makereport(item, call):
         pass    # reporting must never mask the real failure
 
 
+@pytest.fixture(autouse=True)
+def _transfer_guard(request):
+    """Opt-in runtime complement to zoolint's JG-TRANSFER-HOT: tests
+    marked ``@pytest.mark.transfer_guard`` run under
+    ``jax.transfer_guard("disallow")``, so any IMPLICIT host<->device
+    transfer (a numpy op on a device array, ``float()`` on a traced
+    result...) raises at the offending line.  Explicit transfers
+    (``jax.device_put`` / ``jax.device_get``) stay allowed — the point
+    is that every transfer on a hot path must be *visible in the
+    code*, which is exactly what the static rule enforces."""
+    if request.node.get_closest_marker("transfer_guard") is None:
+        yield
+        return
+    with jax.transfer_guard("disallow"):
+        yield
+
+
 @pytest.fixture(scope="session")
 def zoo_ctx():
     from analytics_zoo_tpu import init_zoo_context

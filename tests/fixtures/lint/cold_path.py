@@ -1,0 +1,13 @@
+"""zoolint fixture: the JG-TRANSFER-HOT *negative* module — the same
+per-iteration device_get as hot_path.py, but with no ``hot-path``
+marker and a path outside the hot-module suffix list, so the rule
+stays quiet (cold paths may sync freely)."""
+
+import jax
+
+
+def per_batch_device_get(batches):
+    out = []
+    for b in batches:
+        out.append(jax.device_get(b))  # quiet: not a hot module
+    return out

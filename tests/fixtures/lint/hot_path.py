@@ -1,0 +1,29 @@
+# zoolint: hot-path
+"""zoolint fixture: JG-TRANSFER-HOT in a marked hot module.  The
+firing/quiet pair shows the rule is about *per-iteration* syncs, not
+about transfers per se."""
+
+import jax
+
+
+def per_batch_sync(batches, step_fn):
+    losses = []
+    for b in batches:
+        loss = step_fn(b)
+        losses.append(float(loss))     # JG-TRANSFER-HOT fires: step
+        # output pulled to host every iteration
+    return losses
+
+
+def per_batch_device_get(batches):
+    out = []
+    for b in batches:
+        out.append(jax.device_get(b))  # JG-TRANSFER-HOT fires
+    return out
+
+
+def epoch_sync_ok(batches, step_fn):
+    loss = None
+    for b in batches:
+        loss = step_fn(b)              # quiet: stays on device in-loop
+    return jax.device_get(loss)        # quiet: ONE sync after the loop

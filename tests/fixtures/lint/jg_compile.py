@@ -1,0 +1,49 @@
+"""zoolint fixture: compile-cache and buffer-lifetime rules
+(JG-JIT-IN-LOOP, JG-STATIC-UNSTABLE, JG-DONATE-REUSE)."""
+
+import jax
+
+
+def jit_in_loop(xs):
+    out = []
+    for x in xs:
+        f = jax.jit(lambda a: a + 1)   # JG-JIT-IN-LOOP fires
+        out.append(f(x))
+    return out
+
+
+def jit_hoisted_ok(xs):
+    f = jax.jit(lambda a: a + 1)       # quiet: constructed once
+    return [f(x) for x in xs]
+
+
+def _fwd(x, cfg):
+    return x * len(cfg)
+
+
+apply_fn = jax.jit(_fwd, static_argnums=(1,))
+
+
+def static_unstable(x):
+    return apply_fn(x, [1, 2, 3])      # JG-STATIC-UNSTABLE fires (list)
+
+
+def static_hashable_ok(x):
+    return apply_fn(x, (1, 2, 3))      # quiet: tuples hash
+
+
+def _step(params, batch):
+    return params
+
+
+train_step = jax.jit(_step, donate_argnums=(0,))
+
+
+def donate_reuse(params, batch):
+    new_params = train_step(params, batch)
+    return params, new_params          # JG-DONATE-REUSE fires: stale read
+
+
+def donate_rebind_ok(params, batch):
+    params = train_step(params, batch)  # quiet: rebound by the same assign
+    return params

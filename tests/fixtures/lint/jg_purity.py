@@ -1,0 +1,65 @@
+"""zoolint fixture: tracer-purity rules (JG-IMPURE-CALL, JG-GLOBAL-MUT,
+JG-HOST-SYNC, JG-TRACED-BRANCH) — one firing and one quiet snippet each.
+
+NOT collected by pytest (no test_ prefix) and never imported; the
+analyzer works on the AST only.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def impure_print(x):
+    print("tracing", x)            # JG-IMPURE-CALL fires
+    return x * 2
+
+
+@jax.jit
+def debug_print_ok(x):
+    jax.debug.print("x={x}", x=x)  # quiet: jax.debug.* is the sanctioned way
+    return x * 2
+
+
+def host_print_ok(x):
+    print("not jitted")            # quiet: not a jitted scope
+    return x
+
+
+_CALLS = 0
+
+
+@jax.jit
+def global_mut(x):
+    global _CALLS                  # JG-GLOBAL-MUT fires
+    _CALLS += 1
+    return x
+
+
+def global_mut_host_ok():
+    global _CALLS                  # quiet: not a jitted scope
+    _CALLS += 1
+
+
+@jax.jit
+def host_sync(x):
+    return float(jnp.sum(x))       # JG-HOST-SYNC fires (traced -> host)
+
+
+@jax.jit
+def shape_sync_ok(x):
+    return x * float(x.shape[0])   # quiet: .shape is static at trace time
+
+
+@jax.jit
+def traced_branch(x):
+    if jnp.sum(x) > 0:             # JG-TRACED-BRANCH fires
+        return x
+    return -x
+
+
+@jax.jit
+def static_branch_ok(x, n: int):
+    if n > 3:                      # quiet: int-annotated param is static
+        return x * 2
+    return x

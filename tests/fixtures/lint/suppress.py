@@ -1,0 +1,21 @@
+"""zoolint fixture: inline suppressions.  A reasoned disable silences
+the rule; a bare disable silences it but is itself reported
+(LINT-BARE-DISABLE)."""
+
+import threading
+
+
+class Suppressed:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+
+    def add(self):
+        with self._lock:
+            self.count += 1
+
+    def peek_reasoned_ok(self):
+        return self.count  # zoolint: disable=THR-GUARD(monitoring read; staleness is acceptable)
+
+    def peek_bare(self):
+        return self.count  # zoolint: disable=THR-GUARD

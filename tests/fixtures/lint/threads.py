@@ -1,0 +1,101 @@
+"""zoolint fixture: concurrency rules (THR-GUARD, THR-BLOCK, THR-ORDER,
+THR-SHARED-MUT) — one firing and one quiet snippet each."""
+
+import threading
+import time
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.total = 0
+
+    def add(self, n):
+        with self._lock:
+            self.total += n       # establishes: total guarded by _lock
+
+    def snapshot(self):
+        return self.total         # THR-GUARD fires: unlocked read
+
+    def snapshot_locked_ok(self):
+        with self._lock:
+            return self.total     # quiet: lock held
+
+
+class Waiter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition()
+
+    def sleep_under_lock(self):
+        with self._lock:
+            time.sleep(0.1)       # THR-BLOCK fires
+
+    def sleep_outside_ok(self):
+        time.sleep(0.1)           # quiet: no lock held
+        with self._lock:
+            pass
+
+    def wait_on_held_cv_ok(self):
+        with self._cv:
+            self._cv.wait()       # quiet: wait() releases the held cv
+
+
+class TwoLocks:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def fwd(self):
+        with self._a:
+            with self._b:         # edge a->b
+                pass
+
+    def rev(self):
+        with self._b:
+            with self._a:         # THR-ORDER fires: opposite nesting
+                pass
+
+
+class OneOrder:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def first(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def second(self):
+        with self._a:
+            with self._b:         # quiet: same order everywhere
+                pass
+
+
+class Producer:
+    def __init__(self):
+        self._out = None
+        self._thread = threading.Thread(target=self._run)
+
+    def _run(self):
+        self._out = 42            # THR-SHARED-MUT fires: unlocked
+        # cross-thread write, read by result() below
+
+    def result(self):
+        return self._out
+
+
+class LockedProducer:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._out = None
+        self._thread = threading.Thread(target=self._run)
+
+    def _run(self):
+        with self._lock:
+            self._out = 42        # quiet: guarded write
+
+    def result(self):
+        with self._lock:
+            return self._out

@@ -11,6 +11,11 @@ import jax
 import numpy as np
 import pytest
 
+# runtime complement to zoolint JG-TRANSFER-HOT: the whole point of the
+# device-resident path is that every transfer is explicit, so the entire
+# suite runs under jax.transfer_guard("disallow")
+pytestmark = pytest.mark.transfer_guard
+
 
 @pytest.fixture(autouse=True)
 def fresh_names():
@@ -87,15 +92,24 @@ def test_fit_device_resident_matches_host(zoo_ctx):
                               shuffle=shuffle, verbose=False)
         return ncf
 
-    # device-resident: epoch slices of the presampled stack, device perm
-    dev = run(lambda e: [u[e][:, None], i[e][:, None]], lambda e: y[e],
-              shuffle=True)
-    # host path on the same data
-    host = run(lambda e: [np.asarray(u[e])[:, None],
-                          np.asarray(i[e])[:, None]],
-               lambda e: np.asarray(y[e]), shuffle=True)
-    xe = [np.asarray(u[0])[:, None], np.asarray(i[0])[:, None]]
-    ye = np.asarray(y[0])
+    # device-resident: epoch slices of the presampled stack, device perm.
+    # Slice inside jit with a device index: an eager ``u[e]`` with a
+    # Python-int ``e`` is a dynamic_slice whose start indices are an
+    # implicit h2d transfer — exactly what this suite's
+    # transfer_guard("disallow") marker exists to reject.
+    col = jax.jit(lambda a, e: a[e][:, None])
+    row = jax.jit(lambda a, e: a[e])
+    dev_idx = lambda e: jax.device_put(np.int32(e))
+    dev = run(lambda e: [col(u, dev_idx(e)), col(i, dev_idx(e))],
+              lambda e: row(y, dev_idx(e)), shuffle=True)
+    # host path on the same data: ONE explicit device_get, then pure
+    # numpy slicing (np.asarray(u[e]) would first run the device-side
+    # u[e] with implicit host-int start indices)
+    un, inn, yn = jax.device_get((u, i, y))
+    host = run(lambda e: [un[e][:, None], inn[e][:, None]],
+               lambda e: yn[e], shuffle=True)
+    xe = [un[0][:, None], inn[0][:, None]]
+    ye = yn[0]
     acc_dev = dev.estimator.evaluate(xe, ye, batch_size=256)["accuracy"]
     acc_host = host.estimator.evaluate(xe, ye, batch_size=256)["accuracy"]
     base = max(float(np.mean(ye)), 1 - float(np.mean(ye)))

@@ -29,6 +29,15 @@ from analytics_zoo_tpu.nn import Dense, Sequential, reset_name_scope
 from analytics_zoo_tpu.nn.layers.core import Activation
 from analytics_zoo_tpu.train.optimizers import Adam
 
+# runtime complement to zoolint JG-TRANSFER-HOT: the serving hot path
+# must make every host<->device transfer explicit (decode -> device_put,
+# harvest -> device_get); an implicit transfer anywhere in the pipeline
+# fails the whole suite under jax.transfer_guard("disallow").
+# NOTE: the guard context is thread-local in JAX, so it covers the test
+# thread (model build, serve_once, assertions); pipeline worker threads
+# are exercised for behavior, not guarded — the static rule covers them.
+pytestmark = pytest.mark.transfer_guard
+
 
 def _trained_model(in_dim=12, out_dim=4, buckets=(1, 8)):
     reset_name_scope()
