@@ -108,6 +108,27 @@ class ZooConfig:
     # work is shed with a structured "expired" error before paying
     # decode/dispatch cost.
     serving_default_ttl_ms: Optional[float] = None
+    # Serving SLO for the flight recorder (docs/OBSERVABILITY.md): a
+    # p99 bound on serving_stage_seconds{stage=e2e}, evaluated over
+    # serving_slo_window_s windows by a supervisor check.  0 disables
+    # the watcher entirely.
+    serving_slo_p99_ms: float = 0.0
+    serving_slo_window_s: float = 5.0
+
+    # --- observability ---------------------------------------------------
+    # Bounded ring of completed spans kept by observe.TRACER; any
+    # request's timeline is reconstructable while it's inside the ring.
+    observe_span_ring: int = 4096
+    # Structured JSONL event log (spans as they complete + metric
+    # dumps); empty string = off.
+    observe_jsonl_path: str = ""
+    # Where flight-recorder snapshots (span ring + metrics delta at the
+    # moment of an SLO breach / breaker trip) are written; empty = keep
+    # the last few in memory only.
+    observe_flight_dir: str = ""
+    # Arm a short jax.profiler device trace when the flight recorder
+    # trips (written under observe_flight_dir/profile).
+    observe_profile_on_breach: bool = False
 
     # --- robustness ------------------------------------------------------
     # What a non-finite training loss does (docs/ROBUSTNESS.md):

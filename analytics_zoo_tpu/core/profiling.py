@@ -37,6 +37,7 @@ class _Stat:
     total_s: float = 0.0
     max_s: float = 0.0
     samples: list = field(default_factory=list)  # ring of recent durations
+    cursor: int = 0  # next ring slot to overwrite once the ring is full
 
     @property
     def mean_s(self) -> float:
@@ -49,7 +50,10 @@ class _Stat:
         if len(self.samples) < _MAX_SAMPLES:
             self.samples.append(dt)
         else:
-            self.samples[self.count % _MAX_SAMPLES] = dt
+            # explicit cursor: deriving the slot from the already-
+            # incremented count skipped slot 0 a full lap
+            self.samples[self.cursor] = dt
+            self.cursor = (self.cursor + 1) % _MAX_SAMPLES
 
     def percentile(self, q: float) -> float:
         """q-th percentile (0-100) over the recent-sample ring."""
@@ -174,22 +178,45 @@ def count_event(name: str, n: int = 1) -> None:
     TIMERS.incr(name, n)
 
 
+# jax.profiler supports exactly one active trace per process; track it
+# so a nested trace() fails loudly instead of corrupting the session
+_trace_lock = threading.Lock()
+_active_trace_dir: Optional[str] = None
+
+
 @contextlib.contextmanager
 def trace(log_dir: str, annotation: Optional[str] = None) -> Iterator[None]:
     """Capture a ``jax.profiler`` device trace into ``log_dir``
-    (view with TensorBoard's profile plugin / xprof)."""
+    (view with TensorBoard's profile plugin / xprof).
+
+    Nested ``trace()`` calls raise ``RuntimeError`` (the profiler is a
+    process-wide singleton), and a failed ``start_trace`` propagates
+    without attempting ``stop_trace`` on a never-started profiler."""
     import jax
 
-    jax.profiler.start_trace(log_dir)
+    global _active_trace_dir
+    with _trace_lock:
+        if _active_trace_dir is not None:
+            raise RuntimeError(
+                f"profiling.trace({log_dir!r}) called while a trace into "
+                f"{_active_trace_dir!r} is active; jax.profiler supports "
+                "one trace per process — end the outer trace first")
+        _active_trace_dir = log_dir
+    started = False
     try:
+        jax.profiler.start_trace(log_dir)
+        started = True
         if annotation:
             with jax.profiler.TraceAnnotation(annotation):
                 yield
         else:
             yield
     finally:
-        jax.profiler.stop_trace()
-        logger.info("profiler trace written to %s", log_dir)
+        with _trace_lock:
+            _active_trace_dir = None
+        if started:
+            jax.profiler.stop_trace()
+            logger.info("profiler trace written to %s", log_dir)
 
 
 @contextlib.contextmanager

@@ -185,6 +185,8 @@ def read_scalars(log_dir: str, tag: str) -> List[Tuple[int, float]]:
         i = 0
         while i + 12 <= len(data):
             (length,) = struct.unpack("<Q", data[i:i + 8])
+            if i + 12 + length + 4 > len(data):
+                break  # truncated tail record (torn write); keep the rest
             i += 12  # len + len_crc
             rec = data[i:i + length]
             i += length + 4  # data + data_crc

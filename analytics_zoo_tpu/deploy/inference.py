@@ -519,16 +519,20 @@ class BatchRequest:
     the request's slice of the fused output (or the batch error).
     ``deadline`` (monotonic seconds, optional) is the record's client
     TTL: a request still unflushed past it is shed with a typed
-    ``DeadlineExpired`` instead of wasting a device slot."""
+    ``DeadlineExpired`` instead of wasting a device slot.  ``span``
+    (optional observe.Span) is the record's batch_wait leg — the
+    batcher ends it when the request flushes, sheds, or the batcher
+    closes, so the request's timeline never dangles."""
 
-    __slots__ = ("xs", "n", "callback", "t_submit", "deadline")
+    __slots__ = ("xs", "n", "callback", "t_submit", "deadline", "span")
 
-    def __init__(self, xs, callback, deadline=None):
+    def __init__(self, xs, callback, deadline=None, span=None):
         self.xs = xs
         self.n = xs[0].shape[0]
         self.callback = callback
         self.t_submit = time.monotonic()
         self.deadline = deadline
+        self.span = span
 
 
 def scatter_batch_results(out, reqs: List[BatchRequest]) -> None:
@@ -591,16 +595,17 @@ class DynamicBatcher:
 
     # -- front doors -------------------------------------------------------
     def submit(self, inputs, callback: Callable,
-               deadline: Optional[float] = None) -> None:
+               deadline: Optional[float] = None, span=None) -> None:
         """Async enqueue; ``callback(out, error)`` fires from the
         dispatch side when this request's slice is ready.  ``deadline``
         (monotonic) sheds the request with ``DeadlineExpired`` if it is
-        still queued when the bucket flushes past it."""
+        still queued when the bucket flushes past it.  ``span`` is the
+        caller's batch_wait span, ended by the batcher at flush/shed."""
         if self._stop.is_set():
             raise RuntimeError("DynamicBatcher is closed")
         xs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
         xs = [np.asarray(x) for x in xs]
-        req = BatchRequest(xs, callback, deadline=deadline)
+        req = BatchRequest(xs, callback, deadline=deadline, span=span)
         key = self._key(xs)
         full_reqs = None
         with self._cv:
@@ -675,6 +680,8 @@ class DynamicBatcher:
             self._rows.clear()
             self._deadline.clear()
         for r in pending:
+            if r.span is not None:
+                r.span.end(status="closed")
             r.callback(None, RuntimeError("DynamicBatcher closed"))
 
     # -- dispatcher --------------------------------------------------------
@@ -738,6 +745,7 @@ class DynamicBatcher:
 
     def _flush(self, key, reqs: List[BatchRequest], full: bool) -> None:
         from analytics_zoo_tpu.core.profiling import TIMERS
+        from analytics_zoo_tpu.observe import metrics as obs
         from analytics_zoo_tpu.robust.errors import DeadlineExpired
 
         now = time.monotonic()
@@ -747,10 +755,13 @@ class DynamicBatcher:
             # shed before paying the dispatch: the client's TTL already
             # elapsed while the request batched, so answer the typed
             # error now and keep the device slot for live work
-            TIMERS.incr(f"{self.name}/shed_expired", len(expired))
+            obs.count("serving_shed_total", len(expired), code="expired",
+                      flat=f"{self.name}/shed_expired")
             err = DeadlineExpired(
                 "client TTL expired while the request batched")
             for r in expired:
+                if r.span is not None:
+                    r.span.end(status="expired")
                 r.callback(None, err)
             reqs = [r for r in reqs if r not in expired]
             if not reqs:
@@ -758,7 +769,10 @@ class DynamicBatcher:
         TIMERS.incr(f"{self.name}/flush_full" if full
                     else f"{self.name}/flush_deadline")
         for r in reqs:
-            TIMERS.observe(f"{self.name}/batch_wait", now - r.t_submit)
+            obs.observe("serving_stage_seconds", now - r.t_submit,
+                        stage="batch_wait", flat=f"{self.name}/batch_wait")
+            if r.span is not None:
+                r.span.end(rows=r.n, full=full)
         try:
             fused = [np.concatenate([r.xs[i] for r in reqs], axis=0)
                      for i in range(len(reqs[0].xs))]

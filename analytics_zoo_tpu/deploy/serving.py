@@ -59,6 +59,10 @@ import numpy as np
 from analytics_zoo_tpu.core.profiling import TIMERS
 from analytics_zoo_tpu.deploy.inference import (
     DynamicBatcher, _next_bucket, scatter_batch_results)
+from analytics_zoo_tpu.observe import metrics as obs
+from analytics_zoo_tpu.observe.export import JsonlEventLog, to_prometheus
+from analytics_zoo_tpu.observe.recorder import SLO, FlightRecorder
+from analytics_zoo_tpu.observe.trace import TRACER
 from analytics_zoo_tpu.robust import (CircuitBreaker, Heartbeat, RetryPolicy,
                                       Supervisor, faults)
 from analytics_zoo_tpu.robust.errors import (DeadlineExpired,
@@ -605,7 +609,13 @@ class ServingConfig:
                  stage_stall_s: float = 10.0,
                  harvest_deadline_s: float = 30.0,
                  default_ttl_ms: Optional[float] = None,
-                 supervise: bool = True):
+                 supervise: bool = True,
+                 slo_p99_ms: float = 0.0,
+                 slo_window_s: float = 5.0,
+                 flight_dir: Optional[str] = None,
+                 jsonl_path: Optional[str] = None,
+                 profile_on_breach: bool = False,
+                 span_ring: Optional[int] = None):
         self.model_path = model_path
         self.batch_size = batch_size
         self.backpressure_maxlen = backpressure_maxlen
@@ -625,6 +635,15 @@ class ServingConfig:
         self.harvest_deadline_s = float(harvest_deadline_s)
         self.default_ttl_ms = default_ttl_ms
         self.supervise = supervise
+        # observability (docs/OBSERVABILITY.md): slo_p99_ms > 0 arms the
+        # flight recorder's e2e-p99 SLO; breaker trips are watched
+        # regardless whenever supervision is on
+        self.slo_p99_ms = float(slo_p99_ms)
+        self.slo_window_s = float(slo_window_s)
+        self.flight_dir = flight_dir
+        self.jsonl_path = jsonl_path
+        self.profile_on_breach = bool(profile_on_breach)
+        self.span_ring = span_ring
 
     @classmethod
     def from_yaml(cls, path: str) -> "ServingConfig":
@@ -650,6 +669,12 @@ class ServingConfig:
             stage_stall_s=zoo_cfg.serving_stage_stall_s,
             harvest_deadline_s=zoo_cfg.serving_harvest_deadline_s,
             default_ttl_ms=zoo_cfg.serving_default_ttl_ms,
+            slo_p99_ms=zoo_cfg.serving_slo_p99_ms,
+            slo_window_s=zoo_cfg.serving_slo_window_s,
+            flight_dir=zoo_cfg.observe_flight_dir or None,
+            jsonl_path=zoo_cfg.observe_jsonl_path or None,
+            profile_on_breach=zoo_cfg.observe_profile_on_breach,
+            span_ring=zoo_cfg.observe_span_ring,
             tensorboard_dir=zoo_cfg.tensorboard_dir)
         kw.update(overrides)
         return cls(**kw)
@@ -688,7 +713,8 @@ class _Batch:
     double-answer."""
 
     __slots__ = ("key", "fused", "reqs", "attempt", "slot", "handles",
-                 "t_dispatch", "t_harvest", "claimed", "first_blocked_t")
+                 "t_dispatch", "t_harvest", "claimed", "first_blocked_t",
+                 "span")
 
     def __init__(self, key, fused, reqs, attempt=0):
         self.key = key
@@ -701,6 +727,7 @@ class _Batch:
         self.t_harvest = None
         self.claimed = False
         self.first_blocked_t = None
+        self.span = None  # device-batch span linking member traces
 
 
 class DeviceExecutor:
@@ -869,7 +896,8 @@ class DeviceExecutor:
                     break
             else:
                 return
-        TIMERS.incr(f"{self.name}/replica_rebuilt")
+        obs.count("serving_replica_events_total", event="rebuilt",
+                  replica=index, flat=f"{self.name}/replica_rebuilt")
         self._log.warning("%s: replica %d rebuilt and swapped in",
                           self.name, index)
 
@@ -880,7 +908,8 @@ class DeviceExecutor:
         if self._stop.is_set():
             return
         if not self._dispatch_thread.is_alive():
-            TIMERS.incr(f"{self.name}/stage_restarted")
+            obs.count("serving_stage_restarts_total", stage="dispatch",
+                      flat=f"{self.name}/stage_restarted")
             self._log.warning("%s: dispatch thread died; restarting",
                               self.name)
             self._dispatch_thread = threading.Thread(
@@ -890,7 +919,8 @@ class DeviceExecutor:
             with self._lock:
                 self._harvest_epoch += 1
                 epoch = self._harvest_epoch
-            TIMERS.incr(f"{self.name}/stage_restarted")
+            obs.count("serving_stage_restarts_total", stage="harvest",
+                      flat=f"{self.name}/stage_restarted")
             self._log.warning("%s: harvest thread died; restarting",
                               self.name)
             self._harvest_thread = threading.Thread(
@@ -919,13 +949,18 @@ class DeviceExecutor:
             self._harvest_epoch += 1
             epoch = self._harvest_epoch
         TIMERS.incr(f"{self.name}/harvest_abandoned")
+        if batch.span is not None:
+            batch.span.end(status="abandoned",
+                           error=f"harvest exceeded {deadline_s:.1f}s")
         self._log.warning(
             "%s: harvest readback exceeded %.1fs deadline on replica %s — "
             "abandoning, quarantining, requeueing %d request(s)",
             self.name, deadline_s,
             slot.index if slot is not None else "?", len(batch.reqs))
         if slot is not None and slot.breaker.force_open():
-            TIMERS.incr(f"{self.name}/replica_quarantined")
+            obs.count("serving_replica_events_total", event="quarantined",
+                      replica=slot.index,
+                      flat=f"{self.name}/replica_quarantined")
         self._requeue_or_fail(
             batch, ServingError("device harvest exceeded "
                                 f"{deadline_s:.1f}s deadline",
@@ -943,6 +978,9 @@ class DeviceExecutor:
                 exc.code = getattr(exc, "code", "model_error")
             except Exception:
                 pass
+        if batch.span is not None:  # no-op if already terminal
+            batch.span.end(status=getattr(exc, "code", None) or "error",
+                           error=str(exc))
         for r in batch.reqs:
             r.callback(None, exc)
 
@@ -951,7 +989,10 @@ class DeviceExecutor:
         object stays claimed so a late abandoned readback is inert), or
         answer typed errors once retries are spent."""
         if batch.attempt < self.max_retries:
-            TIMERS.incr(f"{self.name}/batch_retries")
+            obs.count("serving_batch_retries_total",
+                      flat=f"{self.name}/batch_retries")
+            if batch.span is not None:
+                batch.span.end(status="retry", error=str(exc))
             fresh = _Batch(batch.key, batch.fused, batch.reqs,
                            attempt=batch.attempt + 1)
             self._retryq.append(fresh)
@@ -961,7 +1002,9 @@ class DeviceExecutor:
     def _replica_failed(self, slot: "_ReplicaSlot", batch: "_Batch",
                         exc: BaseException) -> None:
         if slot.breaker.record_failure():
-            TIMERS.incr(f"{self.name}/replica_quarantined")
+            obs.count("serving_replica_events_total", event="quarantined",
+                      replica=slot.index,
+                      flat=f"{self.name}/replica_quarantined")
             self._log.warning(
                 "%s: replica %d quarantined after %d consecutive "
                 "failure(s); last error: %s", self.name, slot.index,
@@ -1029,6 +1072,14 @@ class DeviceExecutor:
         if slot is None:
             self._no_healthy_replica(batch)
             return
+        # the batch span links its member record spans: each request's
+        # batch_wait span carries the record's trace id
+        if batch.span is None:
+            batch.span = TRACER.start(
+                "serving/device_batch", replica=slot.index,
+                rows=batch.fused[0].shape[0], attempt=batch.attempt,
+                members=[r.span.trace for r in batch.reqs
+                         if getattr(r, "span", None) is not None])
         try:
             plan = faults.fire(f"{self.name}.replica_crash")
             if plan is not None and plan.exc is not None:
@@ -1041,8 +1092,10 @@ class DeviceExecutor:
             return
         batch.slot = slot
         batch.t_dispatch = time.monotonic()
-        TIMERS.incr(f"{self.name}/device_batches")
-        TIMERS.incr(f"{self.name}/device_rows", batch.fused[0].shape[0])
+        obs.count("serving_batches_total", replica=slot.index,
+                  flat=f"{self.name}/device_batches")
+        obs.count("serving_batch_rows_total", batch.fused[0].shape[0],
+                  replica=slot.index, flat=f"{self.name}/device_rows")
         self._pending.put(batch)
 
     def _no_healthy_replica(self, batch: "_Batch") -> None:
@@ -1056,10 +1109,14 @@ class DeviceExecutor:
                 self._inflight += 1
             try:
                 out = self._fallback(batch.fused)
-                TIMERS.incr(f"{self.name}/sync_fallback_batches")
+                obs.count("serving_batches_total", replica="fallback",
+                          flat=f"{self.name}/sync_fallback_batches")
                 TIMERS.incr(f"{self.name}/device_batches")
-                TIMERS.incr(f"{self.name}/device_rows",
-                            batch.fused[0].shape[0])
+                obs.count("serving_batch_rows_total",
+                          batch.fused[0].shape[0], replica="fallback",
+                          flat=f"{self.name}/device_rows")
+                if batch.span is not None:
+                    batch.span.end(fallback=True)
                 scatter_batch_results(out, batch.reqs)
             except Exception as e:
                 self._requeue_or_fail(batch, e)
@@ -1151,14 +1208,21 @@ class DeviceExecutor:
         if err is not None:
             self._replica_failed(slot, batch, err)
             return
-        TIMERS.observe(f"{self.name}/device",
-                       time.monotonic() - batch.t_dispatch)
+        dt = time.monotonic() - batch.t_dispatch
+        obs.observe("serving_stage_seconds", dt, stage="device",
+                    flat=f"{self.name}/device")
+        if batch.span is not None:
+            batch.span.end(device_s=dt)
         scatter_batch_results(out, batch.reqs)
         if slot.breaker.record_success():
-            TIMERS.incr(f"{self.name}/replica_restored")
+            obs.count("serving_replica_events_total", event="restored",
+                      replica=slot.index,
+                      flat=f"{self.name}/replica_restored")
         if slot.rebuilt:
             slot.rebuilt = False
-            TIMERS.incr(f"{self.name}/replica_restored")
+            obs.count("serving_replica_events_total", event="restored",
+                      replica=slot.index,
+                      flat=f"{self.name}/replica_restored")
 
 
 class ClusterServing:
@@ -1202,6 +1266,16 @@ class ClusterServing:
         if self.cfg.tensorboard_dir:
             from analytics_zoo_tpu.core.summary import SummaryWriter
             self._tb = SummaryWriter(self.cfg.tensorboard_dir)
+        # observability wiring (docs/OBSERVABILITY.md): spans always on
+        # (a dict append per stage hop), event log / flight recorder by
+        # config
+        self.flight_recorder: Optional[FlightRecorder] = None
+        self._event_log: Optional[JsonlEventLog] = None
+        if self.cfg.span_ring:
+            TRACER.resize(self.cfg.span_ring)
+        if self.cfg.jsonl_path:
+            self._event_log = JsonlEventLog(self.cfg.jsonl_path)
+            self._event_log.attach(TRACER)
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> "ClusterServing":
@@ -1270,6 +1344,24 @@ class ClusterServing:
         sup.add_check("heal_replicas", self._heal_replicas)
         sup.add_check("stages", self._check_stages)
         sup.add_check("gauges", self._publish_gauges)
+        # the flight recorder rides the supervisor cadence: e2e-p99 SLO
+        # (if configured) plus breaker trips always
+        slos = []
+        if self.cfg.slo_p99_ms > 0:
+            slos.append(SLO("serving_e2e_p99", "serving_stage_seconds",
+                            labels={"stage": "e2e"},
+                            p99_ms=self.cfg.slo_p99_ms, min_count=10))
+        profile_dir = None
+        if self.cfg.profile_on_breach and self.cfg.flight_dir:
+            profile_dir = os.path.join(self.cfg.flight_dir, "profile")
+        self.flight_recorder = FlightRecorder(
+            slos=slos,
+            watch_counters=[("breaker_transitions_total", {"to": "open"})],
+            window_s=self.cfg.slo_window_s,
+            out_dir=self.cfg.flight_dir or None,
+            profile_dir=profile_dir,
+            cooldown_s=max(1.0, 2.0 * self.cfg.slo_window_s))
+        sup.add_check("flight_recorder", self.flight_recorder.check)
         self._supervisor = sup
         sup.start()
 
@@ -1306,7 +1398,8 @@ class ClusterServing:
             ex.ensure_threads()
         log = logging.getLogger("analytics_zoo_tpu.deploy")
         if self._poller is not None and not self._poller.is_alive():
-            TIMERS.incr("serving/stage_restarted")
+            obs.count("serving_stage_restarts_total", stage="poller",
+                      flat="serving/stage_restarted")
             log.warning("serving poller died; restarting")
             self._poller = threading.Thread(
                 target=self._poll_loop, daemon=True, name="srv-poll")
@@ -1314,7 +1407,8 @@ class ClusterServing:
             self._poller.start()
         for i, t in enumerate(self._decode_workers):
             if not t.is_alive():
-                TIMERS.incr("serving/stage_restarted")
+                obs.count("serving_stage_restarts_total", stage="decode",
+                          flat="serving/stage_restarted")
                 log.warning("decode worker %d died; restarting", i)
                 nt = threading.Thread(target=self._decode_loop, daemon=True,
                                       name=f"srv-decode-{i}")
@@ -1323,7 +1417,8 @@ class ClusterServing:
                 nt.start()
         for i, t in enumerate(self._respond_workers):
             if not t.is_alive():
-                TIMERS.incr("serving/stage_restarted")
+                obs.count("serving_stage_restarts_total", stage="respond",
+                          flat="serving/stage_restarted")
                 log.warning("respond worker %d died; restarting", i)
                 nt = threading.Thread(target=self._respond_loop, daemon=True,
                                       name=f"srv-respond-{i}")
@@ -1344,12 +1439,16 @@ class ClusterServing:
     def _publish_gauges(self) -> None:
         ex = self._executor
         if ex is not None:
-            TIMERS.set_gauge("serving/replicas_healthy",
-                             ex.healthy_replicas())
-            TIMERS.set_gauge("serving/inflight", ex.inflight)
+            obs.set_gauge("serving_replicas_healthy",
+                          ex.healthy_replicas(),
+                          flat="serving/replicas_healthy")
+            obs.set_gauge("serving_inflight", ex.inflight,
+                          flat="serving/inflight")
         if self._hb is not None:
             for stage, age in self._hb.ages().items():
-                TIMERS.set_gauge(f"serving/heartbeat_age_s/{stage}", age)
+                obs.set_gauge("serving_heartbeat_age_seconds", age,
+                              stage=stage,
+                              flat=f"serving/heartbeat_age_s/{stage}")
 
     def is_alive(self) -> bool:
         """True while any worker thread (pipeline stage or sync loop) is
@@ -1398,6 +1497,12 @@ class ClusterServing:
                 "ClusterServing.stop(): worker thread(s) %s still alive "
                 "after %.1fs — leaked (likely stuck in model forward or "
                 "backend I/O)", leaked or ["device-executor"], timeout)
+        if self._event_log is not None:
+            # one final metrics dump so the log tail always carries the
+            # end-of-run registry state
+            self._event_log.detach(TRACER)
+            self._event_log.metrics_dump()
+            self._event_log.close()
 
     # -- deadline-aware admission (docs/SERVING.md "Failure semantics") ----
     def _record_ttl_s(self, rec: Dict) -> Optional[float]:
@@ -1420,9 +1525,16 @@ class ClusterServing:
     def _shed(self, rid: str, rec: Dict, code: str, msg: str) -> None:
         """Answer a shed record with a structured error — every claimed
         record terminates in a result or a typed error payload, never
-        silence."""
-        TIMERS.incr(f"serving/shed_{'expired' if code == 'expired' else 'early'}")
-        TIMERS.incr("serving/errors_returned")
+        silence.  The record's root span (started at claim, or here for
+        the sync path) ends with the shed code as its terminal status."""
+        obs.count("serving_shed_total", code=code,
+                  flat=f"serving/shed_{'expired' if code == 'expired' else 'early'}")
+        obs.count("serving_errors_total", code=code,
+                  flat="serving/errors_returned")
+        sp = rec.pop("_span", None)
+        if sp is None:
+            sp = TRACER.start("serving/request", uri=rec.get("uri") or rid)
+        sp.end(status=code, error=msg)
         try:
             self.queue.set_result(
                 rid, error_payload(code, msg, uri=rec.get("uri")))
@@ -1450,10 +1562,15 @@ class ClusterServing:
                                              timeout=self.cfg.poll_timeout_s)
                 now = time.time()
                 for rid, rec in batch:
+                    # root span: trace id is fresh per claim (rids may
+                    # repeat across runs); the rid rides as the uri attr
+                    rec["_span"] = TRACER.start("serving/request",
+                                                uri=rec.get("uri") or rid)
                     ts = rec.get("ts")
                     if isinstance(ts, (int, float)):
-                        TIMERS.observe("serving/queue_wait",
-                                       max(0.0, now - ts))
+                        obs.observe("serving_stage_seconds",
+                                    max(0.0, now - ts), stage="queue_wait",
+                                    flat="serving/queue_wait")
                     remaining = self._record_ttl_s(rec)
                     if remaining is not None:
                         if remaining <= 0:
@@ -1492,9 +1609,15 @@ class ClusterServing:
             self._hb.beat("decode")
             rid, rec = item
             deadline = rec.get("_deadline_mono")
+            root = rec.get("_span")
+            dsp = None
             try:
                 faults.inject("serving.decode_error")
-                with TIMERS.scope("serving/decode"):
+                if root is not None:
+                    dsp = TRACER.start("serving/decode", trace=root.trace,
+                                       parent=root.sid)
+                with obs.time_stage("serving_stage_seconds",
+                                    stage="decode", flat="serving/decode"):
                     decoded = _decode_record(rec)
                     x = decoded.get("image")
                     if x is None:  # first non-image tensor
@@ -1506,27 +1629,39 @@ class ClusterServing:
                     if self.preprocess is not None:
                         x = self.preprocess(x)
                     x = np.asarray(x)
+                if dsp is not None:
+                    dsp.end()
                 # the decode itself may have eaten the rest of the budget
                 if deadline is not None and time.monotonic() > deadline:
                     raise DeadlineExpired(
                         "client TTL expired during decode")
                 if self._executor.busy():
                     TIMERS.incr("serving/decode_overlap")
+                wsp = None
+                if root is not None:
+                    # ended by the DynamicBatcher at flush/shed time —
+                    # the batch_wait leg of the record's timeline
+                    wsp = TRACER.start("serving/batch_wait",
+                                       trace=root.trace, parent=root.sid)
                 self._batcher.submit(
                     [x[None]],
                     lambda out, err, _rid=rid, _rec=rec:
                         self._respond_q.put((_rid, _rec, out, err)),
-                    deadline=deadline)
+                    deadline=deadline, span=wsp)
             except Exception as e:
                 # a bad record answers with an error instead of poisoning
                 # the pipeline (clients see it in query(), not a hang)
                 if isinstance(e, DeadlineExpired):
-                    TIMERS.incr("serving/shed_expired")
+                    obs.count("serving_shed_total", code="expired",
+                              flat="serving/shed_expired")
                 elif not isinstance(e, ServingError):
                     try:
                         e.code = getattr(e, "code", "decode_error")
                     except Exception:
                         pass
+                if dsp is not None:
+                    dsp.end(status=getattr(e, "code", None) or "error",
+                            error=str(e))
                 self._respond_q.put((rid, rec, None, e))
 
     def _respond_loop(self) -> None:
@@ -1543,8 +1678,15 @@ class ClusterServing:
                 return
             self._hb.beat("respond")
             rid, rec, out, err = item
+            root = rec.pop("_span", None)
+            rsp = None
+            if root is not None:
+                rsp = TRACER.start("serving/respond", trace=root.trace,
+                                   parent=root.sid)
             try:
-                with TIMERS.scope("serving/respond"):
+                with obs.time_stage("serving_stage_seconds",
+                                    stage="respond",
+                                    flat="serving/respond"):
                     try:
                         faults.inject("serving.respond_error")
                         val = self._format_result(out, err, rec)
@@ -1554,7 +1696,9 @@ class ClusterServing:
                             "internal", f"result formatting failed: {fe}",
                             uri=rec.get("uri"))
                     if isinstance(val, dict) and "error" in val:
-                        TIMERS.incr("serving/errors_returned")
+                        obs.count("serving_errors_total",
+                                  code=val.get("code") or "internal",
+                                  flat="serving/errors_returned")
 
                     def _write(_rid=rid, _val=val):
                         faults.inject("serving.queue_io")
@@ -1564,10 +1708,26 @@ class ClusterServing:
             except Exception:
                 TIMERS.incr("serving/respond_failed")
                 log.exception("serving respond failed for %r", rid)
+                if rsp is not None:
+                    rsp.end(status="error", error="respond failed")
+                if root is not None:
+                    root.end(status="internal", error="respond failed")
                 continue
+            # terminal spans: the respond leg, then the root with the
+            # typed outcome — the span chain is now reconstructable
+            outcome_code = (val.get("code") or "internal") \
+                if isinstance(val, dict) and "error" in val else "ok"
+            if rsp is not None:
+                rsp.end()
+            if root is not None:
+                root.end(status=outcome_code)
+            obs.count("serving_records_total",
+                      outcome="ok" if outcome_code == "ok" else "error")
             ts = rec.get("ts")
             if isinstance(ts, (int, float)):
-                TIMERS.observe("serving/e2e", max(0.0, time.time() - ts))
+                obs.observe("serving_stage_seconds",
+                            max(0.0, time.time() - ts), stage="e2e",
+                            flat="serving/e2e")
             with self._count_lock:
                 self.records_served += 1
             self._maybe_tb_flush()
@@ -1666,7 +1826,21 @@ class ClusterServing:
                   if k.startswith("serving/")}
         if gauges:
             h["gauges"] = gauges
+        observe: Dict[str, Any] = {
+            "span_ring": TRACER.ring_size(),
+            "spans_completed": TRACER.completed_count(),
+            "spans_active": TRACER.active_count(),
+            "metric_series": obs.METRICS.series_count(),
+        }
+        if self.flight_recorder is not None:
+            observe["flight_recorder"] = self.flight_recorder.stats()
+        h["observe"] = observe
         return h
+
+    def metrics_text(self) -> str:
+        """The labeled metric registry in Prometheus text format —
+        scrape endpoint payload (``parse_prometheus`` round-trips it)."""
+        return to_prometheus(obs.METRICS)
 
     # -- model hot reload (reference ClusterServingHelper.scala:185-193:
     # the config/model path is re-checked periodically and the serving
@@ -1747,6 +1921,10 @@ class ClusterServing:
         t0 = time.perf_counter()
         groups: Dict[Any, List] = {}  # (shape, dtype) -> [(rid, x, native)]
         for rid, rec in batch:
+            # root span for the sync path too: _shed/error/success all
+            # terminate it, so span chains reconstruct either way
+            rec["_span"] = TRACER.start("serving/request", sync=True,
+                                        uri=rec.get("uri") or rid)
             remaining = self._record_ttl_s(rec)
             if remaining is not None and remaining <= 0:
                 self._shed(rid, rec, "expired",
@@ -1767,7 +1945,11 @@ class ClusterServing:
                 # a bad record answers with an error instead of poisoning
                 # the batch (clients see it in query() rather than a hang)
                 code = getattr(e, "code", None) or "decode_error"
-                TIMERS.incr("serving/errors_returned")
+                obs.count("serving_errors_total", code=code,
+                          flat="serving/errors_returned")
+                sp = rec.pop("_span", None)
+                if sp is not None:
+                    sp.end(status=code, error=str(e))
                 self.queue.set_result(
                     rid, error_payload(code, e, uri=rec.get("uri")))
                 continue
@@ -1782,7 +1964,11 @@ class ClusterServing:
                 # records are already destructively popped from the queue —
                 # answer every one with the error rather than losing them
                 for rid, _, _, rec in entries:
-                    TIMERS.incr("serving/errors_returned")
+                    obs.count("serving_errors_total", code="model_error",
+                              flat="serving/errors_returned")
+                    sp = rec.pop("_span", None)
+                    if sp is not None:
+                        sp.end(status="model_error", error=str(e))
                     self.queue.set_result(rid, error_payload(
                         "model_error", e, uri=rec.get("uri")))
                 continue
@@ -1790,6 +1976,10 @@ class ClusterServing:
             for i, (rid, _, native, _rec) in enumerate(entries):
                 self.queue.set_result(
                     rid, self._format_row(np.asarray(outs[i]), native))
+                sp = _rec.pop("_span", None)
+                if sp is not None:
+                    sp.end()
+            obs.count("serving_records_total", len(entries), outcome="ok")
             served += len(entries)
         dt = time.perf_counter() - t0
         # serve_once can run concurrently with a started pipeline's

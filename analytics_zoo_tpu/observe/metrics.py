@@ -1,0 +1,322 @@
+"""Labeled metrics: counters / gauges / histograms with label sets
+(``stage=decode``, ``replica=2``, ``code=expired``), layered over the
+flat ``core.profiling.Timers`` registry.
+
+Two things distinguish this from the flat Timers bag:
+
+- **labels** — one metric name fans out into series keyed by sorted
+  ``(key, value)`` label tuples, so dashboards and tests can slice
+  ``serving_shed_total`` by ``code`` instead of pattern-matching flat
+  counter names;
+- **snapshot/delta semantics** — ``snapshot()`` marks a point in time
+  and ``delta(snap)`` reads the *window* since it (counter increments,
+  current gauges, histogram percentiles computed over only the samples
+  observed inside the window).  The supervisor's SLO watcher and tests
+  read windows, not process-lifetime totals.
+
+The migration story for existing call sites is the ``flat=`` mirror on
+the module-level helpers: ``count("serving_shed_total", code="expired",
+flat="serving/shed_expired")`` bumps the labeled series *and* the
+legacy flat counter, so ``health()`` sections and older tests keep
+working while new consumers read labels.
+
+Every metric name the repo emits is declared in ``CATALOG`` below;
+``docs/OBSERVABILITY.md`` pins the same list and
+``tests/test_doc_drift.py`` machine-checks the two against each other.
+Emitting an undeclared name still works but is itself counted
+(``observe_undeclared_metrics_total``) so drift is visible.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+from analytics_zoo_tpu.core.profiling import TIMERS
+
+__all__ = ["CATALOG", "MetricsRegistry", "MetricsSnapshot", "METRICS",
+           "count", "set_gauge", "observe", "time_stage", "render_series"]
+
+LabelTuple = Tuple[Tuple[str, str], ...]
+SeriesKey = Tuple[str, LabelTuple]
+
+_HIST_RING = 1024
+
+# name -> (type, help, allowed label keys).  The single source of truth
+# for metric names; OBSERVABILITY.md pins this table and test_doc_drift
+# checks it.
+CATALOG: Dict[str, Tuple[str, str, Tuple[str, ...]]] = {
+    # serving pipeline
+    "serving_stage_seconds": (
+        "histogram", "per-stage latency of the serving pipeline",
+        ("stage",)),
+    "serving_records_total": (
+        "counter", "records answered, by outcome (ok|error)",
+        ("outcome",)),
+    "serving_shed_total": (
+        "counter", "records shed before the device, by typed code",
+        ("code",)),
+    "serving_errors_total": (
+        "counter", "typed error payloads returned, by code", ("code",)),
+    "serving_batches_total": (
+        "counter", "batches dispatched to a device replica",
+        ("replica",)),
+    "serving_batch_rows_total": (
+        "counter", "rows dispatched to a device replica", ("replica",)),
+    "serving_batch_retries_total": (
+        "counter", "batches retried on a healthy peer replica", ()),
+    "serving_replica_events_total": (
+        "counter", "replica lifecycle events "
+        "(quarantined|restored|rebuilt)", ("event", "replica")),
+    "serving_stage_restarts_total": (
+        "counter", "dead stage threads respawned by the supervisor",
+        ("stage",)),
+    "serving_inflight": (
+        "gauge", "records currently inside the pipeline", ()),
+    "serving_replicas_healthy": (
+        "gauge", "replicas currently accepting batches", ()),
+    "serving_heartbeat_age_seconds": (
+        "gauge", "age of each stage's last heartbeat", ("stage",)),
+    # robustness
+    "breaker_transitions_total": (
+        "counter", "circuit breaker state transitions",
+        ("breaker", "to")),
+    "supervisor_check_errors_total": (
+        "counter", "supervisor checks that raised", ("check",)),
+    # training
+    "train_steps_total": (
+        "counter", "optimizer steps dispatched, by dispatch kind "
+        "(1|K|epoch)", ("kind",)),
+    "train_step_seconds": (
+        "histogram", "wall time of one step dispatch", ("kind",)),
+    "train_epoch_seconds": ("histogram", "wall time of one epoch", ()),
+    "train_loss": ("gauge", "last epoch mean loss", ()),
+    "train_throughput_rows_per_s": (
+        "gauge", "last epoch training throughput", ()),
+    # checkpointing
+    "checkpoint_seconds": (
+        "histogram", "checkpoint op wall time", ("op",)),
+    "checkpoint_total": (
+        "counter", "checkpoint ops, by op and status", ("op", "status")),
+    # the observability layer itself
+    "observe_flight_records_total": (
+        "counter", "flight-recorder snapshots captured, by reason",
+        ("reason",)),
+    "observe_undeclared_metrics_total": (
+        "counter", "emissions against names missing from CATALOG", ()),
+}
+
+
+def _labels_of(labels: Dict[str, Any]) -> LabelTuple:
+    return tuple(sorted((k, str(v)) for k, v in labels.items()))
+
+
+def render_series(name: str, labels: LabelTuple) -> str:
+    if not labels:
+        return name
+    inner = ",".join(f'{k}="{v}"' for k, v in labels)
+    return f"{name}{{{inner}}}"
+
+
+class _Hist:
+    __slots__ = ("count", "total", "vmin", "vmax", "seq", "samples")
+
+    def __init__(self):
+        self.count = 0
+        self.total = 0.0
+        self.vmin: Optional[float] = None
+        self.vmax: Optional[float] = None
+        self.seq = 0  # monotonically increasing sample number
+        self.samples: deque = deque(maxlen=_HIST_RING)  # (seq, value)
+
+
+def _percentile(values: List[float], q: float) -> Optional[float]:
+    if not values:
+        return None
+    vs = sorted(values)
+    idx = min(len(vs) - 1, max(0, int(round(q / 100.0 * (len(vs) - 1)))))
+    return vs[idx]
+
+
+class MetricsSnapshot:
+    """An immutable mark; feed it back to ``registry.delta``."""
+
+    __slots__ = ("ts", "counters", "gauges", "hist_marks")
+
+    def __init__(self, ts: float, counters: Dict[SeriesKey, float],
+                 gauges: Dict[SeriesKey, float],
+                 hist_marks: Dict[SeriesKey, Tuple[int, float, int]]):
+        self.ts = ts
+        self.counters = counters
+        self.gauges = gauges
+        self.hist_marks = hist_marks  # (count, total, seq)
+
+
+class MetricsRegistry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[SeriesKey, float] = {}
+        self._gauges: Dict[SeriesKey, float] = {}
+        self._hists: Dict[SeriesKey, _Hist] = {}
+
+    # -- write path --------------------------------------------------------
+
+    def _declared(self, name: str) -> bool:
+        if name in CATALOG:
+            return True
+        key = ("observe_undeclared_metrics_total", ())
+        self._counters[key] = self._counters.get(key, 0) + 1
+        return False
+
+    def inc(self, name: str, n: float = 1, **labels: Any) -> None:
+        key = (name, _labels_of(labels))
+        with self._lock:
+            self._declared(name)
+            self._counters[key] = self._counters.get(key, 0) + n
+
+    def set(self, name: str, value: float, **labels: Any) -> None:
+        key = (name, _labels_of(labels))
+        with self._lock:
+            self._declared(name)
+            self._gauges[key] = float(value)
+
+    def observe(self, name: str, value: float, **labels: Any) -> None:
+        key = (name, _labels_of(labels))
+        with self._lock:
+            self._declared(name)
+            h = self._hists.get(key)
+            if h is None:
+                h = self._hists[key] = _Hist()
+            v = float(value)
+            h.count += 1
+            h.total += v
+            h.vmin = v if h.vmin is None else min(h.vmin, v)
+            h.vmax = v if h.vmax is None else max(h.vmax, v)
+            h.seq += 1
+            h.samples.append((h.seq, v))
+
+    # -- read path ---------------------------------------------------------
+
+    def snapshot(self) -> MetricsSnapshot:
+        with self._lock:
+            return MetricsSnapshot(
+                time.time(), dict(self._counters), dict(self._gauges),
+                {k: (h.count, h.total, h.seq)
+                 for k, h in self._hists.items()})
+
+    def delta(self, since: Optional[MetricsSnapshot]) -> Dict[str, Any]:
+        """The window since ``since`` (or process lifetime if None).
+
+        Histogram percentiles are computed over only the samples whose
+        sequence number postdates the snapshot — a true window read, to
+        the extent the per-series sample ring (last ``1024``) reaches
+        back that far.
+        """
+        with self._lock:
+            now = time.time()
+            counters = {}
+            for k, v in self._counters.items():
+                prev = since.counters.get(k, 0) if since else 0
+                if v - prev:
+                    counters[render_series(*k)] = v - prev
+            gauges = {render_series(*k): v
+                      for k, v in self._gauges.items()}
+            hists = {}
+            for k, h in self._hists.items():
+                c0, t0, s0 = (since.hist_marks.get(k, (0, 0.0, 0))
+                              if since else (0, 0.0, 0))
+                dcount = h.count - c0
+                if not dcount:
+                    continue
+                window = [v for s, v in h.samples if s > s0]
+                hists[render_series(*k)] = {
+                    "count": dcount,
+                    "total": h.total - t0,
+                    "mean": (h.total - t0) / dcount,
+                    "p50": _percentile(window, 50),
+                    "p99": _percentile(window, 99),
+                    "max": max(window) if window else None,
+                    "window_samples": len(window),
+                }
+        return {
+            "window_s": (now - since.ts) if since else None,
+            "counters": counters, "gauges": gauges, "histograms": hists,
+        }
+
+    def collect(self) -> Iterable[Tuple[str, str, str,
+                                        List[Tuple[LabelTuple, Any]]]]:
+        """(name, type, help, [(labels, value-or-hist)]) for exporters,
+        sorted by name for stable output."""
+        with self._lock:
+            by_name: Dict[str, List[Tuple[LabelTuple, Any]]] = {}
+            kinds: Dict[str, str] = {}
+            for (name, labels), v in self._counters.items():
+                by_name.setdefault(name, []).append((labels, v))
+                kinds[name] = "counter"
+            for (name, labels), v in self._gauges.items():
+                by_name.setdefault(name, []).append((labels, v))
+                kinds[name] = "gauge"
+            for (name, labels), h in self._hists.items():
+                summary = {
+                    "count": h.count, "sum": h.total,
+                    "p50": _percentile([v for _, v in h.samples], 50),
+                    "p99": _percentile([v for _, v in h.samples], 99),
+                }
+                by_name.setdefault(name, []).append((labels, summary))
+                kinds[name] = "histogram"
+        out = []
+        for name in sorted(by_name):
+            help_ = CATALOG.get(name, ("", "", ()))[1]
+            out.append((name, kinds[name], help_,
+                        sorted(by_name[name], key=lambda kv: kv[0])))
+        return out
+
+    def series_count(self) -> int:
+        with self._lock:
+            return (len(self._counters) + len(self._gauges) +
+                    len(self._hists))
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._hists.clear()
+
+
+METRICS = MetricsRegistry()
+
+
+# -- module-level helpers with the flat-Timers mirror -----------------------
+
+
+def count(name: str, n: float = 1, flat: Optional[str] = None,
+          **labels: Any) -> None:
+    METRICS.inc(name, n, **labels)
+    if flat:
+        TIMERS.incr(flat, int(n))
+
+
+def set_gauge(name: str, value: float, flat: Optional[str] = None,
+              **labels: Any) -> None:
+    METRICS.set(name, value, **labels)
+    if flat:
+        TIMERS.set_gauge(flat, value)
+
+
+def observe(name: str, seconds: float, flat: Optional[str] = None,
+            **labels: Any) -> None:
+    METRICS.observe(name, seconds, **labels)
+    if flat:
+        TIMERS.observe(flat, seconds)
+
+
+@contextmanager
+def time_stage(name: str, flat: Optional[str] = None, **labels: Any):
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        observe(name, time.perf_counter() - t0, flat=flat, **labels)

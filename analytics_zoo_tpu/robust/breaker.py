@@ -33,6 +33,7 @@ import time
 from typing import Any, Callable, Dict
 
 from analytics_zoo_tpu.core.profiling import TIMERS
+from analytics_zoo_tpu.observe import metrics as obs
 
 CLOSED = "closed"
 OPEN = "open"
@@ -127,7 +128,8 @@ class CircuitBreaker:
             restored = self._state != CLOSED
             self._state = CLOSED
         if restored:
-            TIMERS.incr(f"robust/breaker_closed/{self.name}")
+            obs.count("breaker_transitions_total", breaker=self.name,
+                      to="closed", flat=f"robust/breaker_closed/{self.name}")
         return restored
 
     def record_failure(self) -> bool:
@@ -146,7 +148,10 @@ class CircuitBreaker:
                 if not was_open:
                     self.open_count += 1
         if trip and not was_open:
-            TIMERS.incr(f"robust/breaker_open/{self.name}")
+            # the flight recorder watches this labeled counter: any
+            # breaker opening inside a window trips a snapshot
+            obs.count("breaker_transitions_total", breaker=self.name,
+                      to="open", flat=f"robust/breaker_open/{self.name}")
             return True
         return False
 
@@ -165,7 +170,8 @@ class CircuitBreaker:
             if not was_open:
                 self.open_count += 1
         if not was_open:
-            TIMERS.incr(f"robust/breaker_open/{self.name}")
+            obs.count("breaker_transitions_total", breaker=self.name,
+                      to="open", flat=f"robust/breaker_open/{self.name}")
             return True
         return False
 

@@ -22,7 +22,7 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional, Tuple
 
-from analytics_zoo_tpu.core.profiling import TIMERS
+from analytics_zoo_tpu.observe import metrics as obs
 
 logger = logging.getLogger("analytics_zoo_tpu.robust")
 
@@ -104,7 +104,8 @@ class Supervisor:
             try:
                 fn()
             except Exception:
-                TIMERS.incr(f"robust/supervisor_check_error/{name}")
+                obs.count("supervisor_check_errors_total", check=name,
+                          flat=f"robust/supervisor_check_error/{name}")
                 logger.exception("supervisor check %r failed; supervisor "
                                  "continues", name)
 

@@ -54,7 +54,8 @@ from typing import Any, Dict, List, Optional, Tuple
 import jax
 import numpy as np
 
-from analytics_zoo_tpu.core.profiling import TIMERS
+from analytics_zoo_tpu.observe import metrics as obs
+from analytics_zoo_tpu.observe.trace import TRACER
 from analytics_zoo_tpu.robust import RetryPolicy, faults
 
 logger = logging.getLogger("analytics_zoo_tpu.train")
@@ -226,8 +227,17 @@ class CheckpointManager:
     def save(self, step: int, tree: Any) -> str:
         self.wait()
         path = self._path(step)
-        with TIMERS.scope("checkpoint/write_sync"):
-            self._retry.call(save_pytree, path, tree)
+        sp = TRACER.start("checkpoint/save", step=step, mode="sync")
+        try:
+            with obs.time_stage("checkpoint_seconds", op="save",
+                                flat="checkpoint/write_sync"):
+                self._retry.call(save_pytree, path, tree)
+        except BaseException as e:
+            obs.count("checkpoint_total", op="save", status="error")
+            sp.end(status="error", error=str(e))
+            raise
+        obs.count("checkpoint_total", op="save", status="ok")
+        sp.end()
         self._gc()
         return path
 
@@ -241,12 +251,20 @@ class CheckpointManager:
         host_tree = jax.tree_util.tree_map(np.asarray, jax.device_get(tree))
         path = self._path(step)
 
+        sp = TRACER.start("checkpoint/save", step=step, mode="async")
+
         def write():
             try:
-                with TIMERS.scope("checkpoint/write_async"):
+                with obs.time_stage("checkpoint_seconds", op="save_async",
+                                    flat="checkpoint/write_async"):
                     self._retry.call(save_pytree, path, host_tree)
+                obs.count("checkpoint_total", op="save_async", status="ok")
+                sp.end()
                 self._gc()
             except BaseException as e:
+                obs.count("checkpoint_total", op="save_async",
+                          status="error")
+                sp.end(status="error", error=str(e))
                 self._writer_err = e  # zoolint: disable=THR-SHARED-MUT(wait() joins the writer thread before reading _writer_err; join() is the happens-before edge)
 
         self._writer = threading.Thread(target=write, daemon=True)
@@ -290,7 +308,8 @@ class CheckpointManager:
                 os.replace(path, path + ".corrupt")
         except OSError:
             pass
-        TIMERS.incr("robust/ckpt_quarantined")
+        obs.count("checkpoint_total", op="restore", status="quarantined",
+                  flat="robust/ckpt_quarantined")
         logger.warning("checkpoint step %d is corrupt (%s: %s); quarantined "
                        "as %s.corrupt — falling back to an older snapshot",
                        step, type(err).__name__, err, os.path.basename(path))
@@ -304,24 +323,40 @@ class CheckpointManager:
         is loaded strictly — its corruption raises.
         """
         self.wait(raise_errors=False)
-        if step is not None:
-            return step, load_pytree(self._path(step), verify=self.verify)
-        steps = self.all_steps()
-        if not steps:
-            raise FileNotFoundError(f"no checkpoints in {self.directory}")
-        for s in reversed(steps):
+        sp = TRACER.start("checkpoint/restore", step=step)
+        with obs.time_stage("checkpoint_seconds", op="restore"):
             try:
-                tree = load_pytree(self._path(s), verify=self.verify)
-                return s, tree
-            except KeyboardInterrupt:
+                if step is not None:
+                    tree = load_pytree(self._path(step), verify=self.verify)
+                    obs.count("checkpoint_total", op="restore", status="ok")
+                    sp.end(restored_step=step)
+                    return step, tree
+                steps = self.all_steps()
+                if not steps:
+                    raise FileNotFoundError(
+                        f"no checkpoints in {self.directory}")
+                for s in reversed(steps):
+                    try:
+                        tree = load_pytree(self._path(s),
+                                           verify=self.verify)
+                        obs.count("checkpoint_total", op="restore",
+                                  status="ok")
+                        sp.end(restored_step=s)
+                        return s, tree
+                    except KeyboardInterrupt:
+                        raise
+                    except Exception as e:
+                        # torn zip (BadZipFile/EOF), CRC mismatch,
+                        # unpickle noise — every flavour of "this file
+                        # is not a usable snapshot"
+                        self._quarantine(s, e)
+                raise FileNotFoundError(
+                    f"no intact checkpoints in {self.directory} "
+                    f"({len(steps)} candidate(s) quarantined)")
+            except BaseException as e:
+                obs.count("checkpoint_total", op="restore", status="error")
+                sp.end(status="error", error=str(e))
                 raise
-            except Exception as e:
-                # torn zip (BadZipFile/EOF), CRC mismatch, unpickle noise —
-                # every flavour of "this file is not a usable snapshot"
-                self._quarantine(s, e)
-        raise FileNotFoundError(
-            f"no intact checkpoints in {self.directory} "
-            f"({len(steps)} candidate(s) quarantined)")
 
     def _gc(self) -> None:
         with self._fs_lock:

@@ -53,6 +53,9 @@ from analytics_zoo_tpu.core.context import (ZooContext,
                                              get_zoo_context)
 from analytics_zoo_tpu.core.profiling import TIMERS, timeit
 from analytics_zoo_tpu.core.triggers import (EveryEpoch, Trigger, TriggerState)
+from analytics_zoo_tpu.observe import metrics as obs
+from analytics_zoo_tpu.observe.export import publish_to_summary, to_prometheus
+from analytics_zoo_tpu.observe.trace import TRACER
 from analytics_zoo_tpu.nn import metrics as metrics_lib
 from analytics_zoo_tpu.nn import objectives
 from analytics_zoo_tpu.robust import RetryPolicy, TrainingPreempted, faults
@@ -183,6 +186,12 @@ class Estimator:
         # "host_prefetch") and why — bench and tests read these
         self.last_data_path: Optional[str] = None
         self.last_data_path_reason: Optional[str] = None
+        # observability: the fit-level root span, the current epoch's
+        # child span (train/step spans parent under it), and the metric
+        # snapshot taken at fit() entry (training_report() deltas it)
+        self._fit_span = None
+        self._epoch_span = None
+        self._fit_metrics_mark = None
 
     # ------------------------------------------------------------------
     # configuration
@@ -787,6 +796,12 @@ class Estimator:
             self._multi_step = None
             self._resident_epoch = None
         restore_sig = self._install_preempt_handler()
+        # fit-level root span + metric mark: every epoch/step span chains
+        # under this trace, and training_report() deltas the registry
+        # against the mark so it covers exactly this run
+        self._fit_metrics_mark = obs.METRICS.snapshot()
+        self._fit_span = TRACER.start("train/fit", epochs=epochs,
+                                      batch_size=batch_size)
         try:
             if isinstance(x, FeatureSet):
                 path, reason = self._resolve_data_path(x)
@@ -794,17 +809,68 @@ class Estimator:
                     path, reason
                 TIMERS.incr(f"estimator/data_path_{path}")
                 if path == "device_resident":
-                    return self._fit_device_resident(
+                    out = self._fit_device_resident(
                         x, batch_size, epochs, validation_data,
                         end_trigger, verbose, shuffle)
-                return self._fit_featureset(x, batch_size, epochs,
-                                            validation_data, end_trigger,
-                                            verbose, shuffle)
-            return self._fit_arrays(x, y, batch_size, epochs,
-                                    validation_data, end_trigger, shuffle,
-                                    verbose)
+                else:
+                    out = self._fit_featureset(x, batch_size, epochs,
+                                               validation_data, end_trigger,
+                                               verbose, shuffle)
+            else:
+                out = self._fit_arrays(x, y, batch_size, epochs,
+                                       validation_data, end_trigger, shuffle,
+                                       verbose)
+            self._fit_span.end(epochs_done=self.finished_epochs)
+            return out
+        except BaseException as e:
+            if self._epoch_span is not None:
+                self._epoch_span.end(status="error", error=str(e))
+                self._epoch_span = None
+            self._fit_span.end(status=type(e).__name__, error=str(e))
+            raise
         finally:
             restore_sig()
+
+    # ------------------------------------------------------------------
+    # observability (docs/OBSERVABILITY.md)
+    # ------------------------------------------------------------------
+    def training_report(self) -> Dict[str, Any]:
+        """Training-side observability rollup — the fit() analog of
+        serving ``health()``: progress counters, the labeled-metric
+        delta since the last ``fit()`` entered (step/epoch timings,
+        checkpoint ops, loss/throughput gauges), and span-ring stats so
+        a run's timeline is known to be reconstructable."""
+        report: Dict[str, Any] = {
+            "global_step": self.global_step,
+            "finished_epochs": self.finished_epochs,
+            "last_data_path": self.last_data_path,
+            "history": list(self.history),
+            "spans": {
+                "completed": TRACER.completed_count(),
+                "active": TRACER.active_count(),
+                "ring": TRACER.ring_size(),
+            },
+        }
+        if self._fit_span is not None:
+            report["fit_trace"] = self._fit_span.trace
+        if self._fit_metrics_mark is not None:
+            report["metrics_delta"] = obs.METRICS.delta(
+                self._fit_metrics_mark)
+        return report
+
+    def metrics_text(self) -> str:
+        """The labeled metric registry in Prometheus text format."""
+        return to_prometheus(obs.METRICS)
+
+    def publish_metrics(self, step: Optional[int] = None) -> int:
+        """Bridge the labeled registry into the TensorBoard writer set
+        via ``set_tensorboard`` (no-op 0 without one); returns the
+        number of scalars written."""
+        if self._tb_writer is None:
+            return 0
+        return publish_to_summary(self._tb_writer,
+                                  step if step is not None
+                                  else self.global_step)
 
     # ------------------------------------------------------------------
     # resilience plumbing (docs/ROBUSTNESS.md)
@@ -926,9 +992,21 @@ class Estimator:
             fn, k = self._multi_step, int(batch_y.shape[0])
         else:
             fn, k = self._train_step, 1
+        parent = self._epoch_span or self._fit_span
+        sp = (TRACER.start("train/step", trace=parent.trace,
+                           parent=parent.sid, kind=kind)
+              if parent is not None else None)
+        t0 = time.perf_counter()
         (self.params, self.state, self.opt_state, self._rng,
          self._guard, loss) = fn(self.params, self.state, self.opt_state,
                                  self._rng, self._guard, batch_x, batch_y)
+        # dispatch-side wall time: the carry returns while the device
+        # still computes, so this is host dispatch latency, not step math
+        obs.observe("train_step_seconds", time.perf_counter() - t0,
+                    kind=kind)
+        obs.count("train_steps_total", k, kind=kind)
+        if sp is not None:
+            sp.end(steps=k)
         self.global_step += k
         return k, loss
 
@@ -1018,6 +1096,10 @@ class Estimator:
             batches = None
             try:
                 t0 = time.time()
+                if self._fit_span is not None:
+                    self._epoch_span = TRACER.start(
+                        "train/epoch", trace=self._fit_span.trace,
+                        parent=self._fit_span.sid, epoch=epoch + 1)
                 # Mid-epoch resume (preemption manifest): rewind the host
                 # shuffle rng to the interrupted epoch's start state so the
                 # SAME permutation is redrawn, then skip the steps the
@@ -1106,6 +1188,9 @@ class Estimator:
                 # ONE host sync per epoch reads the NaN-guard counters that
                 # rode the device carry (policy: skip / rollback / raise)
                 if self._check_nan_guard(in_epoch - start_step):
+                    if self._epoch_span is not None:
+                        self._epoch_span.end(status="rollback")
+                        self._epoch_span = None
                     epoch = self.finished_epochs   # rolled back
                     continue
                 epoch += 1
@@ -1118,6 +1203,13 @@ class Estimator:
                 dt = time.time() - t0
                 rec = {"epoch": epoch, "loss": mean_loss,
                        "throughput": steps_per_epoch * eff_batch / dt}
+                obs.observe("train_epoch_seconds", dt)
+                obs.set_gauge("train_loss", mean_loss)
+                obs.set_gauge("train_throughput_rows_per_s",
+                              rec["throughput"])
+                if self._epoch_span is not None:
+                    self._epoch_span.end(loss=mean_loss)
+                    self._epoch_span = None
                 tstate = TriggerState(epoch=epoch, iteration=self.global_step,
                                       epoch_finished=True, loss=mean_loss)
                 if validation_data is not None and (
@@ -1160,6 +1252,9 @@ class Estimator:
             except Exception as e:  # failure-retry (Topology.scala:1179-1261)
                 if batches is not None and hasattr(batches, "close"):
                     batches.close()
+                if self._epoch_span is not None:
+                    self._epoch_span.end(status="retry", error=str(e))
+                    self._epoch_span = None
                 if self._ckpt_mgr is not None:
                     # an async write may still be in flight — land it so
                     # the retry decision sees the newest snapshot

@@ -950,10 +950,13 @@ def _measure_scan(many, carry0, K, rounds, probe=True):
         return time.perf_counter() - t0
 
     # auto-scale K until the window dwarfs transport jitter (~±10ms on
-    # the tunnel); each probe n is distinct, so probes can't be cached
-    while probe and K < 4096 and t(K + K // 4) < 0.08:
+    # the tunnel); each probe n is distinct, so probes can't be cached.
+    # The 64K probe ceiling matters for sub-microsecond iterations (the
+    # attention_l2048 fwd legs): the old 4K cap left the whole window
+    # inside timer resolution and the leg published null/unresolved
+    while probe and K < 65536 and t(K + K // 4) < 0.08:
         K *= 4
-    for attempt in range(3):
+    for attempt in range(5):
         pts = []
         for r in range(max(2, rounds + 1)):
             n = (r + 1) * K
@@ -967,7 +970,7 @@ def _measure_scan(many, carry0, K, rounds, probe=True):
             return slope_ms
         # the whole window sat inside timer/transport noise, so the fit
         # is garbage; grow the windows and retry while the budget holds
-        if attempt == 2 or K >= 65536 or _remaining() < 30.0:
+        if attempt == 4 or K >= (1 << 20) or _remaining() < 30.0:
             return None
         K *= 8
     return None
@@ -1423,6 +1426,33 @@ def _preflight_with_retry(budget_frac: float = 0.8,
         time.sleep(min(retry_sleep_s, max(0, deadline - time.time())))
 
 
+def _run_metadata(device=None):
+    """Provenance stamp for BENCH_*.json artifacts: which commit, which
+    jax, which silicon produced the numbers.  ``device=None`` (the
+    cpu_fallback path) must NOT touch jax — initialising the wedged
+    backend is exactly what that path is avoiding."""
+    meta = {"timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())}
+    try:
+        import subprocess
+        sha = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"], capture_output=True,
+            text=True, timeout=10,
+            cwd=os.path.dirname(os.path.abspath(__file__))).stdout.strip()
+        if sha:
+            meta["git_sha"] = sha
+    except Exception:
+        pass
+    try:
+        import jax
+        meta["jax_version"] = jax.__version__
+    except Exception:
+        pass
+    if device is not None:
+        meta["device_kind"] = getattr(device, "device_kind", "unknown")
+        meta["platform"] = getattr(device, "platform", "unknown")
+    return meta
+
+
 def main():
     import jax
 
@@ -1433,7 +1463,8 @@ def main():
         # flagged number instead of a bare zero
         extra = {"error": "device preflight failed: accelerator "
                           "unreachable (transport hang?)",
-                 "platform": "cpu_fallback"}
+                 "platform": "cpu_fallback",
+                 "run_metadata": _run_metadata()}
         value = 0.0
         try:
             # subprocess with a forced-CPU jax: ANY jax call in this
@@ -1476,6 +1507,7 @@ def main():
     accel = jax.devices()[0]
     on_tpu = accel.platform != "cpu"
     extra = {}
+    extra["run_metadata"] = _run_metadata(accel)
     section_s = {}
     extra["section_seconds"] = section_s
     report = {"metric": "ncf_movielens1m_train_samples_per_sec_per_chip",

@@ -48,6 +48,46 @@ def pytest_runtest_makereport(item, call):
         pass    # reporting must never mask the real failure
 
 
+def pytest_sessionfinish(session, exitstatus):
+    """When ``ZOO_TEST_OBSERVE_DIR`` is set (the CI tier-1 job sets it
+    and uploads the directory as a workflow artifact), dump what the
+    run's instrumentation saw: the completed-span ring as a JSONL event
+    log, the labeled-metric registry as a Prometheus text file, and the
+    legacy flat counters — a red CI run ships its own telemetry."""
+    out_dir = os.environ.get("ZOO_TEST_OBSERVE_DIR")
+    if not out_dir:
+        return
+    try:
+        import json
+
+        from analytics_zoo_tpu.core.profiling import TIMERS
+        from analytics_zoo_tpu.observe import metrics as obs
+        from analytics_zoo_tpu.observe.export import (JsonlEventLog,
+                                                      to_prometheus)
+        from analytics_zoo_tpu.observe.trace import TRACER
+
+        os.makedirs(out_dir, exist_ok=True)
+        log = JsonlEventLog(os.path.join(out_dir, "events.jsonl"))
+        log.emit("session", exitstatus=int(exitstatus),
+                 spans_completed=TRACER.completed_count(),
+                 spans_active=TRACER.active_count(),
+                 metric_series=obs.METRICS.series_count())
+        for d in TRACER.snapshot():
+            log.emit("span", span=d)
+        log.metrics_dump(obs.METRICS)
+        log.close()
+        with open(os.path.join(out_dir, "metrics.prom"), "w",
+                  encoding="utf-8") as f:
+            f.write(to_prometheus(obs.METRICS))
+        with open(os.path.join(out_dir, "timers.json"), "w",
+                  encoding="utf-8") as f:
+            json.dump({"counters": TIMERS.counts(),
+                       "gauges": TIMERS.gauges()}, f, indent=2,
+                      sort_keys=True)
+    except Exception:
+        pass    # telemetry export must never change the exit status
+
+
 @pytest.fixture(autouse=True)
 def _transfer_guard(request):
     """Opt-in runtime complement to zoolint's JG-TRANSFER-HOT: tests

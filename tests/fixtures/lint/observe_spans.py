@@ -1,0 +1,73 @@
+# zoolint: hot-path
+"""zoolint fixture: span/metric instrumentation in a hot module.
+
+The firing snippets are the two mistakes observability retrofits make:
+reading shared span state without the ring's lock (THR-GUARD) and
+forcing a host sync per step just to record a metric sample
+(JG-TRANSFER-HOT).  The quiet twins are the idiom
+``analytics_zoo_tpu/observe`` actually uses — plain fields only touched
+under the lock, the completed-span ring itself a ``deque`` (an
+atomic-safe type, exempt from guard inference), wall-clock timing
+around the dispatch, one sync after the loop — and must stay clean so
+instrumenting a pipeline never costs a lint finding.
+"""
+
+import threading
+import time
+from collections import deque
+
+import jax
+
+
+class NaiveRing:
+    """Span ring whose `last completed` field has an unlocked read."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.last = None
+
+    def finish(self, span):
+        with self._lock:
+            self.last = span          # establishes: last guarded by _lock
+
+    def snapshot(self):
+        return self.last              # THR-GUARD fires: unlocked read
+
+
+class SpanRing:
+    """The observe.trace idiom: plain fields only under the lock, the
+    ring itself a bounded deque (append is atomic, no guard needed)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._done = deque(maxlen=64)
+        self.last = None
+
+    def finish(self, span):
+        with self._lock:
+            self._done.append(span)
+            self.last = span
+
+    def snapshot(self):
+        with self._lock:
+            return self.last          # quiet: lock held
+
+    def completed_count(self):
+        return len(self._done)        # quiet: deque is a safe type
+
+
+def record_step_metric_naive(batches, step_fn, hist):
+    for b in batches:
+        loss = step_fn(b)
+        hist.append(float(loss))      # JG-TRANSFER-HOT fires: a host
+        # sync per step, just to feed a metric sample
+    return hist
+
+
+def record_step_metric_ok(batches, step_fn, hist):
+    loss = None
+    for b in batches:
+        t0 = time.perf_counter()
+        loss = step_fn(b)             # quiet: stays on device in-loop
+        hist.append(time.perf_counter() - t0)   # wall time, no sync
+    return jax.device_get(loss)       # quiet: ONE sync after the loop

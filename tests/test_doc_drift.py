@@ -1,11 +1,17 @@
-"""docs/PERFORMANCE.md must not drift from the committed artifact.
+"""docs must not drift from the artifacts/registries they pin.
 
 r5 shipped a doc quoting flash "8.29x at 1024" while BENCH_r05.json
 said 1.13x — interactive-probe numbers leaked into the doc of record.
-The doc now pins its numeric claims in a marker-delimited table; this
-test resolves each dotted key into the NEWEST BENCH_*.json and fails
-tier-1 when they disagree, so regenerating the artifact without
-regenerating the doc is a red build, not silent drift.
+docs/PERFORMANCE.md now pins its numeric claims in a marker-delimited
+table; this test resolves each dotted key into the NEWEST BENCH_*.json
+and fails tier-1 when they disagree, so regenerating the artifact
+without regenerating the doc is a red build, not silent drift.
+
+The same discipline covers docs/OBSERVABILITY.md: its pinned
+metric-names table is machine-checked against the live
+``observe.metrics.CATALOG`` (names, types, AND label keys), so adding
+or renaming a metric without updating the doc of record is equally
+red.
 
 Also guards the instrument itself: the bench ratio/sanitize helpers
 must never let Infinity/NaN reach an emitted report again.
@@ -77,6 +83,57 @@ class TestDocDrift:
         claims, _ = _pinned_claims()
         for key, v in claims:
             assert math.isfinite(v), f"{key} pins a non-finite value"
+
+
+OBS_DOC = REPO / "docs" / "OBSERVABILITY.md"
+
+_METRICS_TABLE_RE = re.compile(
+    r"<!--\s*METRICS_TABLE:BEGIN\s*-->(.*?)<!--\s*METRICS_TABLE:END\s*-->",
+    re.S)
+
+
+def _pinned_metrics():
+    m = _METRICS_TABLE_RE.search(OBS_DOC.read_text())
+    assert m, "OBSERVABILITY.md lost its METRICS_TABLE markers"
+    pinned = {}
+    for line in m.group(1).splitlines():
+        cells = [c.strip() for c in line.strip().strip("|").split("|")]
+        if len(cells) != 3 or cells[0] in ("metric", "") or "---" in cells[0]:
+            continue
+        labels = tuple(sorted(x.strip() for x in cells[2].split(",")
+                              if x.strip()))
+        pinned[cells[0]] = (cells[1], labels)
+    assert pinned, "pinned metrics table is empty"
+    return pinned
+
+
+class TestObservabilityDocDrift:
+    """docs/OBSERVABILITY.md's metric table == observe.metrics.CATALOG."""
+
+    def test_pinned_metric_names_match_catalog(self):
+        from analytics_zoo_tpu.observe.metrics import CATALOG
+        pinned = _pinned_metrics()
+        missing = sorted(set(CATALOG) - set(pinned))
+        stale = sorted(set(pinned) - set(CATALOG))
+        assert not missing, \
+            f"CATALOG metrics missing from OBSERVABILITY.md: {missing}"
+        assert not stale, \
+            f"OBSERVABILITY.md pins metrics not in CATALOG: {stale}"
+
+    def test_pinned_types_and_labels_match_catalog(self):
+        from analytics_zoo_tpu.observe.metrics import CATALOG
+        bad = []
+        for name, (typ, labels) in _pinned_metrics().items():
+            if name not in CATALOG:
+                continue
+            cat_typ, _, cat_labels = CATALOG[name]
+            if typ != cat_typ:
+                bad.append(f"{name}: doc type={typ} catalog={cat_typ}")
+            if labels != tuple(sorted(cat_labels)):
+                bad.append(f"{name}: doc labels={labels} "
+                           f"catalog={tuple(sorted(cat_labels))}")
+        assert not bad, ("OBSERVABILITY.md drifted from CATALOG:\n  "
+                         + "\n  ".join(bad))
 
 
 def _bench():

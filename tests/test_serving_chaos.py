@@ -382,6 +382,34 @@ class TestServingChaosSoak:
                     i = int(u[1:]) if u[0] == "c" else int(u[3:])
                     np.testing.assert_allclose(
                         np.asarray(v), np.full((6,), 2.0 * i), rtol=1e-6)
+            # ---- span-chain reconstruction under chaos ---------------
+            # every accepted record's timeline must be rebuildable from
+            # the span ring: a terminal root (ok or typed code) and zero
+            # orphan spans, even for records that were shed, retried,
+            # or answered with a typed error.
+            from analytics_zoo_tpu.observe.trace import TRACER
+            trace_of = {}
+            for d in TRACER.snapshot():
+                if d["name"] == "serving/request":
+                    trace_of[d["attrs"].get("uri")] = d["trace"]
+            typed = {"ok", "expired", "malformed", "decode_error",
+                     "model_error", "internal"}
+            bad_chains = []
+            for u in got:
+                tid = trace_of.get(u)
+                if tid is None:
+                    bad_chains.append((u, "no root span in ring"))
+                    continue
+                chain = TRACER.verify_chain(tid)
+                if not chain["complete"] or chain["orphans"] \
+                        or chain["terminal"] not in typed:
+                    bad_chains.append((u, chain["terminal"],
+                                       len(chain["orphans"])))
+            assert not bad_chains, bad_chains[:10]
+            # shed records carry the typed "expired" terminal
+            for i in range(5):
+                c = TRACER.verify_chain(trace_of[f"old{i}"])
+                assert c["terminal"] == "expired", c
             # counter-verified recovery
             for site in ("serving.replica_crash", "serving.replica_hang",
                          "serving.decode_error", "serving.queue_io",
@@ -446,3 +474,70 @@ class TestStageRestart:
             assert len(got) == 20
         finally:
             srv.stop()
+
+
+class TestSpanChains:
+    """Fast (non-soak) version of the tracing invariant: a healthy
+    pipeline run leaves a complete, orphan-free span chain per record,
+    and device-batch spans link back to their member records."""
+
+    def test_every_record_has_a_complete_chain(self):
+        from analytics_zoo_tpu.observe.trace import TRACER
+
+        m = InferenceModel(lambda xs: xs[0] + 1.0, batch_buckets=(1, 8))
+        q = MemoryQueue()
+        inp, outp = InputQueue(q), OutputQueue(q)
+        srv = ClusterServing(m, q, ServingConfig(
+            batch_size=8, poll_timeout_s=0.02, max_batch_delay_ms=3,
+            decode_workers=2, replicas=2)).start()
+        try:
+            for i in range(24):
+                inp.enqueue(uri=f"sp{i}", x=np.full((4,), i, np.float32))
+            got = _drain(outp, 24)
+            assert len(got) == 24
+        finally:
+            srv.stop()
+
+        trace_of = {d["attrs"].get("uri"): d["trace"]
+                    for d in TRACER.snapshot()
+                    if d["name"] == "serving/request"}
+        batch_members = [d["attrs"].get("members", [])
+                         for d in TRACER.snapshot()
+                         if d["name"] == "serving/device_batch"]
+        for i in range(24):
+            tid = trace_of.get(f"sp{i}")
+            assert tid is not None, f"sp{i} has no root span in the ring"
+            chain = TRACER.verify_chain(tid)
+            assert chain["complete"] and not chain["orphans"], chain
+            assert chain["terminal"] == "ok", chain
+            names = {s["name"] for s in chain["spans"]}
+            assert {"serving/request", "serving/decode",
+                    "serving/batch_wait", "serving/respond"} <= names
+            # the record's trace is listed as a member of some batch span
+            assert any(tid in ms for ms in batch_members), tid
+
+    def test_shed_record_gets_typed_terminal_span(self):
+        from analytics_zoo_tpu.deploy.serving import encode_tensor
+        from analytics_zoo_tpu.observe.trace import TRACER
+
+        m = InferenceModel(lambda xs: xs[0], batch_buckets=(1, 4))
+        q = MemoryQueue()
+        outp = OutputQueue(q)
+        srv = ClusterServing(m, q, ServingConfig(
+            batch_size=4, poll_timeout_s=0.02, decode_workers=1)).start()
+        uri = "stale-span-chain-test"
+        try:
+            q.push({"uri": uri, "ts": time.time() - 10.0,
+                    "ttl_ms": 50.0, "fmt": "tensor",
+                    "x": encode_tensor(np.zeros((4,), np.float32))})
+            got = _drain(outp, 1)
+            assert got[uri]["code"] == "expired"
+        finally:
+            srv.stop()
+
+        # newest matching root: other suites share the process-wide ring
+        tid = [d["trace"] for d in TRACER.snapshot()
+               if d["name"] == "serving/request"
+               and d["attrs"].get("uri") == uri][-1]
+        chain = TRACER.verify_chain(tid)
+        assert chain["complete"] and chain["terminal"] == "expired"

@@ -104,6 +104,21 @@ def test_concurrency_fixture():
     assert len(fs) == 5
 
 
+def test_observe_instrumentation_fixture():
+    """Span/metric instrumentation idioms: the naive retrofit fires
+    (unlocked ring read, per-step host sync for a metric sample); the
+    idiom observe/ actually uses — locked plain fields, deque ring,
+    wall-clock-only timing in the loop — stays clean, so instrumenting
+    a pipeline never costs a THR-GUARD/JG-TRANSFER-HOT finding."""
+    fs = fixture_findings("observe_spans.py")
+    assert scopes_of(fs, "THR-GUARD") == {"NaiveRing.snapshot"}
+    assert scopes_of(fs, "JG-TRANSFER-HOT") == {"record_step_metric_naive"}
+    quiet = {"SpanRing.finish", "SpanRing.snapshot",
+             "SpanRing.completed_count", "record_step_metric_ok"}
+    assert not quiet & {f.scope for f in fs}
+    assert len(fs) == 2
+
+
 # ---------------------------------------------------------------------------
 # suppressions
 # ---------------------------------------------------------------------------

@@ -1,0 +1,125 @@
+"""``core/summary.py``: the no-TF event writer and its scalar reader.
+
+The writer hand-encodes Event protobufs inside TFRecord framing; the
+reader walks every ``events.out.tfevents.*`` file in a dir.  These
+tests pin the round trip, multi-file directories (a restarted run
+appends a second event file), and torn tails — a crash mid-write must
+cost only the torn record, not the whole file.
+"""
+
+import os
+import struct
+
+import pytest
+
+from analytics_zoo_tpu.core.summary import (SummaryWriter, crc32c,
+                                            encode_file_version_event,
+                                            encode_scalar_event,
+                                            read_scalars, write_record)
+
+
+def _write_event_file(path, tagged_values, t0=1700000000.0):
+    """Hand-build a second event file (the writer names files by wall
+    second + hostname, so two writers in the same second would collide)."""
+    with open(path, "wb") as f:
+        write_record(f, encode_file_version_event(t0))
+        for tag, value, step in tagged_values:
+            write_record(f, encode_scalar_event(tag, value, step, t0))
+
+
+class TestRoundTrip:
+    def test_writer_reader_round_trip(self, tmp_path):
+        w = SummaryWriter(str(tmp_path))
+        for step in range(5):
+            w.add_scalar("loss", 2.0 - 0.25 * step, step)
+            w.add_scalar("acc", 0.5 + 0.0625 * step, step)
+        w.close()
+        assert read_scalars(str(tmp_path), "loss") == \
+            [(s, 2.0 - 0.25 * s) for s in range(5)]
+        assert read_scalars(str(tmp_path), "acc") == \
+            [(s, 0.5 + 0.0625 * s) for s in range(5)]
+        assert read_scalars(str(tmp_path), "nope") == []
+
+    def test_float32_precision_and_unicode_tags(self, tmp_path):
+        w = SummaryWriter(str(tmp_path))
+        w.add_scalar("métrique/loss", 0.1, 3)
+        w.close()
+        [(step, v)] = read_scalars(str(tmp_path), "métrique/loss")
+        assert step == 3 and v == pytest.approx(0.1, rel=1e-6)
+
+    def test_empty_dir_reads_empty(self, tmp_path):
+        assert read_scalars(str(tmp_path), "anything") == []
+
+
+class TestMultiFileDirs:
+    def test_second_event_file_is_merged(self, tmp_path):
+        w = SummaryWriter(str(tmp_path))
+        w.add_scalar("loss", 4.0, 0)
+        w.add_scalar("loss", 3.0, 1)
+        w.close()
+        # a restarted run drops a second file into the same dir
+        _write_event_file(
+            str(tmp_path / "events.out.tfevents.9999999999.resumed"),
+            [("loss", 2.0, 2), ("loss", 1.0, 3), ("other", 7.0, 2)])
+        assert read_scalars(str(tmp_path), "loss") == \
+            [(0, 4.0), (1, 3.0), (2, 2.0), (3, 1.0)]
+        assert read_scalars(str(tmp_path), "other") == [(2, 7.0)]
+
+    def test_files_read_in_sorted_order(self, tmp_path):
+        _write_event_file(str(tmp_path / "events.out.tfevents.2.b"),
+                          [("x", 2.0, 2)])
+        _write_event_file(str(tmp_path / "events.out.tfevents.1.a"),
+                          [("x", 1.0, 1)])
+        assert read_scalars(str(tmp_path), "x") == [(1, 1.0), (2, 2.0)]
+
+
+class TestTruncatedTail:
+    def _file_with(self, tmp_path, n):
+        path = str(tmp_path / "events.out.tfevents.1.host")
+        _write_event_file(path, [("v", float(i), i) for i in range(n)])
+        return path
+
+    @pytest.mark.parametrize("cut", [1, 3, 4, 11, 15])
+    def test_torn_last_record_keeps_the_rest(self, tmp_path, cut):
+        path = self._file_with(tmp_path, 4)
+        size = os.path.getsize(path)
+        with open(path, "r+b") as f:
+            f.truncate(size - cut)
+        got = read_scalars(str(tmp_path), "v")
+        # the torn record is dropped; every earlier one survives
+        assert got[: len(got)] == [(i, float(i)) for i in range(len(got))]
+        assert 2 <= len(got) <= 3, got
+
+    def test_truncation_inside_header_keeps_the_rest(self, tmp_path):
+        path = self._file_with(tmp_path, 3)
+        size = os.path.getsize(path)
+        with open(path, "r+b") as f:
+            # leave fewer than the 12 header bytes of the last record
+            f.truncate(size - 40)
+        got = read_scalars(str(tmp_path), "v")
+        assert got == [(i, float(i)) for i in range(len(got))]
+        assert len(got) >= 1
+
+    def test_garbage_length_prefix_stops_cleanly(self, tmp_path):
+        path = self._file_with(tmp_path, 2)
+        with open(path, "ab") as f:
+            f.write(struct.pack("<Q", 1 << 40))  # absurd record length
+        assert read_scalars(str(tmp_path), "v") == [(0, 0.0), (1, 1.0)]
+
+
+class TestFraming:
+    def test_crc32c_known_vectors(self):
+        # RFC 3720 test vectors
+        assert crc32c(b"") == 0x00000000
+        assert crc32c(b"123456789") == 0xE3069283
+        assert crc32c(bytes(32)) == 0x8A9136AA
+
+    def test_record_framing_layout(self, tmp_path):
+        path = str(tmp_path / "f.bin")
+        with open(path, "wb") as f:
+            write_record(f, b"payload")
+        data = open(path, "rb").read()
+        (length,) = struct.unpack("<Q", data[:8])
+        assert length == 7
+        assert data[12:19] == b"payload"
+        assert len(data) == 8 + 4 + 7 + 4
