@@ -149,6 +149,19 @@ class ZooConfig:
     # Verify per-leaf CRC32 manifests on checkpoint restore; torn/corrupt
     # snapshots quarantine and restore falls back to the newest intact one.
     ckpt_verify: bool = True
+    # Multi-controller checkpointing (docs/ROBUSTNESS.md "Distributed
+    # checkpoints & elastic resume"): each process writes only the
+    # shards it owns plus a global manifest, with a two-phase commit so
+    # a host dying mid-save leaves a quarantined partial step, never a
+    # torn "latest".  Off → every process would race on one archive, so
+    # leave this on for any multi-process run.
+    ckpt_distributed: bool = True
+    # Deadline for every cross-process coordination barrier (checkpoint
+    # write/commit phases): a peer missing the barrier for this long is
+    # presumed dead and surfaces as a typed HostLostError instead of a
+    # hang.  Generous default — pod-scale saves can be slow; tests dial
+    # it down to seconds.
+    dist_barrier_timeout_s: float = 120.0
     # RetryPolicy defaults (robust/retry.py) — exponential backoff with
     # jitter, bounded by attempts and an optional wall-clock deadline.
     retry_max_attempts: int = 5

@@ -137,20 +137,10 @@ def init_zoo_context(
                 " multihost coordination args are ignored")
             _DISTRIBUTED_ARGS = _EXTERNAL_CLUSTER
         elif _DISTRIBUTED_ARGS is None:
-            try:
-                jax.distributed.initialize(
-                    coordinator_address=coordinator_address,
-                    num_processes=num_processes, process_id=process_id)
+            if _initialize_distributed(config, coordinator_address,
+                                       num_processes, process_id):
                 _DISTRIBUTED_ARGS = args
-            except RuntimeError:
-                # safety net for when the liveness probe's private API
-                # drifts: an already-initialised cluster must stay a
-                # benign adopt, never a startup crash
-                if not _distributed_client_live():
-                    raise
-                logger.warning(
-                    "jax.distributed already initialised; multihost "
-                    "coordination args are ignored")
+            else:
                 _DISTRIBUTED_ARGS = _EXTERNAL_CLUSTER
         elif _DISTRIBUTED_ARGS is _EXTERNAL_CLUSTER:
             logger.warning(
@@ -182,6 +172,129 @@ def init_zoo_context(
         mesh.axis_names,
     )
     return _GLOBAL_CONTEXT
+
+
+def _initialize_distributed(config: ZooConfig, coordinator_address,
+                            num_processes, process_id) -> bool:
+    """Join (or start) the jax.distributed coordination service, with
+    bounded retry: a slow-starting coordinator, a just-released port
+    still in TIME_WAIT, or a transient DNS hiccup must not fail a worker
+    on first contact — the whole point of elastic restarts is that
+    workers come back at slightly different times.
+
+    Returns True when this call initialised the cluster, False when a
+    live cluster was adopted instead (initialised concurrently by a
+    launcher).  Retries count in ``dist_init_retries_total``.
+    """
+    import jax
+
+    from analytics_zoo_tpu.observe import metrics as obs
+    from analytics_zoo_tpu.robust.retry import RetryPolicy
+
+    # The CPU backend refuses computations that span processes unless an
+    # explicit cross-process collectives layer is configured ("Multiprocess
+    # computations aren't implemented on the CPU backend"), so multihost
+    # on CPU — local elastic rehearsals, the multi-process test suites —
+    # defaults to gloo before the backend client is created.  TPU/GPU
+    # platforms never consult the flag, and a user's explicit choice
+    # (e.g. "mpi") is left alone.
+    try:
+        from jax._src import xla_bridge as _xb
+        if _xb.CPU_COLLECTIVES_IMPLEMENTATION.value in (None, "none"):
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        logger.debug("gloo CPU collectives unavailable on this jaxlib",
+                     exc_info=True)
+
+    adopted = []
+
+    def _attempt():
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes, process_id=process_id)
+        except RuntimeError:
+            # "already initialised" must stay a benign adopt (never a
+            # retry loop, never a startup crash); anything else — refused
+            # connection, bind failure — is transient and retryable
+            if not _distributed_client_live():
+                raise
+            logger.warning(
+                "jax.distributed already initialised; multihost "
+                "coordination args are ignored")
+            adopted.append(True)
+
+    policy = RetryPolicy.from_config(
+        config,
+        retry_on=(RuntimeError, OSError, ConnectionError),
+        name="dist_init",
+        on_retry=lambda attempt, exc: obs.count(
+            "dist_init_retries_total", flat="robust/dist_init_retries"))
+    policy.call(_attempt)
+    return not adopted
+
+
+def dist_barrier(name: str, timeout_s: Optional[float] = None,
+                 phase: str = "other") -> float:
+    """Deadline-bounded cross-process barrier over the jax.distributed
+    coordination service; returns the seconds spent waiting.
+
+    A peer that fails to reach the barrier within ``timeout_s`` (default
+    ``dist_barrier_timeout_s`` from the active config) is presumed dead:
+    the wait raises a typed :class:`~analytics_zoo_tpu.robust.errors.HostLostError`
+    instead of hanging, and the timeout counts in
+    ``dist_barrier_timeouts_total{phase=...}``.  Single-process runs
+    return immediately (0.0) — every caller can be written SPMD-first.
+
+    ``name`` must be unique per synchronisation point (the checkpoint
+    protocol embeds the step number); ``phase`` is the bounded metric
+    label (``write`` / ``commit`` / ``other``).
+    """
+    import time as _time
+
+    import jax
+
+    from analytics_zoo_tpu.observe import metrics as obs
+    from analytics_zoo_tpu.robust import faults
+    from analytics_zoo_tpu.robust.errors import HostLostError
+
+    if timeout_s is None:
+        cfg = (_GLOBAL_CONTEXT.config if _GLOBAL_CONTEXT is not None
+               else ZooConfig())
+        timeout_s = cfg.dist_barrier_timeout_s
+    plan = faults.fire("dist.barrier_timeout")
+    if plan is not None:
+        obs.count("dist_barrier_timeouts_total", phase=phase,
+                  flat="robust/dist_barrier_timeouts")
+        raise (plan.exc if plan.exc is not None else HostLostError(
+            f"barrier {name!r}: injected peer loss "
+            f"(deadline {timeout_s}s)", barrier=name, timeout_s=timeout_s))
+    if jax.process_count() <= 1:
+        return 0.0
+    from jax._src.distributed import global_state
+    client = global_state.client
+    t0 = _time.perf_counter()
+    try:
+        if client is not None and hasattr(client, "wait_at_barrier"):
+            client.wait_at_barrier(name, timeout_in_ms=max(
+                1, int(timeout_s * 1000)))
+        else:
+            # coordination client unavailable (private API moved):
+            # fall back to the device-level sync — correct, but a dead
+            # peer hangs until the collective layer's own timeout
+            from jax.experimental import multihost_utils
+            logger.warning("dist_barrier %r: no coordination client; "
+                           "falling back to sync_global_devices "
+                           "(no deadline)", name)
+            multihost_utils.sync_global_devices(name)
+    except Exception as e:
+        obs.count("dist_barrier_timeouts_total", phase=phase,
+                  flat="robust/dist_barrier_timeouts")
+        raise HostLostError(
+            f"barrier {name!r}: peer missed the {timeout_s}s deadline "
+            f"and is presumed dead ({type(e).__name__}: {e})",
+            barrier=name, timeout_s=timeout_s) from e
+    return _time.perf_counter() - t0
 
 
 def _distributed_client_live() -> bool:

@@ -101,6 +101,19 @@ CATALOG: Dict[str, Tuple[str, str, Tuple[str, ...]]] = {
         "histogram", "checkpoint op wall time", ("op",)),
     "checkpoint_total": (
         "counter", "checkpoint ops, by op and status", ("op", "status")),
+    # distributed checkpointing / multi-controller coordination
+    "checkpoint_shard_bytes": (
+        "histogram", "bytes per distributed checkpoint shard written",
+        ()),
+    "checkpoint_barrier_wait_ms": (
+        "histogram", "wait at the distributed checkpoint barriers, by "
+        "commit phase", ("phase",)),
+    "dist_barrier_timeouts_total": (
+        "counter", "barriers that deadline-expired with a presumed-dead "
+        "peer", ("phase",)),
+    "dist_init_retries_total": (
+        "counter", "jax.distributed.initialize attempts retried",
+        ()),
     # the observability layer itself
     "observe_flight_records_total": (
         "counter", "flight-recorder snapshots captured, by reason",

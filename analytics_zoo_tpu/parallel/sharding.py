@@ -352,6 +352,53 @@ def replica_devices(mesh, axis: str = "data") -> list:
     return list(devs.ravel())
 
 
+def spec_str(arr) -> str:
+    """Compact description of a jax.Array's sharding for checkpoint
+    manifests: ``"replicated"``, a PartitionSpec repr for NamedShardings,
+    or the sharding class name otherwise.  Informational only — restore
+    re-lays arrays out onto the *current* mesh (reshard-on-restore), so
+    the recorded spec never constrains the topology a run resumes at."""
+    sharding = getattr(arr, "sharding", None)
+    if sharding is None or getattr(arr, "is_fully_replicated", True):
+        return "replicated"
+    spec = getattr(sharding, "spec", None)
+    if spec is not None:
+        return str(spec)
+    return type(sharding).__name__
+
+
+def device_put_global(x, sharding):
+    """Place one host array onto a (possibly process-spanning) sharding.
+
+    Single-controller: plain ``device_put``.  Multi-controller: every
+    process holds the full host value (the distributed checkpoint
+    restore reassembles the global tree on every host), so
+    ``make_array_from_callback`` carves out each process's addressable
+    chunks locally — no cross-host traffic, and it works for ANY target
+    sharding, which is what makes restore elastic: a tree saved at one
+    process count lays out onto whatever mesh is live now.
+    """
+    if jax.process_count() > 1:
+        a = np.asarray(x)
+        return jax.make_array_from_callback(
+            a.shape, sharding, lambda idx: a[idx])
+    import jax.numpy as jnp
+
+    return jax.device_put(jnp.asarray(x), sharding)
+
+
+def tree_put_global(tree, shardings):
+    """``device_put_global`` over a pytree of host arrays against a
+    matching pytree of shardings (or one sharding for the whole tree)."""
+    import jax.tree_util as jtu
+
+    is_sharding = lambda s: hasattr(s, "device_set")  # noqa: E731
+    if is_sharding(shardings):
+        return jtu.tree_map(
+            lambda x: device_put_global(x, shardings), tree)
+    return jtu.tree_map(device_put_global, tree, shardings)
+
+
 def make_strategy(name: str, mesh, **kw) -> ShardingStrategy:
     """String lowering (config-system entry point)."""
     name = name.lower()

@@ -25,7 +25,7 @@ See docs/ROBUSTNESS.md for the end-to-end guarantees.
 """
 
 from analytics_zoo_tpu.robust.breaker import CircuitBreaker
-from analytics_zoo_tpu.robust.errors import (DeadlineExpired,
+from analytics_zoo_tpu.robust.errors import (DeadlineExpired, HostLostError,
                                              MalformedRecordError,
                                              ServingError, ServingOverloaded,
                                              TrainingPreempted)
@@ -37,6 +37,7 @@ from analytics_zoo_tpu.robust.supervisor import Heartbeat, Supervisor
 __all__ = [
     "RetryPolicy", "RetryState", "RetryDeadlineExceeded",
     "FaultInjector", "fire", "inject", "TrainingPreempted",
+    "HostLostError",
     "CircuitBreaker", "Supervisor", "Heartbeat",
     "ServingError", "DeadlineExpired", "ServingOverloaded",
     "MalformedRecordError",

@@ -42,6 +42,30 @@ class MalformedRecordError(ServingError, ValueError):
     code = "malformed"
 
 
+class HostLostError(RuntimeError):
+    """A peer process failed to reach a coordination barrier within its
+    deadline and is presumed dead (killed, preempted, or partitioned).
+
+    Raised by ``core.context.dist_barrier`` instead of hanging forever
+    on a dead peer: the distributed checkpoint commit protocol bounds
+    every cross-process wait by ``dist_barrier_timeout_s``, so a host
+    dying mid-save surfaces as this typed error within the deadline —
+    the surviving processes exit (or get restarted by the orchestrator)
+    instead of wedging the whole job.
+
+    Deliberately NOT retried by the Estimator's failure-retry loop: a
+    dead peer cannot be fixed by a local restore-and-retry; the run
+    must be relaunched (possibly at a different process count —
+    restore reshards, see docs/ROBUSTNESS.md).
+    """
+
+    def __init__(self, message: str, barrier: str = "",
+                 timeout_s: float = None):
+        super().__init__(message)
+        self.barrier = barrier
+        self.timeout_s = timeout_s
+
+
 class TrainingPreempted(Exception):
     """Raised by ``Estimator.fit`` after a preemption (SIGTERM or an
     injected fault) has been handled: the final synchronous checkpoint

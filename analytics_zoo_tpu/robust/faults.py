@@ -15,6 +15,15 @@ Sites wired into the stack (call granularity in parentheses):
 - ``estimator.preempt``   — one per train-step; firing simulates SIGTERM
 - ``estimator.resident_nan_rows`` — one per device-resident epoch fit
                             (payload: row indices to poison)
+- ``dist.barrier_timeout``— one per ``core.context.dist_barrier`` call
+                            (firing simulates a peer missing the
+                            deadline: typed ``HostLostError``)
+- ``dist.shard_write``    — one per distributed checkpoint shard write
+                            (raise / ``torn`` truncation, mirroring
+                            ``checkpoint.write`` at shard granularity)
+- ``dist.host_lost``      — one per distributed save/restore entry
+                            (raise → simulate discovering a dead peer
+                            before any I/O happens)
 - ``queue.io``            — one per retried serving-queue I/O operation
 - ``serving.replica_crash``  — one per device-executor batch dispatch
                             (raise → breaker failure → quarantine)

@@ -56,6 +56,11 @@ class RetryPolicy:
     window_s: Optional[float] = None
     retry_on: Tuple[Type[BaseException], ...] = (Exception,)
     name: str = "retry"
+    # observer invoked once per FAILED attempt inside ``call`` (attempt
+    # number, exception) before the backoff sleep — lets call sites feed
+    # labeled metrics (e.g. ``dist_init_retries_total``) without wrapping
+    # the retried function
+    on_retry: Optional[Callable[[int, BaseException], None]] = None
     # injectable for determinism in tests (and to keep chaos suites fast)
     sleep: Callable[[float], None] = time.sleep
     clock: Callable[[], float] = time.monotonic
@@ -100,6 +105,12 @@ class RetryPolicy:
             except self.retry_on as e:
                 attempt += 1
                 TIMERS.incr(f"robust/retry_attempts/{self.name}")
+                if self.on_retry is not None:
+                    try:
+                        self.on_retry(attempt, e)
+                    except Exception:
+                        logger.debug("%s: on_retry observer raised",
+                                     self.name, exc_info=True)
                 if attempt >= self.max_attempts:
                     TIMERS.incr(f"robust/retry_exhausted/{self.name}")
                     raise

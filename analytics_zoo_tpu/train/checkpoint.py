@@ -46,6 +46,7 @@ import logging
 import os
 import pickle
 import re
+import shutil
 import tempfile
 import threading
 import zlib
@@ -56,7 +57,7 @@ import numpy as np
 
 from analytics_zoo_tpu.observe import metrics as obs
 from analytics_zoo_tpu.observe.trace import TRACER
-from analytics_zoo_tpu.robust import RetryPolicy, faults
+from analytics_zoo_tpu.robust import HostLostError, RetryPolicy, faults
 
 logger = logging.getLogger("analytics_zoo_tpu.train")
 
@@ -104,6 +105,61 @@ def _fsync_dir(dirname: str) -> None:
         os.close(fd)
 
 
+def _atomic_npz(path: str, arrays: Dict[str, np.ndarray],
+                fsync: bool = True,
+                fault_site: str = "checkpoint.write") -> None:
+    """Write an ``.npz`` archive atomically + durably: tmp file → fsync →
+    ``os.replace`` → directory fsync.  ``fault_site`` is the chaos hook
+    consulted between the flush and the rename — a planned exception
+    simulates dying mid-write (final path untouched), ``action="torn"``
+    simulates a NON-atomic writer dying (the final path receives a
+    truncated archive)."""
+    dirname = os.path.dirname(os.path.abspath(path)) or "."
+    os.makedirs(dirname, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=dirname, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez(f, **arrays)
+            f.flush()
+            if fsync:
+                os.fsync(f.fileno())
+        plan = faults.fire(fault_site)
+        if plan is not None:
+            if plan.exc is not None:
+                raise plan.exc
+            if plan.action == "torn":
+                frac = plan.payload if plan.payload is not None else 0.5
+                size = os.path.getsize(tmp)
+                with open(tmp, "r+b") as f:
+                    f.truncate(max(1, int(size * float(frac))))
+        os.replace(tmp, path)
+        if fsync:
+            _fsync_dir(dirname)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+
+def _atomic_text(path: str, text: str, fsync: bool = True) -> None:
+    """Small sidecar files (manifest / commit markers) written with the
+    same tmp → fsync → rename discipline as the archives."""
+    dirname = os.path.dirname(os.path.abspath(path)) or "."
+    os.makedirs(dirname, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=dirname, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "w") as f:
+            f.write(text)
+            f.flush()
+            if fsync:
+                os.fsync(f.fileno())
+        os.replace(tmp, path)
+        if fsync:
+            _fsync_dir(dirname)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+
 def save_pytree(path: str, tree: Any, fsync: bool = True) -> None:
     """Atomically + durably save a pytree of arrays/scalars to ``path``.
 
@@ -126,34 +182,8 @@ def save_pytree(path: str, tree: Any, fsync: bool = True) -> None:
     manifest = {"version": FORMAT_VERSION, "leaves": manifest_leaves}
     manifest_bytes = np.frombuffer(
         json.dumps(manifest, sort_keys=True).encode("utf-8"), dtype=np.uint8)
-    dirname = os.path.dirname(os.path.abspath(path)) or "."
-    os.makedirs(dirname, exist_ok=True)
-    # atomic write: tmp + fsync + rename + dir fsync
-    fd, tmp = tempfile.mkstemp(dir=dirname, suffix=".tmp")
-    try:
-        with os.fdopen(fd, "wb") as f:
-            np.savez(f, **{_TREEDEF: treedef_bytes,
-                           _MANIFEST: manifest_bytes}, **arrays)
-            f.flush()
-            if fsync:
-                os.fsync(f.fileno())
-        plan = faults.fire("checkpoint.write")
-        if plan is not None:
-            if plan.exc is not None:
-                raise plan.exc
-            if plan.action == "torn":
-                # simulate a non-atomic writer dying mid-write: the final
-                # path receives a truncated archive
-                frac = plan.payload if plan.payload is not None else 0.5
-                size = os.path.getsize(tmp)
-                with open(tmp, "r+b") as f:
-                    f.truncate(max(1, int(size * float(frac))))
-        os.replace(tmp, path)
-        if fsync:
-            _fsync_dir(dirname)
-    finally:
-        if os.path.exists(tmp):
-            os.unlink(tmp)
+    _atomic_npz(path, {_TREEDEF: treedef_bytes, _MANIFEST: manifest_bytes,
+                       **arrays}, fsync=fsync)
 
 
 def load_pytree(path: str, verify: bool = True) -> Any:
@@ -371,3 +401,561 @@ class CheckpointManager:
                     os.unlink(self._path(s))
                 except OSError:
                     pass
+
+
+# --------------------------------------------------------------------------
+# Distributed (multi-controller) checkpoints
+# --------------------------------------------------------------------------
+
+_COMMITTED = "COMMITTED"
+_MANIFEST_FILE = "MANIFEST.json"
+_DSTEP_RE = re.compile(r"dstep_(\d+)")
+_SHARD_RE = re.compile(r"shard_(\d+)of(\d+)\.npz")
+DIST_FORMAT_VERSION = 1
+
+
+def has_distributed_layout(directory: str) -> bool:
+    """True if ``directory`` holds per-step shard directories written by
+    :class:`DistributedCheckpointManager` — the sniff `set_checkpoint`
+    uses so a single-process run can resume a multi-process run's
+    checkpoints (elastic restore) without being told the format."""
+    try:
+        return any(_DSTEP_RE.fullmatch(fn)
+                   for fn in os.listdir(directory))
+    except OSError:
+        return False
+
+
+def _shard_name(pid: int, nproc: int) -> str:
+    return f"shard_{pid:05d}of{nproc:05d}.npz"
+
+
+def _norm_index(idx, shape) -> Tuple[Tuple[int, int], ...]:
+    """A device's index tuple (slices) → hashable ((start, stop), ...)."""
+    out = []
+    for sl, dim in zip(idx, shape):
+        start, stop, _ = sl.indices(dim)
+        out.append((int(start), int(stop)))
+    return tuple(out)
+
+
+def _global_plan(leaves_with_paths, process_of_device):
+    """The chunk layout of a checkpoint tree — who owns which slice.
+
+    Every process computes this identically from the SPMD-identical tree
+    (no coordination needed): a sharded ``jax.Array`` splits into one
+    chunk per DISTINCT device index (replica copies collapse), owned by
+    the process of the lowest-id device holding it; host leaves and
+    fully-replicated arrays are one full chunk owned by process 0.
+
+    Returns ``(leaf_specs, chunk_table)``: the JSON-ready manifest
+    section keyed by leaf, and a flat ``[(chunk_key, owner, leaf_pos,
+    norm_index)]`` list for writers.
+    """
+    leaf_specs: Dict[str, Dict[str, Any]] = {}
+    chunk_table: List[Tuple[str, int, int, Tuple]] = []
+    cid = 0
+    for i, (p, leaf) in enumerate(leaves_with_paths):
+        key = f"{i:06d}|{_path_str(p)}"
+        shape = tuple(int(d) for d in getattr(leaf, "shape",
+                                              np.shape(leaf)))
+        dtype = str(getattr(leaf, "dtype", np.asarray(leaf).dtype))
+        sharding = getattr(leaf, "sharding", None)
+        chunks = []
+        if sharding is not None and \
+                not getattr(leaf, "is_fully_replicated", True):
+            groups: Dict[Tuple, list] = {}
+            for dev, idx in sharding.devices_indices_map(shape).items():
+                groups.setdefault(_norm_index(idx, shape), []).append(dev)
+            for norm in sorted(groups):
+                owner = int(process_of_device(
+                    min(groups[norm], key=lambda d: d.id)))
+                ckey = f"c{cid:06d}"
+                cid += 1
+                chunks.append({"id": ckey, "shard": owner,
+                               "index": [list(se) for se in norm]})
+                chunk_table.append((ckey, owner, i, norm))
+            from analytics_zoo_tpu.parallel.sharding import spec_str
+            spec = spec_str(leaf)
+        else:
+            norm = tuple((0, d) for d in shape)
+            ckey = f"c{cid:06d}"
+            cid += 1
+            chunks.append({"id": ckey, "shard": 0,
+                           "index": [list(se) for se in norm]})
+            chunk_table.append((ckey, 0, i, norm))
+            spec = "replicated"
+        leaf_specs[key] = {"dtype": dtype, "shape": list(shape),
+                           "sharding": spec, "chunks": chunks}
+    return leaf_specs, chunk_table
+
+
+def _extract_chunk(leaf, norm_index) -> np.ndarray:
+    """The host bytes of one owned chunk.  Only chunks this process owns
+    are ever extracted, so the matching addressable shard must exist."""
+    sharding = getattr(leaf, "sharding", None)
+    if sharding is None or getattr(leaf, "is_fully_replicated", True):
+        return np.asarray(leaf)
+    for s in leaf.addressable_shards:
+        if _norm_index(s.index, leaf.shape) == norm_index:
+            return np.asarray(s.data)
+    raise RuntimeError(
+        f"owned chunk {norm_index} has no addressable shard on this "
+        "process — sharding/ownership plan out of sync")
+
+
+def _read_shard_header(path: str) -> Dict[str, Any]:
+    """The embedded JSON manifest of one shard archive (lazy member read
+    — does not load the chunk arrays)."""
+    with np.load(path, allow_pickle=False) as z:
+        if _MANIFEST not in z.files:
+            raise CheckpointCorruptError(f"{path}: no embedded manifest")
+        return json.loads(z[_MANIFEST].tobytes().decode("utf-8"))
+
+
+def _fire_host_lost() -> None:
+    plan = faults.fire("dist.host_lost")
+    if plan is not None:
+        if plan.exc is not None:
+            raise plan.exc
+        raise HostLostError(
+            "planned host loss (chaos site dist.host_lost)")
+
+
+class DistributedCheckpointManager(CheckpointManager):
+    """Sharded multi-controller checkpoints with a two-phase commit.
+
+    Layout — one directory per step::
+
+        dstep_0000000042/
+          shard_00000of00002.npz   # chunks owned by process 0 (+ treedef)
+          shard_00001of00002.npz   # chunks owned by process 1
+          MANIFEST.json            # process 0, after the write barrier
+          COMMITTED                # process 0, last — the commit point
+
+    Each process writes ONLY the chunks it owns (computed identically
+    everywhere by :func:`_global_plan`, no coordination), embedding the
+    full global layout plus per-chunk CRC32s in its shard.  Commit is
+    two-phase: all processes write+fsync their shard, meet a deadline
+    barrier, then process 0 merges the CRC tables into ``MANIFEST.json``
+    and publishes ``COMMITTED``; a second barrier releases everyone.  A
+    host dying at ANY instant leaves either a fully committed step or an
+    uncommitted directory that restore quarantines — never a torn
+    "latest".  A peer missing a barrier for ``dist_barrier_timeout_s``
+    surfaces as :class:`~analytics_zoo_tpu.robust.HostLostError` instead
+    of a hang.
+
+    Restore is **elastic** (reshard-on-restore): the manifest records
+    the *saved* topology, restore reassembles the full global tree on
+    every host from whatever shards were recorded — so a checkpoint
+    written by 2 processes resumes at 1 or 4 — and the Estimator re-lays
+    it onto the live mesh via ``parallel.sharding.tree_put_global``.
+    ``save_preempt`` (SIGTERM path) writes the local shard plus a
+    ``PREEMPT_<pid>`` marker with NO barrier — restore accepts a step
+    with preempt markers when every recorded chunk verifies.
+
+    The constructor seams (``process_index`` / ``process_count`` /
+    ``process_of_device`` / ``barrier``) exist so single-process tests
+    can simulate several writers over one virtual device mesh.
+    """
+
+    def __init__(self, directory: str, keep: int = 3, verify: bool = True,
+                 retry: Optional[RetryPolicy] = None,
+                 process_index: Optional[int] = None,
+                 process_count: Optional[int] = None,
+                 process_of_device=None,
+                 barrier=None,
+                 barrier_timeout_s: Optional[float] = None):
+        super().__init__(directory, keep=keep, verify=verify, retry=retry)
+        self._pid = process_index
+        self._nproc = process_count
+        self._proc_of_dev = process_of_device or \
+            (lambda d: d.process_index)
+        self._barrier = barrier
+        self._barrier_timeout_s = barrier_timeout_s
+
+    # -- topology ----------------------------------------------------------
+
+    @property
+    def process_index(self) -> int:
+        return jax.process_index() if self._pid is None else self._pid
+
+    @property
+    def process_count(self) -> int:
+        return jax.process_count() if self._nproc is None else self._nproc
+
+    def _barrier_wait(self, name: str, phase: str) -> float:
+        fn = self._barrier
+        if fn is None:
+            from analytics_zoo_tpu.core.context import dist_barrier as fn
+        waited = fn(name, timeout_s=self._barrier_timeout_s,
+                    phase=phase) or 0.0
+        obs.observe("checkpoint_barrier_wait_ms", waited * 1000.0,
+                    flat=f"checkpoint/barrier_{phase}_ms", phase=phase)
+        return waited
+
+    # -- save --------------------------------------------------------------
+
+    def _step_dir(self, step: int) -> str:
+        return os.path.join(self.directory, f"dstep_{step:010d}")
+
+    def _path(self, step: int) -> str:  # quarantine/rename target
+        return self._step_dir(step)
+
+    def _prepare(self, step: int, tree: Any):
+        """Flatten + plan + pull owned chunks to host (synchronous part
+        of every save — after it returns the caller may mutate/donate
+        the device buffers)."""
+        _fire_host_lost()
+        leaves_with_paths, treedef = \
+            jax.tree_util.tree_flatten_with_path(tree)
+        pid, nproc = self.process_index, self.process_count
+        leaf_specs, chunk_table = _global_plan(leaves_with_paths,
+                                               self._proc_of_dev)
+        arrays: Dict[str, np.ndarray] = {}
+        crcs: Dict[str, int] = {}
+        for ckey, owner, leaf_pos, norm in chunk_table:
+            if owner != pid:
+                continue
+            a = _extract_chunk(leaves_with_paths[leaf_pos][1], norm)
+            arrays[ckey] = a
+            crcs[ckey] = _crc32(a)
+        header: Dict[str, Any] = {
+            "version": FORMAT_VERSION, "dist_version": DIST_FORMAT_VERSION,
+            "step": int(step), "process_index": pid,
+            "process_count": nproc, "treedef_shard": 0,
+            "leaves": leaf_specs, "chunk_crcs": crcs,
+        }
+        if pid == 0:
+            treedef_bytes = np.frombuffer(pickle.dumps(treedef),
+                                          dtype=np.uint8)
+            arrays[_TREEDEF] = treedef_bytes
+            header["treedef_crc"] = _crc32(treedef_bytes)
+        arrays[_MANIFEST] = np.frombuffer(
+            json.dumps(header, sort_keys=True).encode("utf-8"),
+            dtype=np.uint8)
+        return header, arrays
+
+    def _write_shard(self, step: int, header, arrays) -> str:
+        d = self._step_dir(step)
+        path = os.path.join(d, _shard_name(header["process_index"],
+                                           header["process_count"]))
+        self._retry.call(_atomic_npz, path, arrays,
+                         fault_site="dist.shard_write")
+        obs.observe("checkpoint_shard_bytes", os.path.getsize(path),
+                    flat="checkpoint/shard_bytes")
+        return path
+
+    def _write_manifest_and_commit(self, step: int, header) -> None:
+        """Process 0, after the write barrier: merge every shard's CRC
+        table into the global manifest, then publish the commit point."""
+        d = self._step_dir(step)
+        nproc = header["process_count"]
+        merged = dict(header)
+        merged["chunk_crcs"] = {}
+        merged["shards"] = []
+        for p in range(nproc):
+            sp = os.path.join(d, _shard_name(p, nproc))
+            h = _read_shard_header(sp)
+            if h["process_count"] != nproc or h["step"] != step:
+                raise CheckpointCorruptError(
+                    f"{sp}: shard header disagrees with the save "
+                    f"(step {h['step']}/{step}, "
+                    f"nproc {h['process_count']}/{nproc})")
+            merged["chunk_crcs"].update(h["chunk_crcs"])
+            merged["shards"].append(os.path.basename(sp))
+        _atomic_text(os.path.join(d, _MANIFEST_FILE),
+                     json.dumps(merged, sort_keys=True, indent=1))
+        _atomic_text(os.path.join(d, _COMMITTED),
+                     json.dumps({"step": int(step),
+                                 "process_count": nproc}))
+
+    def _write_and_commit(self, step: int, prepared,
+                          preempt: bool = False) -> None:
+        header, arrays = prepared
+        self._write_shard(step, header, arrays)
+        if preempt:
+            # no barrier on the SIGTERM path — peers may already be gone
+            _atomic_text(
+                os.path.join(self._step_dir(step),
+                             f"PREEMPT_{header['process_index']:05d}"),
+                json.dumps({"step": int(step),
+                            "process_index": header["process_index"],
+                            "process_count": header["process_count"]}))
+            return
+        self._barrier_wait(f"zoo_ckpt_write_{step}", "write")
+        if header["process_index"] == 0:
+            self._write_manifest_and_commit(step, header)
+        self._barrier_wait(f"zoo_ckpt_commit_{step}", "commit")
+
+    def save(self, step: int, tree: Any) -> str:
+        self.wait()
+        sp = TRACER.start("checkpoint/save", step=step, mode="dist")
+        try:
+            with obs.time_stage("checkpoint_seconds", op="save_dist",
+                                flat="checkpoint/write_dist"):
+                prepared = self._prepare(step, tree)
+                self._write_and_commit(step, prepared)
+        except BaseException as e:
+            obs.count("checkpoint_total", op="save_dist", status="error")
+            sp.end(status="error", error=str(e))
+            raise
+        obs.count("checkpoint_total", op="save_dist", status="ok")
+        sp.end()
+        self._gc()
+        return self._step_dir(step)
+
+    def save_async(self, step: int, tree: Any) -> str:
+        """Chunk extraction happens synchronously (cheap — host copies of
+        owned slices only); the write + both barriers + commit run on a
+        background thread on EVERY process symmetrically, so the barriers
+        still meet.  The barrier deadline bounds how long a background
+        writer can hang on a dead peer; the error lands in
+        ``_writer_err`` and surfaces at the next ``wait()``."""
+        self.wait()
+        prepared = self._prepare(step, tree)
+        sp = TRACER.start("checkpoint/save", step=step, mode="dist_async")
+
+        def write():
+            try:
+                with obs.time_stage("checkpoint_seconds",
+                                    op="save_dist_async",
+                                    flat="checkpoint/write_dist_async"):
+                    self._write_and_commit(step, prepared)
+                obs.count("checkpoint_total", op="save_dist_async",
+                          status="ok")
+                sp.end()
+                self._gc()
+            except BaseException as e:
+                obs.count("checkpoint_total", op="save_dist_async",
+                          status="error")
+                sp.end(status="error", error=str(e))
+                self._writer_err = e  # zoolint: disable=THR-SHARED-MUT(wait() joins the writer thread before reading _writer_err; join() is the happens-before edge)
+
+        self._writer = threading.Thread(target=write, daemon=True)
+        self._writer.start()
+        return self._step_dir(step)
+
+    def save_preempt(self, step: int, tree: Any) -> str:
+        """Final flush on SIGTERM: local shard + ``PREEMPT_<pid>`` marker,
+        no barriers (peers are dying too, on their own schedule).  The
+        step is restorable iff every recorded chunk landed — restore
+        verifies and otherwise falls back to the newest committed step."""
+        self.wait(raise_errors=False)
+        sp = TRACER.start("checkpoint/save", step=step,
+                          mode="dist_preempt")
+        try:
+            with obs.time_stage("checkpoint_seconds", op="save_preempt",
+                                flat="checkpoint/write_preempt"):
+                prepared = self._prepare(step, tree)
+                self._write_and_commit(step, prepared, preempt=True)
+        except BaseException as e:
+            obs.count("checkpoint_total", op="save_preempt",
+                      status="error")
+            sp.end(status="error", error=str(e))
+            raise
+        obs.count("checkpoint_total", op="save_preempt", status="ok")
+        sp.end()
+        return self._step_dir(step)
+
+    # -- listing / gc ------------------------------------------------------
+
+    def all_steps(self) -> List[int]:
+        steps = []
+        with self._fs_lock:
+            try:
+                entries = os.listdir(self.directory)
+            except OSError:
+                return []
+            for fn in entries:
+                m = _DSTEP_RE.fullmatch(fn)
+                if m:
+                    steps.append(int(m.group(1)))
+        return sorted(steps)
+
+    def _gc(self) -> None:
+        # one mutator: process 0 owns deletes (shared filesystem)
+        if self.process_index != 0:
+            return
+        with self._fs_lock:
+            steps = []
+            for fn in os.listdir(self.directory):
+                m = _DSTEP_RE.fullmatch(fn)
+                if m:
+                    steps.append(int(m.group(1)))
+            steps.sort()
+            for s in steps[: max(0, len(steps) - self.keep)]:
+                shutil.rmtree(self._step_dir(s), ignore_errors=True)
+
+    def _quarantine(self, step: int, err: BaseException) -> None:
+        d = self._step_dir(step)
+        if self.process_index == 0:
+            try:
+                with self._fs_lock:
+                    os.replace(d, d + ".corrupt")
+            except OSError:
+                pass
+        obs.count("checkpoint_total", op="restore", status="quarantined",
+                  flat="robust/ckpt_quarantined")
+        logger.warning(
+            "distributed checkpoint step %d is unusable (%s: %s); "
+            "quarantined as %s.corrupt — falling back to an older step",
+            step, type(err).__name__, err, os.path.basename(d))
+
+    # -- restore -----------------------------------------------------------
+
+    def _load_step(self, step: int) -> Any:
+        d = self._step_dir(step)
+        if not os.path.isdir(d):
+            raise FileNotFoundError(d)
+        entries = os.listdir(d)
+        committed = _COMMITTED in entries
+        preempt = any(fn.startswith("PREEMPT_") for fn in entries)
+        if not committed and not preempt:
+            raise CheckpointCorruptError(
+                f"{d}: no COMMITTED marker and no preempt flush — a host "
+                "died mid-save")
+        manifest = None
+        if _MANIFEST_FILE in entries:
+            with open(os.path.join(d, _MANIFEST_FILE)) as f:
+                manifest = json.load(f)
+        if manifest is None:
+            # preempt flush: no global manifest — every shard embeds the
+            # identical global layout, so any present shard serves
+            shard_files = sorted(fn for fn in entries
+                                 if _SHARD_RE.fullmatch(fn))
+            if not shard_files:
+                raise CheckpointCorruptError(f"{d}: no shards")
+            manifest = _read_shard_header(os.path.join(d, shard_files[0]))
+        nproc_rec = int(manifest["process_count"])
+        if int(manifest["step"]) != step:
+            raise CheckpointCorruptError(
+                f"{d}: manifest step {manifest['step']} != {step}")
+        leaves_spec = manifest["leaves"]
+        # merged CRC table when the global manifest has one (committed
+        # saves); shard-embedded tables are checked either way
+        global_crcs = manifest.get("chunk_crcs", {}) \
+            if _MANIFEST_FILE in entries else {}
+
+        # chunks grouped by owning shard so each archive opens once
+        by_shard: Dict[int, List[Tuple[str, str]]] = {}
+        for key, ent in leaves_spec.items():
+            for ch in ent["chunks"]:
+                by_shard.setdefault(int(ch["shard"]), []).append(
+                    (ch["id"], key))
+        treedef_shard = int(manifest.get("treedef_shard", 0))
+        by_shard.setdefault(treedef_shard, [])
+
+        chunk_data: Dict[str, np.ndarray] = {}
+        treedef_bytes = None
+        for p, wanted in sorted(by_shard.items()):
+            path = os.path.join(d, _shard_name(p, nproc_rec))
+            if not os.path.exists(path):
+                raise CheckpointCorruptError(
+                    f"{d}: missing shard {p}/{nproc_rec}")
+            with np.load(path, allow_pickle=False) as z:
+                if _MANIFEST not in z.files:
+                    raise CheckpointCorruptError(
+                        f"{path}: no embedded manifest")
+                h = json.loads(z[_MANIFEST].tobytes().decode("utf-8"))
+                if h["process_count"] != nproc_rec or h["step"] != step:
+                    raise CheckpointCorruptError(
+                        f"{path}: shard header disagrees with manifest "
+                        f"(step {h['step']}/{step}, "
+                        f"nproc {h['process_count']}/{nproc_rec})")
+                for ckey, _leaf in wanted:
+                    if ckey not in z.files:
+                        raise CheckpointCorruptError(
+                            f"{path}: chunk {ckey} missing")
+                    a = z[ckey]
+                    if self.verify:
+                        crc = _crc32(a)
+                        want = h.get("chunk_crcs", {}).get(ckey)
+                        if want is not None and crc != want:
+                            raise CheckpointCorruptError(
+                                f"{path}: CRC mismatch on chunk {ckey}")
+                        gwant = global_crcs.get(ckey)
+                        if gwant is not None and crc != gwant:
+                            raise CheckpointCorruptError(
+                                f"{path}: chunk {ckey} disagrees with "
+                                "the global manifest CRC")
+                    chunk_data[ckey] = a
+                if p == treedef_shard:
+                    if _TREEDEF not in z.files:
+                        raise CheckpointCorruptError(
+                            f"{path}: treedef missing")
+                    treedef_bytes = z[_TREEDEF]
+                    want = manifest.get("treedef_crc")
+                    if self.verify and want is not None and \
+                            _crc32(treedef_bytes) != want:
+                        raise CheckpointCorruptError(
+                            f"{path}: treedef CRC mismatch")
+
+        # reassemble the global tree (elastic: independent of the live
+        # process count — the Estimator re-lays it onto the current mesh)
+        leaves = []
+        for key in sorted(leaves_spec,
+                          key=lambda k: int(k.split("|", 1)[0])):
+            ent = leaves_spec[key]
+            shape = tuple(ent["shape"])
+            chunks = ent["chunks"]
+            first = chunk_data[chunks[0]["id"]]
+            if len(chunks) == 1:
+                out = first.reshape(shape)
+            else:
+                out = np.empty(shape, dtype=first.dtype)
+                covered = 0
+                for ch in chunks:
+                    a = chunk_data[ch["id"]]
+                    sl = tuple(slice(s, e) for s, e in ch["index"])
+                    out[sl] = a
+                    covered += int(a.size)
+                if covered != out.size:
+                    raise CheckpointCorruptError(
+                        f"{d}: leaf {key!r} chunks cover {covered} of "
+                        f"{out.size} elements")
+            leaves.append(out)
+        treedef = pickle.loads(treedef_bytes.tobytes())
+        return jax.tree_util.tree_unflatten(treedef, leaves)
+
+    def restore(self, step: Optional[int] = None) -> Tuple[int, Any]:
+        """Newest restorable step wins: a step is eligible iff it has a
+        ``COMMITTED`` marker (normal save) or any ``PREEMPT_*`` marker
+        (SIGTERM flush), and every recorded chunk is present and CRC-
+        clean.  Anything else is quarantined (renamed ``*.corrupt`` by
+        process 0) and the walk continues to the next-older step; an
+        explicitly requested step is loaded strictly."""
+        self.wait(raise_errors=False)
+        _fire_host_lost()
+        sp = TRACER.start("checkpoint/restore", step=step, mode="dist")
+        with obs.time_stage("checkpoint_seconds", op="restore"):
+            try:
+                if step is not None:
+                    tree = self._load_step(step)
+                    obs.count("checkpoint_total", op="restore",
+                              status="ok")
+                    sp.end(restored_step=step)
+                    return step, tree
+                steps = self.all_steps()
+                if not steps:
+                    raise FileNotFoundError(
+                        f"no checkpoints in {self.directory}")
+                for s in reversed(steps):
+                    try:
+                        tree = self._load_step(s)
+                        obs.count("checkpoint_total", op="restore",
+                                  status="ok")
+                        sp.end(restored_step=s)
+                        return s, tree
+                    except KeyboardInterrupt:
+                        raise
+                    except Exception as e:
+                        self._quarantine(s, e)
+                raise FileNotFoundError(
+                    f"no intact checkpoints in {self.directory} "
+                    f"({len(steps)} candidate(s) quarantined)")
+            except BaseException as e:
+                obs.count("checkpoint_total", op="restore",
+                          status="error")
+                sp.end(status="error", error=str(e))
+                raise

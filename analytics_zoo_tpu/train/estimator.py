@@ -58,7 +58,8 @@ from analytics_zoo_tpu.observe.export import publish_to_summary, to_prometheus
 from analytics_zoo_tpu.observe.trace import TRACER
 from analytics_zoo_tpu.nn import metrics as metrics_lib
 from analytics_zoo_tpu.nn import objectives
-from analytics_zoo_tpu.robust import RetryPolicy, TrainingPreempted, faults
+from analytics_zoo_tpu.robust import (HostLostError, RetryPolicy,
+                                      TrainingPreempted, faults)
 from analytics_zoo_tpu.train import checkpoint as ckpt_lib
 from analytics_zoo_tpu.train import optimizers as optim_lib
 from analytics_zoo_tpu.train import prefetch as prefetch_lib
@@ -198,8 +199,21 @@ class Estimator:
     # ------------------------------------------------------------------
     def set_checkpoint(self, path: str, over_write: bool = True,
                        trigger: Optional[Trigger] = None, keep: int = 3):
-        self._ckpt_mgr = ckpt_lib.CheckpointManager(
-            path, keep=keep, verify=self.ctx.config.ckpt_verify)
+        cfg = self.ctx.config
+        # Multi-controller runs get the sharded two-phase manager; so
+        # does ANY run resuming a directory that already holds the
+        # distributed layout — that's the elastic path (a 1-process run
+        # restoring a 2-process run's shards).
+        distributed = cfg.ckpt_distributed and (
+            jax.process_count() > 1
+            or ckpt_lib.has_distributed_layout(path))
+        if distributed:
+            self._ckpt_mgr = ckpt_lib.DistributedCheckpointManager(
+                path, keep=keep, verify=cfg.ckpt_verify,
+                barrier_timeout_s=cfg.dist_barrier_timeout_s)
+        else:
+            self._ckpt_mgr = ckpt_lib.CheckpointManager(
+                path, keep=keep, verify=cfg.ckpt_verify)
         if trigger is not None:
             self._ckpt_trigger = trigger
         return self
@@ -926,7 +940,12 @@ class Estimator:
         fit with :class:`TrainingPreempted`."""
         step = self.global_step
         if self._ckpt_mgr is not None:
-            self._ckpt_mgr.save(step, self._snapshot(
+            # DistributedCheckpointManager flushes barrier-free (peers
+            # are dying on their own schedule); the single-process
+            # manager's plain save is already barrier-free
+            saver = getattr(self._ckpt_mgr, "save_preempt",
+                            self._ckpt_mgr.save)
+            saver(step, self._snapshot(
                 resume_epoch=epoch, in_epoch_step=in_epoch_step,
                 epoch_rng_state=epoch_rng_state))
             TIMERS.incr("robust/preempt_flush")
@@ -1242,10 +1261,12 @@ class Estimator:
                 if end_trigger is not None and end_trigger(tstate):
                     break
             except (KeyboardInterrupt, TrainingPreempted,
-                    FloatingPointError):
+                    FloatingPointError, HostLostError):
                 # release the prefetch producer (its sentinel delivery
-                # waits for close() on abandonment); preemption and the
-                # "raise" NaN policy must surface, never be retried
+                # waits for close() on abandonment); preemption, the
+                # "raise" NaN policy, and a dead peer must surface, never
+                # be retried (retrying solo past a lost host would fork
+                # the SPMD program)
                 if batches is not None and hasattr(batches, "close"):
                     batches.close()
                 raise
@@ -1678,20 +1699,25 @@ class Estimator:
         logger.info("checkpoint saved: %s", path)
 
     def _restore_checkpoint(self):
+        from analytics_zoo_tpu.parallel.sharding import tree_put_global
         step, tree = self._ckpt_mgr.restore()
         rep = self.ctx.replicated_sharding()
-        self.params = jax.device_put(tree["params"],
-                                     self._param_shardings(tree["params"]))
-        self.state = jax.device_put(tree["state"], rep)
+        # tree_put_global is the reshard-on-restore seam: restore hands
+        # back the FULL global host tree on every process, and placement
+        # re-lays it onto whatever mesh is live now — so a checkpoint
+        # written at one process count resumes at another
+        self.params = tree_put_global(tree["params"],
+                                      self._param_shardings(tree["params"]))
+        self.state = tree_put_global(tree["state"], rep)
         try:
             # mirror a fresh init's shardings (matches TP param splits)
-            self.opt_state = jax.device_put(tree["opt_state"],
-                                            self._opt_shardings())
+            self.opt_state = tree_put_global(tree["opt_state"],
+                                             self._opt_shardings())
         except (ValueError, TypeError) as e:
             logger.warning(
                 "optimizer-state shardings could not be mirrored (%s); "
                 "restoring replicated — TP runs lose opt-state sharding", e)
-            self.opt_state = jax.device_put(tree["opt_state"], rep)
+            self.opt_state = tree_put_global(tree["opt_state"], rep)
         self.global_step = int(tree["meta"]["global_step"])
         self.finished_epochs = int(tree["meta"]["finished_epochs"])
         meta = tree["meta"]
@@ -1727,6 +1753,6 @@ class Estimator:
         logger.info("restored checkpoint step %d", step)
 
     def load_checkpoint(self, directory: str):
-        self._ckpt_mgr = ckpt_lib.CheckpointManager(directory)
+        self.set_checkpoint(directory)
         self._restore_checkpoint()
         return self

@@ -1,0 +1,93 @@
+"""Shared harness for the multi-process integration/chaos suites.
+
+Spawns N ``multiprocess_worker.py`` OS processes joined through a gloo
+coordination service on a free localhost port, with the topology and
+scenario fully CLI-driven.  Worker stdout/stderr is teed to
+``ZOO_MP_LOG_DIR`` (default: the test's tmp dir) so CI can upload the
+logs as an artifact when a chaos scenario goes sideways.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+from typing import Dict, List, Optional
+
+WORKER = os.path.join(os.path.dirname(__file__), "multiprocess_worker.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _log_dir(tmp_path) -> str:
+    d = os.environ.get("ZOO_MP_LOG_DIR") or str(tmp_path)
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def run_workers(nproc: int, tmp_path, tag: str, *,
+                scenario: str = "train",
+                ckpt_dir: Optional[str] = None,
+                epochs: int = 3,
+                die_step: Optional[int] = None,
+                die_pid: Optional[int] = None,
+                barrier_timeout: Optional[float] = None,
+                global_devices: int = 4,
+                timeout: float = 240,
+                expect_rc: Optional[Dict[int, int]] = None) -> List[Optional[dict]]:
+    """Run one multi-process scenario to completion.
+
+    ``expect_rc`` maps process id -> expected exit code (default 0 for
+    every process — chaos scenarios expect 19 from workers planned to
+    die).  Returns each worker's parsed outfile JSON, or None for
+    workers that died before writing one (allowed only when their
+    expected exit code is non-zero).
+    """
+    port = _free_port()
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    logs = _log_dir(tmp_path)
+    procs, outs = [], []
+    for pid in range(nproc):
+        out = tmp_path / f"{tag}_{pid}.json"
+        outs.append(out)
+        cmd = [sys.executable, WORKER,
+               "--process-id", str(pid),
+               "--num-processes", str(nproc),
+               "--port", str(port),
+               "--outfile", str(out),
+               "--global-devices", str(global_devices),
+               "--epochs", str(epochs),
+               "--scenario", scenario]
+        if ckpt_dir:
+            cmd += ["--ckpt-dir", str(ckpt_dir)]
+        if die_step is not None:
+            cmd += ["--die-step", str(die_step)]
+        if die_pid is not None:
+            cmd += ["--die-pid", str(die_pid)]
+        if barrier_timeout is not None:
+            cmd += ["--barrier-timeout", str(barrier_timeout)]
+        procs.append(subprocess.Popen(
+            cmd, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True))
+    captured = [p.communicate(timeout=timeout)[0] for p in procs]
+    for pid, (p, log) in enumerate(zip(procs, captured)):
+        with open(os.path.join(logs, f"{tag}_{pid}.log"), "w") as f:
+            f.write(log)
+        want = (expect_rc or {}).get(pid, 0)
+        assert p.returncode == want, (
+            f"worker {pid} exited {p.returncode}, expected {want}:\n"
+            f"{log[-3000:]}")
+    results: List[Optional[dict]] = []
+    for pid, out in enumerate(outs):
+        if out.exists():
+            results.append(json.loads(out.read_text()))
+        else:
+            assert (expect_rc or {}).get(pid, 0) != 0, (
+                f"worker {pid} exited cleanly but wrote no outfile")
+            results.append(None)
+    return results

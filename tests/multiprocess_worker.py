@@ -1,35 +1,98 @@
-"""Worker for the real two-process ``jax.distributed`` test.
+"""Worker for the real multi-process ``jax.distributed`` tests.
 
-Each process forces a 2-device virtual CPU backend, joins the gloo
-coordination service, assembles the 4-device GLOBAL mesh through
-``init_zoo_context(multihost=True, ...)``, and trains the same tiny
-model on its process-LOCAL half of every global batch.  The final loss
-history is written to ``outfile`` so the parent can assert parity with
-a single-process 4-device run of the identical problem.
+Each process forces ``--local-devices`` virtual CPU devices, joins the
+gloo coordination service, assembles the ``--global-devices`` GLOBAL
+mesh through ``init_zoo_context(multihost=True, ...)``, and trains the
+same tiny model on its process-LOCAL rows of every global batch.  The
+topology is fully CLI-driven so the same worker runs 1-, 2- and 4-process
+shapes (elastic-resume tests restart it at a different process count
+against the same checkpoint directory).
+
+Scenarios (``--scenario``):
+
+- ``train``    — plain fit; writes losses / predictions / eval summary.
+- ``resume``   — ``fit(resume=True)`` against ``--ckpt-dir``; same output.
+- ``preempt``  — a planned ``estimator.preempt`` fault at dispatch
+                 ``--die-step`` simulates SIGTERM on every process: each
+                 flushes its final local shard (``save_preempt``) and
+                 exits cleanly reporting the preemption step.
+- ``die``      — hard host death: ``os._exit(19)`` from inside the
+                 training loop at dispatch ``--die-step`` (no flush, no
+                 goodbye — the crash the two-phase commit must survive).
+- ``die_save`` — host death MID-SAVE: the ``--die-pid`` process dies
+                 during its shard write of checkpoint ``--die-step``
+                 (0-based save index); survivors must surface a typed
+                 ``HostLostError`` within the barrier deadline instead
+                 of hanging, and the half-written step must never
+                 become "latest".
 
 Replaces (and automates) the reference's manual two-executor
 integration script (pyzoo/test/zoo/ray/integration/ray_on_yarn.py:23-33).
-
-Usage: multiprocess_worker.py <process_id> <num_processes> <port> <outfile>
 """
 
+import argparse
 import json
 import os
 import sys
+import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def main() -> None:
-    pid, nproc = int(sys.argv[1]), int(sys.argv[2])
-    port, outfile = sys.argv[3], sys.argv[4]
+class _HostDeath(BaseException):
+    """Raised by the planned mid-save fault; a BaseException so no
+    retry/recovery layer can swallow it on the way out — the worker
+    converts it into a hard ``os._exit`` (simulated host death)."""
 
-    # 4 global devices regardless of process count: nproc processes each
-    # expose 4/nproc local CPU devices, so the single-process reference
-    # run and the two-process run see the SAME mesh and global batches.
+
+def parse_args(argv=None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--process-id", type=int, required=True)
+    p.add_argument("--num-processes", type=int, required=True)
+    p.add_argument("--port", type=int, required=True)
+    p.add_argument("--outfile", required=True)
+    p.add_argument("--global-devices", type=int, default=4,
+                   help="global mesh size; identical across process "
+                        "counts so trajectories are comparable")
+    p.add_argument("--local-devices", type=int, default=0,
+                   help="devices this process exposes "
+                        "(0 = global/num-processes)")
+    p.add_argument("--scenario", default="train",
+                   choices=["train", "resume", "preempt", "die",
+                            "die_save"])
+    p.add_argument("--ckpt-dir", default="",
+                   help="checkpoint directory (enables checkpointing)")
+    p.add_argument("--die-step", type=int, default=4,
+                   help="0-based dispatch index (preempt/die) or save "
+                        "index (die_save) at which the fault fires")
+    p.add_argument("--die-pid", type=int, default=-1,
+                   help="process the fault targets (-1 = all)")
+    p.add_argument("--epochs", type=int, default=3)
+    p.add_argument("--seed", type=int, default=7)
+    p.add_argument("--barrier-timeout", type=float, default=20.0,
+                   help="dist_barrier_timeout_s for this run")
+    p.add_argument("--async-checkpoint", action="store_true",
+                   help="use async checkpoint writes (chaos scenarios "
+                        "want the deterministic sync path)")
+    return p.parse_args(argv)
+
+
+def _exit_hard(code: int) -> None:
+    """Die like a lost host: no atexit, no jax.distributed shutdown
+    handshake (which would hang on the already-dead peer)."""
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os._exit(code)
+
+
+def main() -> None:
+    args = parse_args()
+    pid, nproc = args.process_id, args.num_processes
+    local_devices = args.local_devices or args.global_devices // nproc
+
     os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
                                + f" --xla_force_host_platform_device_count="
-                                 f"{4 // nproc}").strip()
+                                 f"{local_devices}").strip()
     import jax
 
     jax.config.update("jax_platforms", "cpu")
@@ -38,17 +101,20 @@ def main() -> None:
     from analytics_zoo_tpu.nn import Sequential
     from analytics_zoo_tpu.nn.layers.core import Dense
 
+    cfg_kw = dict(seed=args.seed,
+                  dist_barrier_timeout_s=args.barrier_timeout,
+                  async_checkpoint=bool(args.async_checkpoint))
     if nproc > 1:
         ctx = init_zoo_context(
             multihost=True,
-            coordinator_address=f"127.0.0.1:{port}",
+            coordinator_address=f"127.0.0.1:{args.port}",
             num_processes=nproc,
             process_id=pid,
-            seed=7,
+            **cfg_kw,
         )
     else:
-        ctx = init_zoo_context(seed=7)
-    assert ctx.num_devices == 4, ctx.num_devices
+        ctx = init_zoo_context(**cfg_kw)
+    assert ctx.num_devices == args.global_devices, ctx.num_devices
     assert ctx.process_count == nproc
 
     # deterministic problem; every process generates the full dataset and
@@ -65,8 +131,8 @@ def main() -> None:
     g_batch = 16
     local = g_batch // nproc
     # rows of global batch k that live on THIS process's devices: the
-    # data axis is laid out [dev0..dev3] = [p0.d0, p0.d1, p1.d0, p1.d1],
-    # so process p owns the contiguous middle slice of every batch.
+    # data axis is laid out process-major, so process p owns the
+    # contiguous p-th slice of every global batch.
     keep = np.concatenate([
         np.arange(k * g_batch + pid * local,
                   k * g_batch + (pid + 1) * local)
@@ -76,17 +142,95 @@ def main() -> None:
     model = Sequential([Dense(16, activation="relu"),
                         Dense(classes, activation="softmax")])
     model.compile(optimizer="adam", loss="sparse_categorical_crossentropy")
-    hist = model.fit(x_loc, y_loc, batch_size=local, epochs=3,
-                     shuffle=False, verbose=False)
+    if args.ckpt_dir:
+        model.set_checkpoint(args.ckpt_dir)
+
+    fit_kw = dict(batch_size=local, epochs=args.epochs, shuffle=False,
+                  verbose=False)
+
+    from analytics_zoo_tpu.robust import (FaultInjector, HostLostError,
+                                          TrainingPreempted)
+
+    targeted = args.die_pid < 0 or args.die_pid == pid
+
+    if args.scenario == "preempt":
+        fi = FaultInjector()
+        if targeted:
+            fi.plan("estimator.preempt", at=args.die_step)
+        try:
+            with fi:
+                model.fit(x_loc, y_loc, **fit_kw)
+        except TrainingPreempted as e:
+            with open(args.outfile, "w") as f:
+                json.dump({"process_id": pid, "scenario": "preempt",
+                           "preempted_step": int(e.step)}, f)
+            # peers were "preempted" too; skip the distributed shutdown
+            # handshake with processes that may already be gone
+            _exit_hard(0)
+        raise SystemExit("preempt scenario finished without preempting")
+
+    if args.scenario == "die":
+        from analytics_zoo_tpu.train.estimator import Estimator
+
+        orig = Estimator._dispatch_step
+        calls = {"n": 0}
+
+        def dying_dispatch(self, *a, **kw):
+            if targeted and calls["n"] == args.die_step:
+                print(f"worker {pid}: dying hard at dispatch "
+                      f"{calls['n']}", flush=True)
+                _exit_hard(19)
+            calls["n"] += 1
+            return orig(self, *a, **kw)
+
+        Estimator._dispatch_step = dying_dispatch
+        model.fit(x_loc, y_loc, **fit_kw)
+        raise SystemExit("die scenario finished without dying")
+
+    if args.scenario == "die_save":
+        fi = FaultInjector()
+        if targeted:
+            fi.plan("dist.shard_write", at=args.die_step,
+                    exc=_HostDeath("host died mid shard write"))
+        t0 = time.monotonic()
+        try:
+            with fi:
+                model.fit(x_loc, y_loc, **fit_kw)
+        except _HostDeath:
+            print(f"worker {pid}: dying hard mid-save", flush=True)
+            _exit_hard(19)
+        except HostLostError as e:
+            # the survivor's report: the dead peer surfaced as a typed
+            # error within the barrier deadline, not a hang
+            with open(args.outfile, "w") as f:
+                json.dump({"process_id": pid, "scenario": "die_save",
+                           "error": "HostLostError",
+                           "barrier": e.barrier,
+                           "timeout_s": e.timeout_s,
+                           "elapsed_s": time.monotonic() - t0}, f)
+            _exit_hard(0)
+        raise SystemExit("die_save scenario finished without host loss")
+
+    # train / resume
+    hist = model.fit(x_loc, y_loc,
+                     resume=(args.scenario == "resume"), **fit_kw)
 
     # the process-crossing predict/evaluate paths must agree with the
     # single-process run too (order-insensitive summaries)
     preds = model.predict(x_loc, batch_size=local)
     ev = model.evaluate(x_loc, y_loc, batch_size=local)
+    est = model._estimator
+    param_sum = float(sum(
+        np.asarray(leaf).sum()
+        for leaf in jax.tree_util.tree_leaves(est.params)))
 
-    with open(outfile, "w") as f:
+    with open(args.outfile, "w") as f:
         json.dump({"process_id": pid,
+                   "scenario": args.scenario,
                    "losses": [h["loss"] for h in hist],
+                   "finished_epochs": int(est.finished_epochs),
+                   "global_step": int(est.global_step),
+                   "param_sum": param_sum,
                    "pred_rows": int(np.asarray(preds).shape[0]),
                    "pred_sum": float(np.asarray(preds).sum()),
                    "eval_loss": float(ev["loss"])}, f)

@@ -1,0 +1,498 @@
+"""Unit suite for the distributed checkpoint layer (train/checkpoint.py).
+
+The multi-process chaos suite (test_multiprocess_chaos.py) proves the
+protocol against real OS processes; this suite covers the same machinery
+fast and in-process, in tier-1, by simulating several writer processes
+over the 8-device virtual CPU mesh through the
+``DistributedCheckpointManager`` constructor seams (``process_index`` /
+``process_count`` / ``process_of_device`` / ``barrier``):
+
+- shard layout: each fake process writes ONLY the chunks it owns, the
+  global manifest records the full plan with per-chunk CRCs;
+- elastic restore: a 2-writer checkpoint reassembles bit-exactly under
+  a 1- or 4-process manager (reshard-on-restore);
+- the corruption matrix (ISSUE satellite): missing shard, CRC-tampered
+  chunk, manifest/process-count mismatch, absent COMMITTED marker, torn
+  shard write — each quarantines the step and falls back to the newest
+  intact one;
+- preempt flushes: restorable when complete, quarantined when a peer's
+  shard never landed;
+- the deadline barrier and multihost-init retry plumbing
+  (core/context.py) with injected faults.
+"""
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def fresh_names():
+    from analytics_zoo_tpu.nn import reset_name_scope
+
+    reset_name_scope()
+
+
+@pytest.fixture(autouse=True)
+def default_ctx():
+    """Config knobs are per-test; restore defaults afterwards."""
+    yield
+    from analytics_zoo_tpu import init_zoo_context
+
+    init_zoo_context()
+
+
+def _counters():
+    from analytics_zoo_tpu.core.profiling import TIMERS
+
+    return TIMERS
+
+
+def _tree(scale=1.0):
+    """A checkpoint tree with every chunk flavour: a data-sharded matrix
+    (8 distinct device slices → 4 chunks per fake process), a fully
+    replicated vector, and plain host leaves."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices()).reshape(-1), ("data",))
+    return {
+        "params": {
+            "w": jax.device_put(
+                (jnp.arange(32.0).reshape(8, 4) + 1.0) * scale,
+                NamedSharding(mesh, P("data"))),
+            "b": jax.device_put(jnp.full((3,), scale),
+                                NamedSharding(mesh, P())),
+        },
+        "meta": {"step": np.int64(round(scale)),
+                 "hist": np.arange(5.0) * scale},
+    }
+
+
+def _split_at_4(dev):
+    """Two fake processes over the 8-device mesh: devices 0-3 → 0,
+    devices 4-7 → 1."""
+    return 0 if dev.id < 4 else 1
+
+
+def _noop_barrier(name, timeout_s=None, phase="other"):
+    return 0.0
+
+
+def _managers(directory, nproc=2, barrier=_noop_barrier, **kw):
+    from analytics_zoo_tpu.train.checkpoint import \
+        DistributedCheckpointManager
+
+    return [DistributedCheckpointManager(
+        str(directory), process_index=p, process_count=nproc,
+        process_of_device=_split_at_4, barrier=barrier, **kw)
+        for p in range(nproc)]
+
+
+def _save_all(managers, step, tree):
+    # non-zero writers first: process 0's save ends with the commit
+    # merge, which reads every peer shard
+    for m in managers[1:]:
+        m.save(step, tree)
+    managers[0].save(step, tree)
+
+
+def _assert_tree_equal(want, got):
+    import jax
+
+    lw, tw = jax.tree_util.tree_flatten(want)
+    lg, tg = jax.tree_util.tree_flatten(got)
+    assert tw == tg
+    for a, b in zip(lw, lg):
+        a, b = np.asarray(a), np.asarray(b)
+        assert a.dtype == b.dtype
+        assert np.array_equal(a, b)
+
+
+# ---------------------------------------------------------------------------
+# shard layout + two-phase commit
+# ---------------------------------------------------------------------------
+
+class TestShardedSave:
+    def test_each_process_writes_only_owned_chunks(self, zoo_ctx, tmp_path):
+        tree = _tree()
+        _save_all(_managers(tmp_path), 5, tree)
+
+        d = tmp_path / "dstep_0000000005"
+        assert sorted(os.listdir(d)) == [
+            "COMMITTED", "MANIFEST.json",
+            "shard_00000of00002.npz", "shard_00001of00002.npz"]
+
+        man = json.loads((d / "MANIFEST.json").read_text())
+        assert man["process_count"] == 2
+        assert man["step"] == 5
+        specs = man["leaves"]
+        # the merged CRC table covers every chunk of every leaf
+        assert set(man["chunk_crcs"]) == {
+            c["id"] for s in specs.values() for c in s["chunks"]}
+
+        w = next(s for s in specs.values() if s["shape"] == [8, 4])
+        assert len(w["chunks"]) == 8
+        owners = [c["shard"] for c in sorted(
+            w["chunks"], key=lambda c: c["index"][0][0])]
+        assert owners == [0, 0, 0, 0, 1, 1, 1, 1]
+        b = next(s for s in specs.values() if s["shape"] == [3])
+        assert b["sharding"] == "replicated"
+        assert [c["shard"] for c in b["chunks"]] == [0]
+
+        # shard 1 holds EXACTLY the rows-4..8 chunks of w (+ its header)
+        mine = sorted((c for c in w["chunks"] if c["shard"] == 1),
+                      key=lambda c: c["index"][0][0])
+        with np.load(d / "shard_00001of00002.npz") as z:
+            assert set(z.files) == {"__manifest__"} | {c["id"] for c in mine}
+            rows = np.concatenate([z[c["id"]] for c in mine])
+        assert np.array_equal(rows, np.asarray(tree["params"]["w"])[4:8])
+        # the treedef travels in shard 0 only
+        with np.load(d / "shard_00000of00002.npz") as z0:
+            assert "__treedef__" in z0.files
+
+    def test_layout_sniff(self, zoo_ctx, tmp_path):
+        from analytics_zoo_tpu.train.checkpoint import has_distributed_layout
+
+        assert not has_distributed_layout(str(tmp_path))
+        assert not has_distributed_layout(str(tmp_path / "missing"))
+        _save_all(_managers(tmp_path), 1, _tree())
+        assert has_distributed_layout(str(tmp_path))
+
+    def test_save_async_with_real_thread_barrier(self, zoo_ctx, tmp_path):
+        """Both fake writers run their write+commit on background
+        threads; a real threading.Barrier stands in for the coordination
+        service, so the two-phase ordering is actually exercised."""
+        tb = threading.Barrier(2)
+
+        def barrier(name, timeout_s=None, phase="other"):
+            tb.wait(timeout=10)
+            return 0.0
+
+        tree = _tree(2.0)
+        managers = _managers(tmp_path, barrier=barrier)
+        for m in managers:
+            m.save_async(11, tree)
+        for m in managers:
+            m.wait()
+        assert (tmp_path / "dstep_0000000011" / "COMMITTED").exists()
+        step, got = managers[0].restore()
+        assert step == 11
+        _assert_tree_equal(tree, got)
+
+    def test_gc_is_process0_only_and_keeps_newest(self, zoo_ctx, tmp_path):
+        managers = _managers(tmp_path, keep=2)
+        for s in (1, 2, 3):
+            _save_all(managers, s, _tree(float(s)))
+        assert managers[0].all_steps() == [2, 3]
+        assert managers[1].all_steps() == [2, 3]
+
+    def test_save_with_dead_peer_never_commits(self, zoo_ctx, tmp_path):
+        """No injected barrier → the real ``dist_barrier`` runs; a
+        planned barrier timeout (the dead-peer signal) must surface as a
+        typed HostLostError from ``save`` and leave the step
+        uncommitted."""
+        from analytics_zoo_tpu.robust import FaultInjector, HostLostError
+        from analytics_zoo_tpu.train.checkpoint import \
+            DistributedCheckpointManager
+
+        m0 = DistributedCheckpointManager(
+            str(tmp_path), process_index=0, process_count=2,
+            process_of_device=_split_at_4, barrier_timeout_s=1.0)
+        with FaultInjector().plan("dist.barrier_timeout", at=0):
+            with pytest.raises(HostLostError):
+                m0.save(3, _tree())
+        d = tmp_path / "dstep_0000000003"
+        assert (d / "shard_00000of00002.npz").exists()
+        assert not (d / "COMMITTED").exists()
+        assert not (d / "MANIFEST.json").exists()
+
+
+# ---------------------------------------------------------------------------
+# elastic restore (reshard-on-restore)
+# ---------------------------------------------------------------------------
+
+class TestElasticRestore:
+    @pytest.mark.parametrize("nproc_restore", [1, 2, 4])
+    def test_restore_at_any_process_count_is_bit_exact(
+            self, zoo_ctx, tmp_path, nproc_restore):
+        from analytics_zoo_tpu.train.checkpoint import \
+            DistributedCheckpointManager
+
+        tree = _tree(3.0)
+        _save_all(_managers(tmp_path), 7, tree)
+        m = DistributedCheckpointManager(
+            str(tmp_path), process_index=0, process_count=nproc_restore,
+            process_of_device=_split_at_4, barrier=_noop_barrier)
+        step, got = m.restore()
+        assert step == 7
+        _assert_tree_equal(tree, got)
+
+    def test_explicit_step_restore_is_strict(self, zoo_ctx, tmp_path):
+        from analytics_zoo_tpu.train.checkpoint import CheckpointCorruptError
+
+        managers = _managers(tmp_path)
+        _save_all(managers, 1, _tree(1.0))
+        _save_all(managers, 2, _tree(2.0))
+        os.remove(tmp_path / "dstep_0000000002" / "COMMITTED")
+        # an explicitly requested broken step raises — no silent fallback
+        with pytest.raises(CheckpointCorruptError):
+            _managers(tmp_path)[0].restore(step=2)
+
+    def test_empty_directory_raises_file_not_found(self, zoo_ctx, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            _managers(tmp_path)[0].restore()
+
+
+# ---------------------------------------------------------------------------
+# corruption matrix: quarantine + fallback (ISSUE satellite)
+# ---------------------------------------------------------------------------
+
+class TestCorruptionFallback:
+    def _two_steps(self, tmp_path):
+        t1 = _tree(1.0)
+        managers = _managers(tmp_path)
+        _save_all(managers, 1, t1)
+        _save_all(managers, 2, _tree(2.0))
+        return t1
+
+    def _assert_falls_back_to_step1(self, tmp_path, t1):
+        n0 = _counters().count("robust/ckpt_quarantined")
+        step, got = _managers(tmp_path)[0].restore()
+        assert step == 1
+        _assert_tree_equal(t1, got)
+        assert (tmp_path / "dstep_0000000002.corrupt").exists()
+        assert not (tmp_path / "dstep_0000000002").exists()
+        assert _counters().count("robust/ckpt_quarantined") == n0 + 1
+
+    def test_missing_shard(self, zoo_ctx, tmp_path):
+        t1 = self._two_steps(tmp_path)
+        os.remove(tmp_path / "dstep_0000000002" / "shard_00001of00002.npz")
+        self._assert_falls_back_to_step1(tmp_path, t1)
+
+    def test_crc_mismatched_chunk(self, zoo_ctx, tmp_path):
+        """Bit-rot: a chunk's bytes change but the shard's embedded
+        manifest (and the global CRC table) still carry the original
+        CRCs — verification must catch the disagreement."""
+        t1 = self._two_steps(tmp_path)
+        path = tmp_path / "dstep_0000000002" / "shard_00001of00002.npz"
+        with np.load(path, allow_pickle=False) as z:
+            data = {k: z[k] for k in z.files}
+        victim = next(k for k in data
+                      if k not in ("__manifest__", "__treedef__"))
+        data[victim] = data[victim] + 1.0
+        with open(path, "wb") as f:
+            np.savez(f, **data)
+        self._assert_falls_back_to_step1(tmp_path, t1)
+
+    def test_manifest_process_count_mismatch(self, zoo_ctx, tmp_path):
+        """A manifest recorded for a different topology than the shards
+        on disk (e.g. a bad copy) can never resolve its shard files."""
+        t1 = self._two_steps(tmp_path)
+        mp = tmp_path / "dstep_0000000002" / "MANIFEST.json"
+        man = json.loads(mp.read_text())
+        man["process_count"] = 3
+        mp.write_text(json.dumps(man))
+        self._assert_falls_back_to_step1(tmp_path, t1)
+
+    def test_absent_committed_marker(self, zoo_ctx, tmp_path):
+        t1 = self._two_steps(tmp_path)
+        os.remove(tmp_path / "dstep_0000000002" / "COMMITTED")
+        self._assert_falls_back_to_step1(tmp_path, t1)
+
+    def test_torn_shard_write_never_commits(self, zoo_ctx, tmp_path):
+        """A non-atomic writer dying mid-write leaves a truncated shard:
+        process 0's commit merge rejects it, so the step never gets a
+        COMMITTED marker and restore falls back."""
+        from analytics_zoo_tpu.robust import FaultInjector
+
+        t1 = _tree(1.0)
+        managers = _managers(tmp_path)
+        _save_all(managers, 1, t1)
+        managers[1].save(2, _tree(2.0))
+        with FaultInjector().plan("dist.shard_write", at=0, action="torn"):
+            with pytest.raises(Exception):
+                managers[0].save(2, _tree(2.0))
+        assert not (tmp_path / "dstep_0000000002" / "COMMITTED").exists()
+        self._assert_falls_back_to_step1(tmp_path, t1)
+
+
+# ---------------------------------------------------------------------------
+# preempt flushes (SIGTERM path: local shard + marker, no barrier)
+# ---------------------------------------------------------------------------
+
+class TestPreemptFlush:
+    def test_complete_preempt_flush_is_restorable(self, zoo_ctx, tmp_path):
+        tree = _tree(4.0)
+        managers = _managers(tmp_path)
+        _save_all(managers, 1, _tree(1.0))
+        for m in managers:
+            m.save_preempt(9, tree)
+        d = tmp_path / "dstep_0000000009"
+        assert (d / "PREEMPT_00000").exists()
+        assert (d / "PREEMPT_00001").exists()
+        assert not (d / "COMMITTED").exists()
+        assert not (d / "MANIFEST.json").exists()
+        step, got = _managers(tmp_path)[0].restore()
+        assert step == 9
+        _assert_tree_equal(tree, got)
+
+    def test_partial_preempt_flush_falls_back(self, zoo_ctx, tmp_path):
+        """Only process 0's flush landed before the lights went out: the
+        preempt step is missing process 1's chunks, so restore must
+        quarantine it and fall back to the committed step."""
+        t1 = _tree(1.0)
+        managers = _managers(tmp_path)
+        _save_all(managers, 1, t1)
+        managers[0].save_preempt(9, _tree(5.0))
+        step, got = _managers(tmp_path)[0].restore()
+        assert step == 1
+        _assert_tree_equal(t1, got)
+        assert (tmp_path / "dstep_0000000009.corrupt").exists()
+
+
+# ---------------------------------------------------------------------------
+# barriers + multihost init (core/context.py)
+# ---------------------------------------------------------------------------
+
+class TestBarrierAndInit:
+    def test_dist_barrier_single_process_is_noop(self, zoo_ctx):
+        from analytics_zoo_tpu.core.context import dist_barrier
+
+        assert dist_barrier("zoo_test_noop") == 0.0
+
+    def test_injected_timeout_surfaces_typed_error(self, zoo_ctx):
+        from analytics_zoo_tpu.core.context import dist_barrier
+        from analytics_zoo_tpu.robust import FaultInjector, HostLostError
+
+        n0 = _counters().count("robust/dist_barrier_timeouts")
+        with FaultInjector().plan("dist.barrier_timeout", at=0):
+            with pytest.raises(HostLostError) as ei:
+                dist_barrier("zoo_test_barrier", timeout_s=2.5,
+                             phase="write")
+        assert ei.value.barrier == "zoo_test_barrier"
+        assert ei.value.timeout_s == 2.5
+        assert _counters().count("robust/dist_barrier_timeouts") == n0 + 1
+
+    def test_multihost_init_retries_transient_failures(
+            self, zoo_ctx, monkeypatch):
+        """A slow-starting coordinator must not fail a worker on first
+        contact — init retries with backoff, counting each retry."""
+        import jax
+
+        from analytics_zoo_tpu.core import context as zoo_context
+        from analytics_zoo_tpu.core.config import ZooConfig
+
+        calls = {"n": 0}
+
+        def flaky_init(coordinator_address=None, num_processes=None,
+                       process_id=None):
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise ConnectionError("coordinator not up yet")
+
+        monkeypatch.setattr(jax.distributed, "initialize", flaky_init)
+        cfg = ZooConfig(retry_base_delay_s=1e-4, retry_max_delay_s=1e-3)
+        n0 = _counters().count("robust/dist_init_retries")
+        assert zoo_context._initialize_distributed(
+            cfg, "127.0.0.1:1", 1, 0) is True
+        assert calls["n"] == 3
+        assert _counters().count("robust/dist_init_retries") == n0 + 2
+
+
+# ---------------------------------------------------------------------------
+# Estimator integration: layout sniffing + elastic resume end to end
+# ---------------------------------------------------------------------------
+
+def _build_model():
+    from analytics_zoo_tpu.nn import Sequential, reset_name_scope
+    from analytics_zoo_tpu.nn.layers.core import Dense
+
+    # fresh name scope per build: checkpoints key params by layer name,
+    # so a restoring model must generate the same names as the saver
+    reset_name_scope()
+    return Sequential([Dense(8, input_shape=(4,), activation="relu"),
+                       Dense(1)])
+
+
+def _toy_data(n=64, d=4, seed=0):
+    rs = np.random.RandomState(seed)
+    return (rs.randn(n, d).astype(np.float32),
+            rs.randn(n, 1).astype(np.float32))
+
+
+class TestEstimatorIntegration:
+    def test_set_checkpoint_sniffs_distributed_layout(
+            self, zoo_ctx, tmp_path):
+        from analytics_zoo_tpu.train.checkpoint import (
+            CheckpointManager, DistributedCheckpointManager)
+        from analytics_zoo_tpu.train.estimator import Estimator
+
+        est = Estimator(_build_model(), optimizer="sgd", loss="mse")
+        est.set_checkpoint(str(tmp_path / "plain"))
+        assert type(est._ckpt_mgr) is CheckpointManager
+
+        dist_dir = tmp_path / "dist"
+        (dist_dir / "dstep_0000000001").mkdir(parents=True)
+        est.set_checkpoint(str(dist_dir))
+        assert isinstance(est._ckpt_mgr, DistributedCheckpointManager)
+
+    def test_ckpt_distributed_false_disables_sniffing(self, tmp_path):
+        from analytics_zoo_tpu import init_zoo_context
+        from analytics_zoo_tpu.train.checkpoint import (
+            CheckpointManager, DistributedCheckpointManager)
+        from analytics_zoo_tpu.train.estimator import Estimator
+
+        init_zoo_context(ckpt_distributed=False)
+        (tmp_path / "dstep_0000000001").mkdir()
+        est = Estimator(_build_model(), optimizer="sgd", loss="mse")
+        est.set_checkpoint(str(tmp_path))
+        assert type(est._ckpt_mgr) is CheckpointManager
+        assert not isinstance(est._ckpt_mgr, DistributedCheckpointManager)
+
+    def test_preempt_resume_through_distributed_manager_is_bit_exact(
+            self, zoo_ctx, tmp_path):
+        """The full single-process elastic path: a preempted fit flushes
+        through ``save_preempt``, ``fit(resume=True)`` restores through
+        the distributed manager and ``tree_put_global``, and lands on
+        the uninterrupted trajectory bit-exactly."""
+        from analytics_zoo_tpu.robust import FaultInjector, TrainingPreempted
+        from analytics_zoo_tpu.train.checkpoint import \
+            DistributedCheckpointManager
+        from analytics_zoo_tpu.train.estimator import Estimator
+
+        def _leaves(tree):
+            import jax
+
+            return jax.tree_util.tree_leaves(jax.device_get(tree))
+
+        x, y = _toy_data()
+        ref = Estimator(_build_model(), optimizer="sgd", loss="mse")
+        ref.fit(x, y, batch_size=8, epochs=3, verbose=False)
+
+        # seed the directory with the distributed layout so the sniff
+        # selects the distributed manager even at process_count == 1
+        (tmp_path / "dstep_0000000000").mkdir()
+        est = Estimator(_build_model(), optimizer="sgd", loss="mse")
+        est.set_checkpoint(str(tmp_path))
+        assert isinstance(est._ckpt_mgr, DistributedCheckpointManager)
+        with FaultInjector().plan("estimator.preempt", at=9):
+            with pytest.raises(TrainingPreempted):
+                est.fit(x, y, batch_size=8, epochs=3, verbose=False)
+        # the flush produced a preempt-marked step directory
+        flushed = [fn for fn in os.listdir(tmp_path)
+                   if fn.startswith("dstep_") and
+                   any(f.startswith("PREEMPT_")
+                       for f in os.listdir(tmp_path / fn))]
+        assert flushed
+
+        est2 = Estimator(_build_model(), optimizer="sgd", loss="mse")
+        est2.set_checkpoint(str(tmp_path))
+        est2.fit(x, y, batch_size=8, epochs=3, verbose=False, resume=True)
+        assert est2.finished_epochs == 3
+        for a, b in zip(_leaves(ref.params), _leaves(est2.params)):
+            assert np.array_equal(a, b), "resume diverged from reference"

@@ -12,48 +12,20 @@ carry no semantic drift.
 Exercises ``core/context.py`` multihost init for real, replacing the
 reference's manual two-executor script
 (pyzoo/test/zoo/ray/integration/ray_on_yarn.py:23-33) with CI.
+``mp_harness`` (shared with the chaos suite in
+test_multiprocess_chaos.py) spawns the workers and tees their stdout to
+``ZOO_MP_LOG_DIR`` for the CI artifact upload.
 """
-
-import json
-import os
-import socket
-import subprocess
-import sys
 
 import pytest
 
-WORKER = os.path.join(os.path.dirname(__file__), "multiprocess_worker.py")
-
-
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
-
-
-def _run_workers(nproc: int, tmp_path, tag: str, timeout=240):
-    port = _free_port()
-    env = {k: v for k, v in os.environ.items()
-           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
-    procs, outs = [], []
-    for pid in range(nproc):
-        out = tmp_path / f"{tag}_{pid}.json"
-        outs.append(out)
-        procs.append(subprocess.Popen(
-            [sys.executable, WORKER, str(pid), str(nproc), str(port),
-             str(out)],
-            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-            text=True))
-    logs = [p.communicate(timeout=timeout)[0] for p in procs]
-    for p, log in zip(procs, logs):
-        assert p.returncode == 0, f"worker failed:\n{log[-3000:]}"
-    return [json.loads(o.read_text()) for o in outs]
+from tests.mp_harness import run_workers
 
 
 @pytest.mark.slow
 def test_two_process_dp_matches_single_process(tmp_path):
-    single = _run_workers(1, tmp_path, "single")[0]
-    double = _run_workers(2, tmp_path, "double")
+    single = run_workers(1, tmp_path, "single")[0]
+    double = run_workers(2, tmp_path, "double")
 
     # both workers observed the same (global) loss every epoch
     assert double[0]["losses"] == pytest.approx(double[1]["losses"],
@@ -74,3 +46,14 @@ def test_two_process_dp_matches_single_process(tmp_path):
                                                    rel=1e-6)
     assert double[0]["eval_loss"] == pytest.approx(single["eval_loss"],
                                                    rel=1e-4)
+
+
+@pytest.mark.slow
+def test_four_process_topology_from_cli(tmp_path):
+    """The lifted topology knobs: 4 processes x 1 local device assemble
+    the same 4-device global mesh and land on the same trajectory."""
+    single = run_workers(1, tmp_path, "single4")[0]
+    quad = run_workers(4, tmp_path, "quad")
+    assert quad[0]["losses"] == pytest.approx(quad[3]["losses"], rel=1e-6)
+    assert quad[0]["losses"] == pytest.approx(single["losses"], rel=1e-4)
+    assert sum(q["pred_rows"] for q in quad) == 128

@@ -1,0 +1,132 @@
+"""Multi-process chaos: host failure during elastic multi-host training.
+
+Real OS processes, real gloo coordination, real kills — no mocks.  The
+scenarios assert the acceptance criteria of the distributed checkpoint
+protocol (docs/ROBUSTNESS.md "Distributed checkpoints & elastic
+resume"):
+
+- a 2-process run killed mid-epoch (SIGTERM-style preempt flush) or
+  mid-save (hard ``os._exit``) resumes at 1 AND 4 processes with loss
+  parity against an uninterrupted single-process run — the checkpoint
+  reshards onto whatever topology comes back;
+- a host dying mid-save can never produce a torn "latest": the
+  half-written step has no ``COMMITTED`` marker, restore quarantines it
+  and falls back to the newest committed step;
+- a dead peer surfaces to survivors as a typed ``HostLostError`` within
+  the barrier deadline instead of wedging the job.
+
+The worker topology (8 dispatches/epoch: 128 rows / global batch 16)
+makes dispatch index 10 = epoch 2, in-epoch step 2 — a mid-epoch kill
+point; epoch-boundary checkpoints land at global steps 8, 16, 24.
+"""
+
+import os
+import shutil
+
+import pytest
+
+from tests.mp_harness import run_workers
+
+STEPS_PER_EPOCH = 8
+
+
+@pytest.fixture(scope="module")
+def ref_run(tmp_path_factory):
+    """Uninterrupted single-process 3-epoch run: the parity baseline."""
+    tmp = tmp_path_factory.mktemp("mp_ref")
+    return run_workers(1, tmp, "ref")[0]
+
+
+def _assert_parity(res, ref):
+    assert res["finished_epochs"] == 3
+    assert res["losses"][-1] == pytest.approx(ref["losses"][-1], rel=1e-4)
+    assert res["eval_loss"] == pytest.approx(ref["eval_loss"], rel=1e-4)
+    assert res["param_sum"] == pytest.approx(ref["param_sum"], rel=1e-3)
+
+
+@pytest.mark.slow
+def test_preempt_midepoch_resumes_elastically(tmp_path, ref_run):
+    """2-process run preempted mid-epoch-2 → resume at 1 AND 4 processes
+    lands on the uninterrupted trajectory (reshard-on-restore)."""
+    ckpt = tmp_path / "ckpt"
+    pre = run_workers(2, tmp_path, "preempt", scenario="preempt",
+                      ckpt_dir=ckpt, die_step=10)
+    assert [r["preempted_step"] for r in pre] == [10, 10]
+
+    # epoch-1 boundary step committed; the preempt flush carries markers
+    # from BOTH processes and (correctly) no COMMITTED
+    d8 = ckpt / "dstep_0000000008"
+    d10 = ckpt / "dstep_0000000010"
+    assert (d8 / "COMMITTED").exists()
+    assert sorted(f for f in os.listdir(d10)
+                  if f.startswith("PREEMPT_")) == \
+        ["PREEMPT_00000", "PREEMPT_00001"]
+    assert not (d10 / "COMMITTED").exists()
+
+    # resume each topology from its own copy of the preempted state
+    ckpt1, ckpt4 = tmp_path / "ckpt_r1", tmp_path / "ckpt_r4"
+    shutil.copytree(ckpt, ckpt1)
+    shutil.copytree(ckpt, ckpt4)
+
+    res1 = run_workers(1, tmp_path, "resume1", scenario="resume",
+                       ckpt_dir=ckpt1)[0]
+    _assert_parity(res1, ref_run)
+
+    res4 = run_workers(4, tmp_path, "resume4", scenario="resume",
+                       ckpt_dir=ckpt4)
+    for a in res4[1:]:
+        assert a["losses"] == pytest.approx(res4[0]["losses"], rel=1e-6)
+    _assert_parity(res4[0], ref_run)
+
+
+@pytest.mark.slow
+def test_hard_death_midepoch_resumes_from_boundary(tmp_path, ref_run):
+    """Both hosts die hard (os._exit, no flush) mid-epoch-2; the run
+    resumes from the committed epoch-1 boundary and re-lands the
+    uninterrupted trajectory — including the re-trained epoch 2."""
+    ckpt = tmp_path / "ckpt"
+    run_workers(2, tmp_path, "die", scenario="die", ckpt_dir=ckpt,
+                die_step=10, expect_rc={0: 19, 1: 19})
+
+    assert (ckpt / "dstep_0000000008" / "COMMITTED").exists()
+
+    res = run_workers(1, tmp_path, "die_resume", scenario="resume",
+                      ckpt_dir=ckpt)[0]
+    _assert_parity(res, ref_run)
+    # resumed from the epoch-1 boundary: epochs 2 and 3 re-run whole,
+    # so BOTH resumed loss rows match the uninterrupted run
+    assert res["losses"] == pytest.approx(ref_run["losses"][1:], rel=1e-4)
+
+
+@pytest.mark.slow
+def test_death_midsave_never_torn_and_peer_surfaces(tmp_path, ref_run):
+    """Process 1 dies DURING its shard write of the second checkpoint
+    (epoch-2 boundary, global step 16): the step must never commit, the
+    survivor must get a typed HostLostError from the write barrier
+    within its 5s deadline, and resume must fall back to the committed
+    epoch-1 step — quarantining the half-written one."""
+    ckpt = tmp_path / "ckpt"
+    res = run_workers(2, tmp_path, "dsave", scenario="die_save",
+                      ckpt_dir=ckpt, die_step=1, die_pid=1,
+                      barrier_timeout=5, expect_rc={1: 19})
+
+    surv = res[0]
+    assert surv["error"] == "HostLostError"
+    assert "zoo_ckpt_write_16" in surv["barrier"]
+    assert surv["timeout_s"] == 5
+    # surfaced promptly: the whole fit (2 epochs of training + the 5s
+    # barrier deadline) stayed well under the harness kill timeout
+    assert surv["elapsed_s"] < 120
+
+    # the half-written step: survivor's shard only, no COMMITTED marker
+    d16 = ckpt / "dstep_0000000016"
+    assert (d16 / "shard_00000of00002.npz").exists()
+    assert not (d16 / "COMMITTED").exists()
+    assert not (d16 / "MANIFEST.json").exists()
+    assert (ckpt / "dstep_0000000008" / "COMMITTED").exists()
+
+    res1 = run_workers(1, tmp_path, "dsave_resume", scenario="resume",
+                       ckpt_dir=ckpt)[0]
+    _assert_parity(res1, ref_run)
+    # the torn step was quarantined, never restored
+    assert (ckpt / "dstep_0000000016.corrupt").exists()
