@@ -114,6 +114,19 @@ class ZooConfig:
     # the watcher entirely.
     serving_slo_p99_ms: float = 0.0
     serving_slo_window_s: float = 5.0
+    # Queue transport (docs/SERVING.md "Wire format & queue backends"):
+    # "memory" (in-process, legacy json wire), "file" (spool dir, binary
+    # framed records), "redis" (reference-compatible distributed), or
+    # "shm" — the zero-copy shared-memory ring buffer for single-host
+    # serving (deploy.make_queue_from_zoo lowers this).
+    serving_queue_backend: str = "memory"
+    # ShmQueue arena geometry: ring capacity in records and the byte cap
+    # per record slot / per result slot.  slots x slot_bytes is the
+    # segment's request-arena footprint in /dev/shm; a record that packs
+    # larger than slot_bytes is rejected client-side as malformed.
+    serving_shm_slots: int = 256
+    serving_shm_slot_bytes: int = 1 << 20
+    serving_shm_result_slot_bytes: int = 1 << 20
 
     # --- observability ---------------------------------------------------
     # Bounded ring of completed spans kept by observe.TRACER; any

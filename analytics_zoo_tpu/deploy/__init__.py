@@ -10,7 +10,12 @@ from analytics_zoo_tpu.deploy.inference import (  # noqa: F401
     BatchRequest, DynamicBatcher, InferenceModel, ModelReplica,
     dequantize_pytree, imagenet_preprocess, quantize_pytree,
     scatter_batch_results)
+from analytics_zoo_tpu.deploy.codec import (  # noqa: F401
+    pack_record, pack_result, packed_nbytes, unpack_record, unpack_result)
 from analytics_zoo_tpu.deploy.serving import (  # noqa: F401
     ClusterServing, DeviceExecutor, FileQueue, InputQueue, MemoryQueue,
     OutputQueue, RedisQueue, ServingConfig, decode_image, decode_tensor,
-    encode_image, encode_tensor, error_payload, make_queue)
+    encode_image, encode_tensor, error_payload, make_queue,
+    make_queue_from_zoo)
+from analytics_zoo_tpu.deploy.shmqueue import (  # noqa: F401
+    ShmQueue, shm_available)

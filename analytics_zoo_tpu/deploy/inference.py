@@ -774,8 +774,16 @@ class DynamicBatcher:
             if r.span is not None:
                 r.span.end(rows=r.n, full=full)
         try:
-            fused = [np.concatenate([r.xs[i] for r in reqs], axis=0)
-                     for i in range(len(reqs[0].xs))]
+            if len(reqs) == 1:
+                # single-request batch: hand the arrays through as-is —
+                # on the shm backend these are views into the slot, and
+                # this is the last place a host copy could sneak in
+                # before device_put
+                fused = list(reqs[0].xs)
+            else:
+                TIMERS.incr(f"{self.name}/batch_fuse_copies")
+                fused = [np.concatenate([r.xs[i] for r in reqs], axis=0)
+                         for i in range(len(reqs[0].xs))]
             if self._dispatch_fn is not None:
                 self._dispatch_fn(key, fused, reqs)
                 return

@@ -26,7 +26,18 @@ pluggable:
 - ``FileQueue``     — spool directory with atomic renames (cross-process
                       on one host / shared FS, zero extra deps);
 - ``RedisQueue``    — wire-compatible with the reference client
-                      (xadd/hset), used when ``redis`` is importable.
+                      (xadd/hset), used when ``redis`` is importable;
+- ``ShmQueue``      — shared-memory ring buffer + binary tensor codec,
+                      the zero-copy single-host hot path
+                      (``deploy/shmqueue.py``; docs/SERVING.md "Wire
+                      format & queue backends").
+
+Wire format is a per-backend property (``queue.wire``): ``"binary"``
+backends move framed raw tensor bytes (:mod:`deploy.codec` — no base64,
+no JSON for tensor payloads), ``"json"`` backends keep the legacy
+base64-in-JSON codec for compatibility with the reference client.  The
+worker decodes BOTH on every backend, so old producers keep working
+against new workers.
 
 Client API parity: ``InputQueue.enqueue`` / ``enqueue_image`` (base64) and
 ``OutputQueue.dequeue`` / ``query`` keep the reference semantics.
@@ -57,6 +68,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from analytics_zoo_tpu.core.profiling import TIMERS
+from analytics_zoo_tpu.deploy import codec as wire_codec
 from analytics_zoo_tpu.deploy.inference import (
     DynamicBatcher, _next_bucket, scatter_batch_results)
 from analytics_zoo_tpu.observe import metrics as obs
@@ -70,9 +82,10 @@ from analytics_zoo_tpu.robust.errors import (DeadlineExpired,
                                              ServingError, ServingOverloaded)
 
 __all__ = ["MemoryQueue", "FileQueue", "RedisQueue", "make_queue",
-           "InputQueue", "OutputQueue", "ServingConfig", "ClusterServing",
-           "DeviceExecutor", "encode_image", "decode_image", "error_payload",
-           "MalformedRecordError"]
+           "make_queue_from_zoo", "InputQueue", "OutputQueue",
+           "ServingConfig", "ClusterServing", "DeviceExecutor",
+           "encode_tensor", "decode_tensor", "encode_image",
+           "decode_image", "error_payload", "MalformedRecordError"]
 
 
 def error_payload(code: str, message: Any, uri: Optional[str] = None
@@ -95,31 +108,76 @@ def error_payload(code: str, message: Any, uri: Optional[str] = None
 # ---------------------------------------------------------------------------
 
 def encode_tensor(a) -> Dict[str, Any]:
-    """ndarray → JSON-safe payload (the single raw-array wire codec)."""
+    """ndarray → JSON-safe payload (the LEGACY base64 wire codec).
+
+    Binary-wire backends (``queue.wire == "binary"``) skip this entirely
+    and ship raw ndarrays through :mod:`deploy.codec`; this stays the
+    reference-compatible fallback for Memory/Redis and old producers.
+    Instrumented so the bench can attribute the base64 tax:
+    ``serving/codec_b64_encode`` counts calls,
+    ``serving_wire_bytes_total{codec="json_b64"}`` the on-wire bytes."""
+    t0 = time.perf_counter()
     a = np.asarray(a)
-    return {"b64": base64.b64encode(a.tobytes()).decode("ascii"),
-            "shape": list(a.shape), "dtype": str(a.dtype)}
+    payload = {"b64": base64.b64encode(a.tobytes()).decode("ascii"),
+               "shape": list(a.shape), "dtype": str(a.dtype)}
+    TIMERS.incr("serving/codec_b64_encode")
+    obs.count("serving_wire_bytes_total", len(payload["b64"]),
+              codec="json_b64", flat="serving/wire_bytes_json_b64")
+    obs.observe("serving_codec_seconds", time.perf_counter() - t0,
+                codec="json_b64", op="encode")
+    return payload
 
 
-def decode_tensor(payload: Dict[str, Any]) -> np.ndarray:
-    return np.frombuffer(
+def decode_tensor(payload, writable: bool = False) -> np.ndarray:
+    """Wire payload → ndarray.
+
+    Accepts the legacy ``{"b64", "shape", "dtype"}`` dict AND a raw
+    ndarray (the binary wire hands tensors through already decoded —
+    possibly as a read-only view into a shared-memory slot).
+
+    Writability is explicit: the default is a zero-copy READ-ONLY array
+    (``np.frombuffer`` views are non-writable by nature; hiding that
+    behind an implicit copy is exactly the hot-path tax this module
+    removes).  Pass ``writable=True`` to get a private mutable copy —
+    counted in ``serving/codec_tensor_copies`` so the zero-copy claim
+    stays test-verifiable."""
+    if isinstance(payload, np.ndarray):
+        if writable and not payload.flags.writeable:
+            TIMERS.incr("serving/codec_tensor_copies")
+            return payload.copy()
+        return payload
+    t0 = time.perf_counter()
+    TIMERS.incr("serving/codec_b64_decode")
+    a = np.frombuffer(
         base64.b64decode(payload["b64"]),
-        dtype=np.dtype(payload["dtype"])).reshape(payload["shape"]).copy()
+        dtype=wire_codec.wire_dtype(payload["dtype"])
+    ).reshape(payload["shape"])
+    if writable:
+        TIMERS.incr("serving/codec_tensor_copies")
+        a = a.copy()
+    obs.observe("serving_codec_seconds", time.perf_counter() - t0,
+                codec="json_b64", op="decode")
+    return a
 
 
-def encode_image(image) -> Dict[str, Any]:
-    """ndarray (H, W, C) float/uint8 or a path → JSON-safe payload."""
+def encode_image(image, wire: str = "json") -> Dict[str, Any]:
+    """ndarray (H, W, C) float/uint8 or a path → wire payload."""
     if isinstance(image, str):
         with open(image, "rb") as f:
             return {"image": base64.b64encode(f.read()).decode("ascii"),
                     "codec": "file"}
+    if wire == "binary":
+        return {"codec": "raw", "image": np.asarray(image)}
     return {"codec": "raw", "image": encode_tensor(image)}
 
 
 def decode_image(payload: Dict[str, Any]) -> np.ndarray:
+    img = payload.get("image")
+    if isinstance(img, np.ndarray):  # binary wire: already decoded
+        return img
     if payload.get("codec") == "raw":
         return decode_tensor(payload["image"])
-    raw = base64.b64decode(payload["image"])
+    raw = base64.b64decode(img)
     import cv2  # compressed file bytes (jpg/png)
     img = cv2.imdecode(np.frombuffer(raw, np.uint8), cv2.IMREAD_COLOR)
     if img is None:
@@ -213,14 +271,28 @@ class MemoryQueue:
 class FileQueue:
     """Spool-directory stream: cross-process on one host or a shared FS.
 
-    Records are JSON files; atomic rename makes push/claim race-free
+    Records are one file each; atomic rename makes push/claim race-free
     without locks (rename(2) is atomic on POSIX).  Plays the role the
     Redis server plays for the reference when no Redis is available.
+
+    ``codec="binary"`` (the default) spools records as ``.bin`` framed
+    tensor files (:mod:`deploy.codec` — raw bytes, no base64);
+    ``codec="json"`` keeps the legacy one-JSON-per-record format.
+    ``pop_batch`` reads BOTH extensions, so mixed producers coexist.
+
+    Depth bookkeeping is cached: ``__len__``/``trim`` answer from a
+    counter maintained under ``_lock`` (push +1, pop refreshes it from
+    the directory scan it does anyway) and only fall back to a full
+    ``os.listdir`` on a cache miss — the poller calls ``trim`` every
+    loop, so an O(queue) scan per loop was a measurable tax.
     """
 
     def __init__(self, root: str, name: str = "serving_stream",
-                 retry: Optional[RetryPolicy] = None):
+                 retry: Optional[RetryPolicy] = None,
+                 codec: str = "binary"):
         self.name = name
+        self.codec = codec
+        self.wire = "binary" if codec == "binary" else "json"
         self.root = os.path.join(root, name)
         self.in_dir = os.path.join(self.root, "in")
         self.out_dir = os.path.join(self.root, "out")
@@ -228,33 +300,69 @@ class FileQueue:
             os.makedirs(d, exist_ok=True)
         self._seq = 0
         self._retry = retry or _io_retry("filequeue_io", (OSError,))
+        self._lock = threading.Lock()
+        self._n: Optional[int] = None  # None = miss → rescan
+
+    _EXTS = (".json", ".bin")
 
     def push(self, record: Dict) -> str:
         rid = record.get("uri") or uuid.uuid4().hex
         self._seq += 1
-        fn = f"{time.time_ns():020d}_{self._seq:06d}_{rid}.json"
+        ext = ".bin" if self.codec == "binary" else ".json"
+        fn = f"{time.time_ns():020d}_{self._seq:06d}_{rid}{ext}"
 
         def _write():
             faults.inject("queue.io")
             fd, tmp = tempfile.mkstemp(dir=self.root, suffix=".tmp")
-            with os.fdopen(fd, "w") as f:
-                json.dump({"rid": rid, "record": record}, f)
+            if ext == ".bin":
+                with os.fdopen(fd, "wb") as f:
+                    f.write(wire_codec.pack_record(record, codec="file"))
+            else:
+                with os.fdopen(fd, "w") as f:
+                    json.dump({"rid": rid, "record": record}, f)
             os.replace(tmp, os.path.join(self.in_dir, fn))
 
         self._retry.call(_write)
+        with self._lock:
+            if self._n is not None:
+                self._n += 1
         return rid
 
     # claims older than this are from a crashed worker and get requeued
     STALE_CLAIM_S = 60.0
+
+    @classmethod
+    def _is_record(cls, fn: str) -> bool:
+        return fn.endswith(cls._EXTS)
+
+    @staticmethod
+    def _rid_of(fn: str) -> str:
+        # {time_ns}_{seq}_{rid}.{ext}: rid may itself contain "_"
+        return fn.rsplit(".", 1)[0].split("_", 2)[2]
+
+    def _read_record(self, path: str) -> Tuple[str, Dict]:
+        if path.endswith(".bin.claimed") or path.endswith(".bin"):
+            with open(path, "rb") as f:
+                data = f.read()
+            fn = os.path.basename(path)
+            if fn.endswith(".claimed"):
+                fn = fn[: -len(".claimed")]
+            # copy=True: the backing file is deleted after the claim, so
+            # views must not outlive this function
+            return (self._rid_of(fn),
+                    wire_codec.unpack_record(data, copy=True,
+                                             codec="file"))
+        with open(path) as f:
+            blob = json.load(f)
+        return blob["rid"], blob["record"]
 
     def pop_batch(self, n: int, timeout: float = 0.1
                   ) -> List[Tuple[str, Dict]]:
         deadline = time.monotonic() + timeout
         while True:
             out = []
+            seen = 0
             for fn in sorted(os.listdir(self.in_dir)):
-                if len(out) >= n:
-                    break
                 path = os.path.join(self.in_dir, fn)
                 if fn.endswith(".claimed"):
                     # recover claims orphaned by a crashed worker
@@ -262,70 +370,99 @@ class FileQueue:
                         if (time.time() - os.path.getmtime(path)
                                 > self.STALE_CLAIM_S):
                             os.rename(path, path[: -len(".claimed")])
+                            seen += 1
                     except OSError:
                         pass
                     continue
-                if not fn.endswith(".json"):
+                if not self._is_record(fn):
+                    continue
+                if len(out) >= n:
+                    seen += 1  # stays queued; count for the cache
                     continue
                 claimed = path + ".claimed"
                 try:
                     os.rename(path, claimed)  # atomic claim
                 except OSError:
                     continue  # another worker won
-                with open(claimed) as f:
-                    blob = json.load(f)
+                blob = self._read_record(claimed)
                 os.unlink(claimed)
-                out.append((blob["rid"], blob["record"]))
+                out.append(blob)
+            with self._lock:
+                # the scan just walked the whole directory — refresh the
+                # cached depth for free (also heals cross-process drift)
+                self._n = seen
             if out or time.monotonic() >= deadline:
                 return out
             time.sleep(0.005)
 
     def __len__(self) -> int:
-        return sum(1 for fn in os.listdir(self.in_dir)
-                   if fn.endswith(".json"))
+        with self._lock:
+            if self._n is None:  # cache miss: rescan once
+                self._n = sum(1 for fn in os.listdir(self.in_dir)
+                              if self._is_record(fn))
+            return self._n
 
     def trim(self, maxlen: int) -> int:
+        with self._lock:
+            if self._n is not None and self._n <= maxlen:
+                return 0  # fast path: no listdir under the limit
         files = sorted(fn for fn in os.listdir(self.in_dir)
-                       if fn.endswith(".json"))
+                       if self._is_record(fn))
         drop = max(0, len(files) - maxlen)
         for fn in files[:drop]:
             try:
                 os.unlink(os.path.join(self.in_dir, fn))
             except OSError:
                 pass
+        with self._lock:
+            self._n = len(files) - drop
         return drop
 
     def set_result(self, rid: str, value: Any) -> None:
+        binary = self.codec == "binary"
+
         def _write():
             faults.inject("queue.io")
             fd, tmp = tempfile.mkstemp(dir=self.root, suffix=".tmp")
-            with os.fdopen(fd, "w") as f:
-                json.dump(value, f)
-            os.replace(tmp, os.path.join(self.out_dir, rid + ".json"))
+            if binary:
+                with os.fdopen(fd, "wb") as f:
+                    f.write(wire_codec.pack_result(value, codec="file"))
+                os.replace(tmp, os.path.join(self.out_dir, rid + ".bin"))
+            else:
+                with os.fdopen(fd, "w") as f:
+                    json.dump(value, f)
+                os.replace(tmp, os.path.join(self.out_dir, rid + ".json"))
 
         self._retry.call(_write)
 
     def get_result(self, rid: str, timeout: float = 10.0) -> Any:
-        path = os.path.join(self.out_dir, rid + ".json")
+        paths = [os.path.join(self.out_dir, rid + ext)
+                 for ext in (".bin", ".json")]
         deadline = time.monotonic() + timeout
 
-        def _read():
+        def _read(path):
             faults.inject("queue.io")
-            with open(path) as f:
-                val = json.load(f)
+            if path.endswith(".bin"):
+                with open(path, "rb") as f:
+                    val = wire_codec.unpack_result(f.read(), copy=True,
+                                                   codec="file")
+            else:
+                with open(path) as f:
+                    val = json.load(f)
             os.unlink(path)
             return val
 
         while True:
-            if os.path.exists(path):
-                return self._retry.call(_read)
+            for path in paths:
+                if os.path.exists(path):
+                    return self._retry.call(lambda p=path: _read(p))
             if time.monotonic() >= deadline:
                 raise TimeoutError(_timeout_msg(self, rid, timeout))
             time.sleep(0.005)
 
     def pending_results(self) -> List[str]:
-        return [fn[:-5] for fn in os.listdir(self.out_dir)
-                if fn.endswith(".json")]
+        return [fn.rsplit(".", 1)[0] for fn in os.listdir(self.out_dir)
+                if fn.endswith(self._EXTS)]
 
     def health(self) -> Dict[str, Any]:
         """Probe: the spool directories must exist and be writable."""
@@ -462,8 +599,25 @@ def make_queue(backend: str = "memory", **kw):
         return FileQueue(**kw)
     if b in ("redis",):
         return RedisQueue(**kw)
+    if b in ("shm", "shared_memory"):
+        from analytics_zoo_tpu.deploy.shmqueue import ShmQueue
+
+        return ShmQueue(**kw)
     raise ValueError(f"unknown queue backend {backend!r}; "
-                     "known: memory, file, redis")
+                     "known: memory, file, redis, shm")
+
+
+def make_queue_from_zoo(zoo_cfg, **kw):
+    """Queue from the global config: ``serving_queue_backend`` picks the
+    transport (``ZOO_SERVING_QUEUE_BACKEND=shm`` env-selects the
+    zero-copy path) and the ``serving_shm_*`` knobs size the arena."""
+    backend = kw.pop("backend", None) or zoo_cfg.serving_queue_backend
+    if backend.lower() in ("shm", "shared_memory"):
+        kw.setdefault("slots", zoo_cfg.serving_shm_slots)
+        kw.setdefault("slot_bytes", zoo_cfg.serving_shm_slot_bytes)
+        kw.setdefault("result_slot_bytes",
+                      zoo_cfg.serving_shm_result_slot_bytes)
+    return make_queue(backend, **kw)
 
 
 # ---------------------------------------------------------------------------
@@ -471,10 +625,16 @@ def make_queue(backend: str = "memory", **kw):
 # ---------------------------------------------------------------------------
 
 class InputQueue:
-    """Producer side: enqueue records for the serving worker."""
+    """Producer side: enqueue records for the serving worker.
+
+    The tensor wire format follows the queue: binary backends
+    (``queue.wire == "binary"``) get raw ndarrays (framed by the backend,
+    zero base64), JSON backends get the legacy ``encode_tensor``
+    payloads."""
 
     def __init__(self, queue):
         self.queue = queue
+        self.wire = getattr(queue, "wire", "json")
 
     @staticmethod
     def _validated_ttl(ttl_ms) -> Optional[float]:
@@ -517,7 +677,7 @@ class InputQueue:
                 if a.dtype.hasobject:
                     raise ValueError(
                         f"dtype {a.dtype} is not wire-encodable")
-                rec[k] = encode_tensor(a)
+                rec[k] = a if self.wire == "binary" else encode_tensor(a)
             except MalformedRecordError:
                 raise
             except Exception as e:
@@ -530,7 +690,7 @@ class InputQueue:
         """Enqueue one image (path or ndarray) — reference
         enqueue_image:83 (base64 xadd)."""
         rec = {"uri": uri or uuid.uuid4().hex, "ts": time.time(),
-               "fmt": "tensor", **encode_image(image)}
+               "fmt": "tensor", **encode_image(image, wire=self.wire)}
         ttl = self._validated_ttl(ttl_ms)
         if ttl is not None:
             rec["ttl_ms"] = ttl
@@ -547,9 +707,11 @@ class OutputQueue:
     def _decode_result(val: Any) -> Any:
         # native-client results ride the tensor codec (lossless, typed);
         # everything else (top-N pairs, errors, reference-wire lists)
-        # passes through as-is
+        # passes through as-is.  Clients get a WRITABLE array either
+        # way — results left the slot/spool already, so this copy (if
+        # any) is off the serving hot path.
         if isinstance(val, dict) and "tensor" in val:
-            return decode_tensor(val["tensor"])
+            return decode_tensor(val["tensor"], writable=True)
         return val
 
     def query(self, uri: str, timeout: float = 10.0) -> Any:
@@ -681,11 +843,18 @@ class ServingConfig:
 
 
 def _decode_record(rec: Dict) -> Dict[str, np.ndarray]:
+    """Tensor fields of a claimed record, whatever wire they rode:
+    binary-backend ndarrays pass through untouched (zero-copy views on
+    shm), legacy ``{"b64": ...}`` payloads decode read-only."""
     out = {}
     if "image" in rec:
         out["image"] = decode_image(rec)
     for k, v in rec.items():
-        if k != "image" and isinstance(v, dict) and "b64" in v:
+        if k == "image" or k.startswith("_"):
+            continue
+        if isinstance(v, np.ndarray):
+            out[k] = v
+        elif isinstance(v, dict) and "b64" in v:
             out[k] = decode_tensor(v)
     return out
 
@@ -1247,6 +1416,7 @@ class ClusterServing:
                  preprocess: Optional[Callable] = None):
         self.model = model  # InferenceModel
         self.queue = queue
+        self._wire = getattr(queue, "wire", "json")
         self.cfg = config or ServingConfig()
         self.preprocess = preprocess
         self._stop = threading.Event()
@@ -1666,53 +1836,93 @@ class ClusterServing:
 
     def _respond_loop(self) -> None:
         """Stage 4: format + write results, close the e2e span, emit
-        TensorBoard scalars.  Transient result-store failures retry
-        (above the backend's own I/O retries); a formatting failure
-        degrades to a typed internal-error payload — the record still
-        terminates."""
+        TensorBoard scalars.  Writes are BATCHED: the worker greedily
+        drains whatever is already queued (up to one device batch) and
+        publishes the whole group through one ``set_result_many`` round
+        — on ShmQueue that is one lock claim for N results instead of N.
+        Transient result-store failures retry (above the backend's own
+        I/O retries); a formatting failure degrades to a typed
+        internal-error payload — the record still terminates."""
         log = logging.getLogger("analytics_zoo_tpu.deploy")
         retry = _io_retry("serving_respond", retry_on=(Exception,))
+        cap = max(8, self.cfg.batch_size)
         while True:
             item = self._respond_q.get()
             if item is None:
                 return
+            items = [item]
+            while len(items) < cap:
+                try:
+                    nxt = self._respond_q.get_nowait()
+                except pyqueue.Empty:
+                    break
+                if nxt is None:
+                    # hand the stop sentinel on (ours arrives at the
+                    # next blocking get) and publish what we have
+                    self._respond_q.put(None)
+                    break
+                items.append(nxt)
             self._hb.beat("respond")
-            rid, rec, out, err = item
+            self._respond_many(items, retry, log)
+
+    def _respond_many(self, items: List, retry, log) -> None:
+        t0 = time.perf_counter()
+        prepared: List[Tuple] = []  # (rid, rec, val, root, rsp)
+        for rid, rec, out, err in items:
             root = rec.pop("_span", None)
             rsp = None
             if root is not None:
                 rsp = TRACER.start("serving/respond", trace=root.trace,
                                    parent=root.sid)
             try:
-                with obs.time_stage("serving_stage_seconds",
-                                    stage="respond",
-                                    flat="serving/respond"):
-                    try:
-                        faults.inject("serving.respond_error")
-                        val = self._format_result(out, err, rec)
-                    except Exception as fe:
-                        log.exception("result formatting failed for %r", rid)
-                        val = error_payload(
-                            "internal", f"result formatting failed: {fe}",
-                            uri=rec.get("uri"))
-                    if isinstance(val, dict) and "error" in val:
-                        obs.count("serving_errors_total",
-                                  code=val.get("code") or "internal",
-                                  flat="serving/errors_returned")
+                faults.inject("serving.respond_error")
+                val = self._format_result(out, err, rec)
+            except Exception as fe:
+                log.exception("result formatting failed for %r", rid)
+                val = error_payload(
+                    "internal", f"result formatting failed: {fe}",
+                    uri=rec.get("uri"))
+            if isinstance(val, dict) and "error" in val:
+                obs.count("serving_errors_total",
+                          code=val.get("code") or "internal",
+                          flat="serving/errors_returned")
+            prepared.append((rid, rec, val, root, rsp))
 
-                    def _write(_rid=rid, _val=val):
-                        faults.inject("serving.queue_io")
-                        self.queue.set_result(_rid, _val)
+        def _write():
+            pairs = []
+            for _rid, _rec, _val, _root, _rsp in prepared:
+                # keep the per-record fault cadence the chaos plans
+                # target, batched write or not
+                faults.inject("serving.queue_io")
+                pairs.append((_rid, _val))
+            many = getattr(self.queue, "set_result_many", None)
+            if many is not None:
+                many(pairs)
+            else:
+                for _rid, _val in pairs:
+                    self.queue.set_result(_rid, _val)
 
-                    retry.call(_write)
-            except Exception:
-                TIMERS.incr("serving/respond_failed")
-                log.exception("serving respond failed for %r", rid)
+        try:
+            retry.call(_write)
+        except Exception:
+            TIMERS.incr("serving/respond_failed", len(prepared))
+            log.exception("serving respond failed for %d record(s)",
+                          len(prepared))
+            for _rid, _rec, _val, root, rsp in prepared:
                 if rsp is not None:
                     rsp.end(status="error", error="respond failed")
                 if root is not None:
                     root.end(status="internal", error="respond failed")
-                continue
+            return
+        if len(prepared) > 1:
+            TIMERS.incr("serving/respond_batched_writes")
+        # per-record stage time: the batch wall time amortized over its
+        # members, so breakdown math (total / records) stays honest
+        per = (time.perf_counter() - t0) / len(prepared)
+        now = time.time()
+        for rid, rec, val, root, rsp in prepared:
+            obs.observe("serving_stage_seconds", per, stage="respond",
+                        flat="serving/respond")
             # terminal spans: the respond leg, then the root with the
             # typed outcome — the span chain is now reconstructable
             outcome_code = (val.get("code") or "internal") \
@@ -1726,11 +1936,11 @@ class ClusterServing:
             ts = rec.get("ts")
             if isinstance(ts, (int, float)):
                 obs.observe("serving_stage_seconds",
-                            max(0.0, time.time() - ts), stage="e2e",
+                            max(0.0, now - ts), stage="e2e",
                             flat="serving/e2e")
-            with self._count_lock:
-                self.records_served += 1
-            self._maybe_tb_flush()
+        with self._count_lock:
+            self.records_served += len(prepared)
+        self._maybe_tb_flush()
 
     def _format_result(self, out, err, rec: Dict) -> Any:
         """One result value for the wire: typed error payload, top-N
@@ -1761,6 +1971,9 @@ class ClusterServing:
             idx = np.argsort(row)[::-1][:top_n]
             return [[int(j), float(row[j])] for j in idx]
         if native and row.dtype.kind in "biufc":
+            if self._wire == "binary":
+                # the backend frames the raw array itself — no base64
+                return {"tensor": row}
             return {"tensor": encode_tensor(row)}
         # object/str rows (e.g. a detector forward returning JSON blobs)
         # can't ride the tensor codec — hand the value through as-is

@@ -80,6 +80,12 @@ CATALOG: Dict[str, Tuple[str, str, Tuple[str, ...]]] = {
         "gauge", "replicas currently accepting batches", ()),
     "serving_heartbeat_age_seconds": (
         "gauge", "age of each stage's last heartbeat", ("stage",)),
+    "serving_wire_bytes_total": (
+        "counter", "tensor payload bytes crossing the serving wire, by "
+        "codec (json_b64|binary|file|shm)", ("codec",)),
+    "serving_codec_seconds": (
+        "histogram", "wire codec encode/decode wall time, by codec and "
+        "direction", ("codec", "op")),
     # robustness
     "breaker_transitions_total": (
         "counter", "circuit breaker state transitions",

@@ -1368,6 +1368,225 @@ def bench_serving(n_requests=32, concurrency=8, n_saturated=256):
             sync.get("batched_throughput_imgs_per_sec"))
     finally:
         srv.stop()
+
+    # --- zero-copy shm leg: same model, same saturated pattern, the
+    # shared-memory ring + binary wire instead of MemoryQueue + base64.
+    # Own TIMERS window so the breakdown attributes this leg alone. ---
+    from analytics_zoo_tpu.deploy.shmqueue import ShmQueue, shm_available
+
+    if shm_available():
+        q2 = ShmQueue(name="bench_serving", slots=max(64, n_saturated),
+                      slot_bytes=1 << 20, push_timeout_s=30.0)
+        srv2 = ClusterServing(m, q2, ServingConfig(
+            batch_size=32, poll_timeout_s=0.01, max_batch_delay_ms=5.0,
+            decode_workers=4, max_inflight=2)).start()
+        inp2, outp2 = InputQueue(q2), OutputQueue(q2)
+        try:
+            inp2.enqueue(uri="warm1", x=imgs[1][0])
+            outp2.query("warm1", timeout=600.0)
+            TIMERS.reset()
+            crs = np.random.RandomState(11)
+            sat = [crs.randint(0, 256, (224, 224, 3)).astype(np.uint8)
+                   for _ in range(n_saturated)]
+            t0 = time.perf_counter()
+            for i, im in enumerate(sat):
+                inp2.enqueue(uri=f"shm{i}", x=im)
+            served = 0
+            deadline = time.monotonic() + 600
+            while served < n_saturated and time.monotonic() < deadline:
+                served += len(outp2.dequeue(timeout=1.0))
+            dt = time.perf_counter() - t0
+            stats = TIMERS.stats()
+            tot = lambda nm: stats.get(nm, {}).get("total_s", 0.0)
+            counts = TIMERS.counts()
+            shm_out = {
+                "batched_throughput_imgs_per_sec": round(served / dt, 1),
+                "saturated_requests": served,
+                "wire_format": "shm ring + binary frames (zero-copy)",
+            }
+            if served:
+                per_img = lambda s: round(s * 1e3 / served, 3)
+                shm_out["breakdown"] = {
+                    "device_compute_ms_per_img": per_img(
+                        tot("serving/device")),
+                    "wire_codec_ms_per_img": per_img(
+                        tot("serving/decode") + tot("serving/respond")),
+                    "queue_wait_ms_per_img": per_img(
+                        tot("serving/queue_wait")
+                        + tot("serving/batch_wait")),
+                    "chaos_enabled": False,
+                }
+                # the zero-copy claim, re-verified at bench time
+                shm_out["codec_b64_calls"] = (
+                    counts.get("serving/codec_b64_encode", 0)
+                    + counts.get("serving/codec_b64_decode", 0))
+            out["serving_shm"] = shm_out
+            out["shm_speedup_vs_memory_queue"] = _safe_ratio(
+                shm_out["batched_throughput_imgs_per_sec"],
+                out.get("batched_throughput_imgs_per_sec"))
+        finally:
+            srv2.stop()
+            q2.stop()
+    else:
+        out["serving_shm"] = {"skipped": "POSIX shared memory unavailable"}
+    return out
+
+
+def bench_serving_wire_codecs(n_codec=64, n_queue=256):
+    """The wire tax, isolated (docs/PERFORMANCE.md "Serving wire
+    codecs"): how fast tensor payloads cross each serving wire, with the
+    device and pipeline machinery factored out.
+
+    Two tiers:
+    - codec micro: encode+decode of one uint8 image record per codec —
+      the legacy json+base64 envelope, the binary frame, and the binary
+      frame through an actual shm slot (pack into the segment, decode a
+      zero-copy view back out).
+    - queue path: producer -> queue -> worker-side decode ->
+      jax.device_put, per record, same run: the legacy serialized json
+      wire (what File/Redis ship), the in-process MemoryQueue shortcut
+      (dict hand-off, base64 tensors), and the ShmQueue binary ring.
+      ``queue_path_speedup`` = shm vs the serialized json wire — the
+      end-to-end zero-copy win.
+    """
+    import gc
+    import json as _json
+
+    import jax
+
+    from analytics_zoo_tpu.core.profiling import TIMERS
+    from analytics_zoo_tpu.deploy import (MemoryQueue, encode_tensor,
+                                          pack_record, unpack_record)
+    from analytics_zoo_tpu.deploy.serving import _decode_record
+    from analytics_zoo_tpu.deploy.shmqueue import ShmQueue, shm_available
+
+    rs = np.random.RandomState(0)
+    img = rs.randint(0, 256, (224, 224, 3)).astype(np.uint8)
+    nbytes = img.nbytes
+    out = {"payload": "uint8 224x224x3", "payload_bytes": nbytes}
+    mbs = lambda n, dt: round(n * nbytes / dt / 1e6, 1)
+
+    # --- tier 1: raw codec round-trips --------------------------------
+    def rec_of(i):
+        return {"uri": f"c{i}", "ts": 0.0, "fmt": "tensor", "x": img}
+
+    t0 = time.perf_counter()
+    for i in range(n_codec):
+        blob = _json.dumps({**rec_of(i), "x": encode_tensor(img)})
+        back = _json.loads(blob)
+        _decode_record(back)
+    dt_json = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for i in range(n_codec):
+        _decode_record(unpack_record(pack_record(rec_of(i))))
+    dt_bin = time.perf_counter() - t0
+    micro = {
+        "json_b64_imgs_per_sec": round(n_codec / dt_json, 1),
+        "json_b64_mb_per_sec": mbs(n_codec, dt_json),
+        "binary_imgs_per_sec": round(n_codec / dt_bin, 1),
+        "binary_mb_per_sec": mbs(n_codec, dt_bin),
+        "binary_speedup": _safe_ratio(n_codec / dt_bin,
+                                      n_codec / dt_json),
+    }
+    if shm_available():
+        q = ShmQueue(name="codec_micro", slots=8,
+                     slot_bytes=nbytes + (1 << 12))
+        try:
+            t0 = time.perf_counter()
+            for i in range(n_codec):
+                q.push(rec_of(i))
+                [(_, rec)] = q.pop_batch(1, timeout=1.0)
+                _decode_record(rec)
+                del rec         # release the slot lease
+            dt_shm = time.perf_counter() - t0
+            micro["shm_imgs_per_sec"] = round(n_codec / dt_shm, 1)
+            micro["shm_mb_per_sec"] = mbs(n_codec, dt_shm)
+            micro["shm_speedup"] = _safe_ratio(n_codec / dt_shm,
+                                               n_codec / dt_json)
+        finally:
+            q.stop()
+    out["codec_micro"] = micro
+
+    # --- tier 2: through the queue to the device ----------------------
+    # Two payload sizes: the uint8 image wire (150KB — shm fixed costs
+    # show) and the float32 tensor wire (600KB — the regime embeddings /
+    # feature tensors live in, where the per-byte codec tax dominates).
+    jax.device_put(img).block_until_ready()     # backend warmup
+
+    def queue_leg(push_one, pop_decode, n, chunk=32):
+        """push `chunk` records, pop + decode + device_put them, repeat;
+        returns imgs/s.  Per-record device_put on both sides keeps the
+        comparison honest (the device share is identical)."""
+        t0 = time.perf_counter()
+        done = 0
+        while done < n:
+            k = min(chunk, n - done)
+            for i in range(k):
+                push_one(done + i)
+            popped = pop_decode(k)
+            assert len(popped) == k
+            for x in popped:
+                jax.device_put(x).block_until_ready()
+            done += k
+            del popped, x
+        return n / (time.perf_counter() - t0)
+
+    out["queue_path"] = {}
+    for dtype_name, a in (("uint8", img),
+                          ("float32", img.astype(np.float32))):
+        pb = a.nbytes
+        pmbs = lambda rate: round(rate * pb / 1e6, 1)
+
+        def rec_a(i):
+            return {"uri": f"q{i}", "ts": 0.0, "fmt": "tensor", "x": a}
+
+        qp = {"payload_bytes": pb}
+        # legacy serialized wire: json envelope + base64 tensors (the
+        # File/Redis legacy shape, writable-copy decode semantics),
+        # transported over MemoryQueue so only the codec differs
+        qj = MemoryQueue()
+        rate = queue_leg(
+            lambda i: qj.push(_json.loads(_json.dumps(
+                {**rec_a(i), "x": encode_tensor(a)}))),
+            lambda k: [_decode_record(r)["x"]
+                       for _, r in qj.pop_batch(k, timeout=1.0)],
+            n_queue)
+        qp["json_wire_imgs_per_sec"] = round(rate, 1)
+        qp["json_wire_mb_per_sec"] = pmbs(rate)
+        # in-process shortcut: same base64 tensor payloads, no envelope
+        qm = MemoryQueue()
+        rate = queue_leg(
+            lambda i: qm.push({**rec_a(i), "x": encode_tensor(a)}),
+            lambda k: [_decode_record(r)["x"]
+                       for _, r in qm.pop_batch(k, timeout=1.0)],
+            n_queue)
+        qp["memory_b64_imgs_per_sec"] = round(rate, 1)
+        if shm_available():
+            qs = ShmQueue(name="codec_path", slots=64,
+                          slot_bytes=pb + (1 << 12), push_timeout_s=10.0)
+            try:
+                c0 = TIMERS.counts()
+                rate = queue_leg(
+                    lambda i: qs.push(rec_a(i)),
+                    lambda k: [_decode_record(r)["x"]
+                               for _, r in qs.pop_batch(k, timeout=1.0)],
+                    n_queue)
+                gc.collect()
+                counts = TIMERS.counts()
+                qp["shm_imgs_per_sec"] = round(rate, 1)
+                qp["shm_mb_per_sec"] = pmbs(rate)
+                # counter-verified zero-copy at bench time
+                qp["shm_tensor_copies"] = (
+                    counts.get("serving/codec_tensor_copies", 0)
+                    - c0.get("serving/codec_tensor_copies", 0))
+                qp["queue_path_speedup"] = _safe_ratio(
+                    qp["shm_imgs_per_sec"],
+                    qp["json_wire_imgs_per_sec"])
+            finally:
+                qs.stop()
+        else:
+            qp["shm_skipped"] = "POSIX shared memory unavailable"
+        out["queue_path"][dtype_name] = qp
     return out
 
 
@@ -1546,6 +1765,15 @@ def main():
     except Exception as e:
         extra["serving_error"] = f"{type(e).__name__}: {e}"
     _mark("serving", t0)
+
+    # serving wire codecs: the isolated wire tax (json+b64 vs binary vs
+    # shm ring), device/pipeline factored out — runs on host, no accel
+    t0 = time.time()
+    try:
+        extra["serving_wire_codecs"] = bench_serving_wire_codecs()
+    except Exception as e:
+        extra["serving_wire_codecs_error"] = f"{type(e).__name__}: {e}"
+    _mark("serving_wire_codecs", t0)
 
     # BASELINE config #4: WideAndDeep throughput
     t0 = time.time()

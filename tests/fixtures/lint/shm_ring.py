@@ -1,0 +1,43 @@
+"""zoolint fixture: the shared-memory ring-buffer idiom
+(deploy/shmqueue.py).  The naive port writes its ring cursor from the
+consumer thread with no lock — exactly the race THR-SHARED-MUT exists
+to catch; the shipped idiom (claim the slot under the condition, memcpy
+outside it) stays quiet."""
+
+import threading
+
+
+class NaiveRing:
+    """Unlocked cursor: the consumer thread bumps ``_head`` while the
+    producer reads it — a torn/stale cursor loses or re-reads slots."""
+
+    def __init__(self, slots=8):
+        self._slots = [None] * slots
+        self._head = 0
+        self._thread = threading.Thread(target=self._run)
+
+    def _run(self):
+        self._head = self._head + 1   # THR-SHARED-MUT fires: unlocked
+        # cross-thread cursor write, read by free_slots() below
+
+    def free_slots(self):
+        return len(self._slots) - self._head
+
+
+class LockedRing:
+    """The shipped protocol: cursor and state flips happen under the
+    condition; only the payload memcpy runs outside it."""
+
+    def __init__(self, slots=8):
+        self._cond = threading.Condition()
+        self._slots = [None] * slots
+        self._head = 0
+        self._thread = threading.Thread(target=self._run)
+
+    def _run(self):
+        with self._cond:
+            self._head = self._head + 1   # quiet: claimed under lock
+
+    def free_slots(self):
+        with self._cond:
+            return len(self._slots) - self._head

@@ -317,7 +317,8 @@ class TestClusterServing:
         q = FileQueue(str(tmp_path))
         q.push({"uri": "a"})
         # simulate a worker that claimed and crashed
-        fn = [f for f in os.listdir(q.in_dir) if f.endswith(".json")][0]
+        fn = [f for f in os.listdir(q.in_dir)
+              if f.endswith(FileQueue._EXTS)][0]
         claimed = os.path.join(q.in_dir, fn + ".claimed")
         os.rename(os.path.join(q.in_dir, fn), claimed)
         old = time.time() - 120

@@ -39,26 +39,43 @@ def _newest_artifact():
     return arts[-1]
 
 
+def _pinned_tables():
+    """Every BENCH_TABLE block in the doc, not just the first.  A table
+    may carry ``requires=<dotted key>``: its claims are only checked
+    against artifacts that HAVE that key (so pinning a newly-benched
+    number doesn't fail tier-1 against an older artifact that predates
+    the bench leg — the claim arms itself on the next regeneration)."""
+    tables = []
+    for m in _TABLE_RE.finditer(DOC.read_text()):
+        attrs = dict(re.findall(r"(\w+)=(\S+)", m.group(1)))
+        claims = []
+        for line in m.group(2).splitlines():
+            cells = [c.strip() for c in line.strip().strip("|").split("|")]
+            if (len(cells) != 2 or cells[0] in ("key", "")
+                    or "---" in cells[0]):
+                continue
+            claims.append((cells[0], float(cells[1])))
+        assert claims, "a pinned-claims table is empty"
+        tables.append({"requires": attrs.get("requires"),
+                       "tolerance": float(attrs.get("tolerance", 0.02)),
+                       "claims": claims})
+    assert tables, "PERFORMANCE.md lost its BENCH_TABLE markers"
+    return tables
+
+
 def _pinned_claims():
-    m = _TABLE_RE.search(DOC.read_text())
-    assert m, "PERFORMANCE.md lost its BENCH_TABLE markers"
-    attrs = dict(re.findall(r"(\w+)=(\S+)", m.group(1)))
-    tol = float(attrs.get("tolerance", 0.02))
-    claims = []
-    for line in m.group(2).splitlines():
-        cells = [c.strip() for c in line.strip().strip("|").split("|")]
-        if len(cells) != 2 or cells[0] in ("key", "") or "---" in cells[0]:
-            continue
-        claims.append((cells[0], float(cells[1])))
-    assert claims, "pinned-claims table is empty"
-    return claims, tol
+    tables = _pinned_tables()
+    return ([c for t in tables for c in t["claims"]],
+            tables[0]["tolerance"])
 
 
-def _resolve(doc, dotted):
+def _resolve(doc, dotted, required=True):
     cur = {"parsed": doc.get("parsed", doc)}
     for part in dotted.split("."):
-        assert isinstance(cur, dict) and part in cur, \
-            f"artifact has no key {dotted!r} (stopped at {part!r})"
+        if not (isinstance(cur, dict) and part in cur):
+            assert not required, \
+                f"artifact has no key {dotted!r} (stopped at {part!r})"
+            return None
         cur = cur[part]
     return cur
 
@@ -67,16 +84,36 @@ class TestDocDrift:
     def test_pinned_claims_match_newest_artifact(self):
         art = _newest_artifact()
         doc = json.loads(art.read_text())
-        claims, tol = _pinned_claims()
         bad = []
-        for key, claimed in claims:
-            actual = _resolve(doc, key)
-            assert isinstance(actual, (int, float)), \
-                f"{key} resolves to non-numeric {actual!r}"
-            if actual != pytest.approx(claimed, rel=tol):
-                bad.append(f"{key}: doc={claimed} artifact={actual}")
+        for table in _pinned_tables():
+            req = table["requires"]
+            if req and _resolve(doc, req, required=False) is None:
+                continue        # artifact predates this bench leg
+            for key, claimed in table["claims"]:
+                actual = _resolve(doc, key)
+                assert isinstance(actual, (int, float)), \
+                    f"{key} resolves to non-numeric {actual!r}"
+                if actual != pytest.approx(claimed,
+                                           rel=table["tolerance"]):
+                    bad.append(f"{key}: doc={claimed} artifact={actual}")
         assert not bad, (f"PERFORMANCE.md drifted from {art.name}:\n  "
                          + "\n  ".join(bad))
+
+    def test_requires_gate_skips_only_missing_keys(self):
+        """The requires= mechanism itself: a table gated on a key the
+        artifact lacks is skipped; one gated on a present key is
+        checked (regression for the multi-table finditer upgrade)."""
+        doc = {"parsed": {"extra": {"new_leg": {"speedup": 12.0}}}}
+        assert _resolve(doc, "parsed.extra.new_leg.speedup") == 12.0
+        assert _resolve(doc, "parsed.extra.absent_leg",
+                        required=False) is None
+        with pytest.raises(AssertionError):
+            _resolve(doc, "parsed.extra.absent_leg")
+        # and the doc of record actually uses multi-table pinning
+        tables = _pinned_tables()
+        assert len(tables) >= 2, \
+            "expected the wire-codec claims in their own BENCH_TABLE"
+        assert any(t["requires"] for t in tables)
 
     def test_pinned_claims_are_finite(self):
         import math

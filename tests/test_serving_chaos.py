@@ -305,18 +305,33 @@ class TestExecutorSelfHealing:
 
 @pytest.mark.slow
 class TestServingChaosSoak:
-    def test_soak_all_sites_zero_lost(self):
+    @pytest.mark.parametrize("backend", [
+        "memory",
+        pytest.param("shm", marks=pytest.mark.skipif(
+            not __import__(
+                "analytics_zoo_tpu.deploy.shmqueue",
+                fromlist=["shm_available"]).shm_available(),
+            reason="POSIX shared memory unavailable"))])
+    def test_soak_all_sites_zero_lost(self, backend):
         """Saturated load with every serving fault site armed: all
         records terminate (result or typed error), recovery counters
         move, health() exposes the replica state machine, and fault-free
-        throughput afterwards is within tolerance of before."""
+        throughput afterwards is within tolerance of before.  Runs on
+        the legacy in-memory backend AND the zero-copy shm ring (same
+        zero-lost bar, plus: no leaked /dev/shm segment afterwards)."""
 
         def fwd(xs):
             time.sleep(0.001)
             return xs[0] * 2.0
 
         m = InferenceModel(fwd, batch_buckets=(1, 8))
-        q = MemoryQueue()
+        if backend == "shm":
+            from analytics_zoo_tpu.deploy.shmqueue import ShmQueue
+
+            q = ShmQueue(name="chaos_soak", slots=128,
+                         slot_bytes=1 << 16, push_timeout_s=20.0)
+        else:
+            q = MemoryQueue()
         inp, outp = InputQueue(q), OutputQueue(q)
         cfg = ServingConfig(batch_size=8, poll_timeout_s=0.02,
                             max_batch_delay_ms=3, decode_workers=2,
@@ -447,6 +462,19 @@ class TestServingChaosSoak:
         finally:
             srv.stop()
         assert not srv.is_alive()
+        if backend == "shm":
+            import os
+
+            from analytics_zoo_tpu.deploy.shmqueue import live_segments
+
+            # the soak ran the binary zero-copy wire end to end: the
+            # legacy base64 codec must never have fired for live records
+            # (the 5 pre-expired records were pushed legacy on purpose)
+            assert delta("serving/codec_b64_encode") == 5
+            seg = q.segment
+            q.stop()
+            assert seg not in live_segments()
+            assert not os.path.exists(os.path.join("/dev/shm", seg))
 
 
 class TestStageRestart:
