@@ -63,10 +63,23 @@ class ZooConfig:
     # TPU analog of the reference's PMEM/DRAM cached partitions
     # (feature/FeatureSet.scala:690-722).
     data_cache_level: str = "HOST"
-    # HBM budget for DEVICE caching; datasets above it fall back to the
-    # HOST prefetch path automatically (4 GiB default leaves room for
-    # params/activations on every shipping TPU generation).
+    # HBM budget for DEVICE caching; datasets above it stream
+    # budget-sized shards through HBM (CacheLevel.STREAM — the tier
+    # auto-router is replicated < budget < stream < host) with the host
+    # prefetch path as the final fallback (4 GiB default leaves room
+    # for params/activations on every shipping TPU generation).
     data_device_budget_bytes: int = 4 << 30
+    # STREAM tier: HBM shard slots alive at once.  2 = double
+    # buffering — shard N+1 uploads on the background uploader thread
+    # while the jitted shard program trains on shard N.
+    data_stream_slots: int = 2
+    # Compressed device cache for STREAM shards: None keeps shards at
+    # their native dtype; "uint8" (affine) / "int8" (symmetric) encode
+    # FLOAT feature arrays host-side and decode them in-kernel after
+    # the minibatch gather (ops/quantization.py), stretching the
+    # effective device budget ~4x for image/embedding features.
+    # Labels and integer arrays always pass through unquantized.
+    data_cache_dtype: Optional[str] = None
 
     # --- serving ---------------------------------------------------------
     # Pipelined serving engine (docs/SERVING.md).  The DynamicBatcher

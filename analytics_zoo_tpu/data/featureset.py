@@ -26,6 +26,16 @@ import numpy as np
 MemoryType = str  # "DRAM" | "DISK_AND_DRAM" | "DIRECT"
 
 
+def npy_header(path: str) -> Tuple[Tuple[int, ...], np.dtype]:
+    """(shape, dtype) of a .npy file from its header ONLY — no data is
+    read or mapped, so the tier auto-router can classify beyond-memory
+    datasets without touching their rows."""
+    with open(path, "rb") as f:
+        version = np.lib.format.read_magic(f)
+        shape, _fortran, dtype = np.lib.format._read_array_header(f, version)
+    return tuple(int(s) for s in shape), np.dtype(dtype)
+
+
 class CacheLevel:
     """Where a FeatureSet's rows live while the Estimator trains from it.
 
@@ -40,14 +50,25 @@ class CacheLevel:
     - ``DEVICE``: the whole dataset is materialized into HBM once and the
       Estimator's device-resident epoch body shuffles and gathers
       minibatches *inside* the compiled step — zero host→device bytes
-      per epoch.  Falls back to HOST automatically when the dataset
-      exceeds ``ZooConfig.data_device_budget_bytes``.
+      per epoch.  Over ``ZooConfig.data_device_budget_bytes`` it
+      upgrades to STREAM (or HOST when streaming is not feasible).
+    - ``STREAM``: the middle tier for datasets bigger than HBM (the
+      reference's PMEM capacity tier, feature/FeatureSet.scala:690-722,
+      made TPU-native): the dataset is split into budget-sized shards
+      staged on the host, and a background uploader
+      (data/streaming.ShardUploader) rotates them through HBM with
+      double-buffered async ``device_put`` — shard N+1 uploads while
+      the jitted shard program trains on shard N.  Two-level shuffle
+      (shard order per epoch, on-device permutation within the shard);
+      optional uint8/int8 compressed shards decoded in-kernel
+      (``ZooConfig.data_cache_dtype``).
     """
 
     HOST = "HOST"
     DEVICE = "DEVICE"
+    STREAM = "STREAM"
 
-    _LEVELS = (HOST, DEVICE)
+    _LEVELS = (HOST, DEVICE, STREAM)
 
     @staticmethod
     def normalize(level: str) -> str:
@@ -255,6 +276,15 @@ class FeatureSet:
                 pass
         return np.asarray(a[idx])
 
+    def read_rows(self, start: int, stop: int) -> List[np.ndarray]:
+        """Row span [start, stop) of every backing array (views for DRAM
+        arrays, lazy page-backed reads for mmap tiers) — the shard
+        loader for the STREAM tier."""
+        if not (0 <= start <= stop <= len(self)):
+            raise ValueError(f"row span [{start}, {stop}) out of range "
+                             f"for {len(self)} rows")
+        return [a[start:stop] for a in self.arrays]
+
     # -- internals --------------------------------------------------------
     @staticmethod
     def _to_mmap(a: np.ndarray) -> np.ndarray:
@@ -294,12 +324,30 @@ class SlicedFeatureSet(FeatureSet):
         # slice-wise sets exist BECAUSE the data outgrows resident memory;
         # HBM caching is never applicable
         self.cache_level = CacheLevel.HOST
-        # row counts from headers only (no data load)
+        # row counts and byte totals from headers only (no data load,
+        # no mmap): classifying a beyond-memory dataset must not cost a
+        # page-cache walk over it
         self._slice_rows = []
+        self._disk_bytes = 0
+        self._row_specs: Optional[List[Tuple[Tuple[int, ...],
+                                             np.dtype]]] = None
         for s in self.slice_paths:
-            counts = {len(np.load(p, mmap_mode="r")) for p in s}
+            counts = set()
+            specs = []
+            for p in s:
+                shape, dtype = npy_header(p)
+                counts.add(shape[0] if shape else 0)
+                specs.append((shape[1:], dtype))
+                self._disk_bytes += dtype.itemsize * int(
+                    np.prod(shape, dtype=np.int64))
             if len(counts) != 1:
                 raise ValueError(f"slice {s} arrays are not aligned")
+            if self._row_specs is None:
+                self._row_specs = specs
+            elif specs != self._row_specs:
+                raise ValueError(
+                    f"slice {s} row shapes/dtypes differ from the first "
+                    f"slice: {specs} vs {self._row_specs}")
             self._slice_rows.append(counts.pop())
 
     def transform(self, fn) -> "SlicedFeatureSet":
@@ -310,22 +358,47 @@ class SlicedFeatureSet(FeatureSet):
 
     @property
     def nbytes(self) -> int:
-        """Summed on-disk bytes across slices (headers only, no load)."""
-        total = 0
-        for s in self.slice_paths:
-            for p in s:
-                a = np.load(p, mmap_mode="r")
-                total += a.dtype.itemsize * a.size
-        return int(total)
+        """Summed on-disk bytes across slices, computed at __init__ from
+        the .npy headers alone (``npy_header``) — no slice is loaded or
+        mapped to answer the budget check."""
+        return int(self._disk_bytes)
 
     def cache(self, level: str = CacheLevel.DEVICE) -> "SlicedFeatureSet":
-        if CacheLevel.normalize(level) == CacheLevel.DEVICE:
+        lv = CacheLevel.normalize(level)
+        if lv == CacheLevel.DEVICE:
             raise ValueError(
                 "SlicedFeatureSet streams slices because the dataset "
                 "outgrows resident memory; a DEVICE (HBM) cache cannot "
-                "hold it — use FeatureSet.from_ndarrays for data that "
-                "fits the device budget")
-        return self
+                "hold it — use CacheLevel.STREAM to rotate budget-sized "
+                "shards through HBM, or FeatureSet.from_ndarrays for "
+                "data that fits the device budget")
+        fs = SlicedFeatureSet.__new__(SlicedFeatureSet)
+        fs.__dict__.update(self.__dict__)
+        fs.cache_level = lv
+        return fs
+
+    def read_rows(self, start: int, stop: int) -> List[np.ndarray]:
+        """Materialize global rows [start, stop) across slice files
+        (mmap-backed reads, copied out) — the shard loader for the
+        STREAM tier.  Bounded by the requested span, not the slice
+        layout."""
+        if not (0 <= start <= stop <= len(self)):
+            raise ValueError(f"row span [{start}, {stop}) out of range "
+                             f"for {len(self)} rows")
+        width = len(self.slice_paths[0])
+        parts: List[List[np.ndarray]] = [[] for _ in range(width)]
+        offset = 0
+        for si, rows in enumerate(self._slice_rows):
+            lo, hi = max(start - offset, 0), min(stop - offset, rows)
+            if lo < hi:
+                for j, p in enumerate(self.slice_paths[si]):
+                    a = np.load(p, mmap_mode="r")
+                    parts[j].append(np.asarray(a[lo:hi]))
+            offset += rows
+            if offset >= stop:
+                break
+        return [np.concatenate(ps) if len(ps) > 1 else ps[0]
+                for ps in parts]
 
     def __len__(self) -> int:
         return int(sum(self._slice_rows))

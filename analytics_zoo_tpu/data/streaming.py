@@ -1,0 +1,460 @@
+"""STREAM cache tier — double-buffered host→device shard rotation.
+
+Reference capability: the L2 cache *hierarchy* (DRAM / PMEM /
+DISK_AND_DRAM, feature/FeatureSet.scala:690-722) — production datasets
+don't fit the fast tier, so the reference stages cached partitions in a
+slower-but-bigger medium and feeds workers from there.
+
+TPU-native design: the fast tier is HBM and the capacity tier is host
+memory (numpy / mmap — ``FeatureSet.read_rows``), so the middle tier
+becomes a *rotation*: the dataset is split into budget-sized shards and
+a background uploader thread keeps ``ZooConfig.data_stream_slots``
+(default 2 — double buffering) shards alive in HBM, uploading shard
+N+1 while the Estimator's jitted shard program trains on shard N.  JAX
+dispatch is async, so the training loop only ever blocks when an upload
+is slower than a whole shard of compute — the steady-state wait is
+bounded by ONE upload, counter-verified by
+``data_stream_overlap_frac``.
+
+Shuffle is two-level (the reference's cached index-shuffled partitions,
+FeatureSet.scala:229, split across the tiers): the shard ORDER is
+permuted per epoch from a seed+epoch-deterministic stream (so resume
+needs no extra rng state), and rows WITHIN the resident shard are
+permuted on device inside the jitted program.
+
+The compressed device cache (``ZooConfig.data_cache_dtype``) encodes
+float feature shards to uint8/int8 host-side
+(ops/quantization.quantize_feature_array) and decodes them in-kernel
+after the minibatch gather — ~4× more rows per HBM byte for
+image/embedding features.
+
+Lease/ready protocol (the ``PrefetchIterator`` pattern with slot
+recycling): the uploader owns a free-slot queue; ``get()`` hands the
+consumer a :class:`ShardLease`, and ``lease.release(after=carry_leaf)``
+returns the slot with a sync handle — before re-using that HBM slot
+for shard N+2 the uploader blocks on shard N's output, ON ITS OWN
+THREAD, so the wait itself overlaps the main thread's dispatch of
+shard N+1.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from analytics_zoo_tpu.observe import metrics as obs
+from analytics_zoo_tpu.robust import faults
+
+logger = logging.getLogger("analytics_zoo_tpu.data")
+
+_SENTINEL = object()
+
+
+class StreamUploadError(RuntimeError):
+    """A shard failed to stage/upload (uploader crash, torn shard).
+
+    The Estimator catches this mid-rotation and finishes the epoch's
+    remaining shards through the host path — the epoch is never lost.
+    """
+
+
+class StreamPlan:
+    """Shard geometry for one STREAM fit: how many shards, how many
+    rows each, and which arrays travel quantized.
+
+    All shards share ONE static shape (``shard_rows`` rows, a multiple
+    of the effective batch), so a single compiled shard program is
+    reused across every shard of every epoch.  The tail beyond
+    ``n_shards * shard_rows`` rows is dropped per epoch (< one batch
+    per shard — the streaming analog of ``drop_remainder``).
+    """
+
+    def __init__(self, *, n_rows: int, n_shards: int, shard_rows: int,
+                 steps_per_shard: int, eff_batch: int, slots: int,
+                 cache_dtype: Optional[str],
+                 specs: List[Tuple[Tuple[int, ...], np.dtype]],
+                 quantized: Tuple[bool, ...]):
+        self.n_rows = n_rows
+        self.n_shards = n_shards
+        self.shard_rows = shard_rows
+        self.steps_per_shard = steps_per_shard
+        self.eff_batch = eff_batch
+        self.slots = slots
+        self.cache_dtype = cache_dtype
+        self.specs = specs              # post-transform (row shape, dtype)
+        self.quantized = quantized      # per-array: encoded for upload?
+        self.dropped_rows = n_rows - n_shards * shard_rows
+        self.device_shard_bytes = shard_rows * self._device_row_bytes()
+        # bytes of quantized payload each shard dispatch decodes
+        # in-kernel (gathered rows only)
+        self.decode_bytes_per_shard = steps_per_shard * eff_batch * sum(
+            int(np.prod(shape, dtype=np.int64))
+            for (shape, _), q in zip(specs, quantized) if q)
+
+    def _device_row_bytes(self) -> int:
+        total = 0
+        for (shape, dtype), q in zip(self.specs, self.quantized):
+            elems = int(np.prod(shape, dtype=np.int64))
+            total += elems * (1 if q else dtype.itemsize)
+        return total
+
+    # -- epoch geometry ---------------------------------------------------
+    def epoch_order(self, seed: int, epoch: int,
+                    shuffle: bool) -> np.ndarray:
+        """Shard visit order for ``epoch`` — level 1 of the two-level
+        shuffle.  Deterministic in (seed, epoch), consuming NO carried
+        rng state, so a mid-epoch resume re-derives the identical order
+        from the manifest's epoch number alone."""
+        if not shuffle or self.n_shards == 1:
+            return np.arange(self.n_shards)
+        rs = np.random.RandomState(
+            (int(seed) + 7919 * (int(epoch) + 1)) % (2 ** 31 - 1))
+        return rs.permutation(self.n_shards)
+
+    # -- host staging -----------------------------------------------------
+    def load_shard(self, fs, shard_id: int) -> List[np.ndarray]:
+        """Stage shard ``shard_id``'s rows in host memory: a row-span
+        read (DRAM view / mmap pages / SlicedFeatureSet cross-slice
+        gather) plus the FeatureSet's transforms, applied once per
+        shard (row-independent per the lazy per-batch protocol — same
+        contract as ``FeatureSet.device_arrays``)."""
+        start = shard_id * self.shard_rows
+        arrays = fs.read_rows(start, start + self.shard_rows)
+        if fs.transforms:
+            batch = tuple(np.asarray(a) for a in arrays)
+            for fn in fs.transforms:
+                batch = fn(*batch)
+                if not isinstance(batch, tuple):
+                    batch = (batch,)
+            arrays = list(batch)
+        return arrays
+
+    def validate_shard(self, arrays: Sequence[np.ndarray],
+                       shard_id: int) -> None:
+        """Defense against torn reads: every staged array must match the
+        plan's static shard shape exactly, or the shard is unusable."""
+        for j, (a, (shape, dtype)) in enumerate(zip(arrays, self.specs)):
+            want = (self.shard_rows,) + tuple(shape)
+            if tuple(a.shape) != want or a.dtype != dtype:
+                raise StreamUploadError(
+                    f"torn shard {shard_id}: array {j} is "
+                    f"{a.shape}/{a.dtype}, expected {want}/{dtype}")
+
+    # -- device staging ---------------------------------------------------
+    def put_shard(self, arrays: Sequence[np.ndarray], ctx) -> List[Any]:
+        """Encode + upload one staged shard: quantized arrays travel as
+        ``{"q", "scale", "zero"}`` pytrees (per-shard scalar scales),
+        rows sharded over the mesh's data axis with the same
+        ``dataset_sharding`` specs as a DEVICE cache — dp×tp meshes
+        keep working.  Blocks until the transfer lands (the uploader
+        thread pays this wait, not the training loop)."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from analytics_zoo_tpu.ops.quantization import quantize_feature_array
+        from analytics_zoo_tpu.parallel.sharding import dataset_sharding
+
+        rep = NamedSharding(ctx.mesh, P())
+        out: List[Any] = []
+        for a, q in zip(arrays, self.quantized):
+            row_shard = dataset_sharding(ctx.mesh, self.shard_rows,
+                                         np.ndim(a), axis=ctx.data_axis)
+            if q:
+                qa, scale, zero = quantize_feature_array(
+                    np.asarray(a), self.cache_dtype)
+                out.append({"q": jax.device_put(qa, row_shard),
+                            "scale": jax.device_put(scale, rep),
+                            "zero": jax.device_put(zero, rep)})
+            else:
+                out.append(jax.device_put(a, row_shard))
+        jax.block_until_ready(out)
+        return out
+
+    def probe_inputs(self, fs) -> List[np.ndarray]:
+        """Tiny (2-row) post-transform host arrays for the Estimator's
+        shape-only model build (features only, label excluded)."""
+        rows = min(len(fs), 2)
+        arrays = fs.read_rows(0, rows)
+        if fs.transforms:
+            batch = tuple(np.asarray(a) for a in arrays)
+            for fn in fs.transforms:
+                batch = fn(*batch)
+                if not isinstance(batch, tuple):
+                    batch = (batch,)
+            arrays = list(batch)
+        return [np.asarray(a) for a in arrays[:-1]]
+
+
+def plan_stream(fs, budget_bytes: int, eff_batch: int, *, slots: int = 2,
+                cache_dtype: Optional[str] = None
+                ) -> Tuple[Optional[StreamPlan], str]:
+    """Derive the shard geometry for streaming ``fs`` through a
+    ``budget_bytes`` HBM bill, or explain why streaming is infeasible:
+    returns ``(plan, "")`` or ``(None, reason)``.
+
+    Each shard is sized for one of ``slots`` HBM slots (budget/slots),
+    so the steady-state footprint of the rotation — ``slots`` live
+    shards — respects the budget the DEVICE tier would have used.
+    """
+    n = len(fs)
+    if n == 0:
+        return None, "empty dataset"
+    if eff_batch <= 0 or n < eff_batch:
+        return None, (f"dataset ({n} rows) smaller than one effective "
+                      f"batch ({eff_batch})")
+    probe = fs.read_rows(0, min(n, 2))
+    if fs.transforms:
+        batch = tuple(np.asarray(a) for a in probe)
+        for fn in fs.transforms:
+            batch = fn(*batch)
+            if not isinstance(batch, tuple):
+                batch = (batch,)
+        probe = list(batch)
+    specs = [(tuple(int(s) for s in np.shape(a)[1:]),
+              np.dtype(np.asarray(a).dtype)) for a in probe]
+    if len(specs) < 2:
+        return None, "streaming needs (inputs..., label) arrays"
+    if cache_dtype is not None and cache_dtype not in ("uint8", "int8"):
+        raise ValueError(f"unknown data_cache_dtype {cache_dtype!r}; "
+                         "known: None, uint8, int8")
+    # compress float FEATURE arrays only; the label (last array) and
+    # integer features (ids, tokens) pass through unquantized
+    quantized = tuple(
+        cache_dtype is not None and j < len(specs) - 1
+        and np.issubdtype(dtype, np.floating)
+        for j, (_, dtype) in enumerate(specs))
+    slots = max(2, int(slots))
+    slot_budget = max(1, int(budget_bytes) // slots)
+    row_bytes = sum(
+        int(np.prod(shape, dtype=np.int64)) * (1 if q else dtype.itemsize)
+        for (shape, dtype), q in zip(specs, quantized))
+    max_rows_per_shard = slot_budget // max(1, row_bytes)
+    if max_rows_per_shard < eff_batch:
+        return None, (
+            f"a {slot_budget}B HBM slot holds {max_rows_per_shard} rows "
+            f"({row_bytes}B/row) — less than one batch ({eff_batch})")
+    n_shards = max(1, -(-n * row_bytes // slot_budget))   # ceil
+    shard_rows = ((n // n_shards) // eff_batch) * eff_batch
+    if shard_rows == 0:
+        return None, (f"{n} rows over {n_shards} shards leaves no full "
+                      f"batch of {eff_batch} per shard")
+    plan = StreamPlan(
+        n_rows=n, n_shards=n_shards, shard_rows=shard_rows,
+        steps_per_shard=shard_rows // eff_batch, eff_batch=eff_batch,
+        slots=slots, cache_dtype=cache_dtype, specs=specs,
+        quantized=quantized)
+    if plan.dropped_rows:
+        logger.warning(
+            "STREAM tier drops %d/%d rows per epoch (%d shards x %d "
+            "rows; < one batch per shard, the streaming analog of "
+            "drop_remainder)", plan.dropped_rows, n, n_shards, shard_rows)
+    return plan, ""
+
+
+class ShardLease:
+    """One uploaded shard, alive in an HBM slot until released.
+
+    ``release(after=...)`` hands the slot back to the uploader with a
+    sync handle (any device array produced by this shard's compute);
+    the uploader blocks on it — on its own thread — before overwriting
+    the slot, which is what makes slot recycling safe without the
+    training loop ever waiting on uploads it doesn't need yet.
+    """
+
+    __slots__ = ("position", "shard_id", "xs", "y", "_slot", "_uploader",
+                 "_released")
+
+    def __init__(self, position: int, shard_id: int, arrays: List[Any],
+                 slot: int, uploader: "ShardUploader"):
+        self.position = position        # index into the epoch's order
+        self.shard_id = shard_id        # which fixed partition
+        self.xs = arrays[:-1]
+        self.y = arrays[-1]
+        self._slot = slot
+        self._uploader = uploader
+        self._released = False
+
+    def release(self, after: Any = None) -> None:
+        if self._released:
+            return
+        self._released = True
+        self._uploader._release_slot(self._slot, after)
+
+
+class ShardUploader:
+    """Background shard staging: load → (encode) → ``device_put`` on a
+    daemon thread, ``slots`` shards ahead of the consumer at most.
+
+    The ``PrefetchIterator`` contract carried over: producer exceptions
+    surface at the consumption point (as :class:`StreamUploadError`),
+    the sentinel is never dropped, and ``close()`` is idempotent and
+    bounded.  What's new is the slot protocol (see :class:`ShardLease`)
+    and the fault sites ``data.shard_upload`` (planned crash per shard)
+    and ``data.shard_torn`` (planned truncation caught by shape
+    validation).
+    """
+
+    def __init__(self, fs, plan: StreamPlan, order: np.ndarray, ctx, *,
+                 start: int = 0):
+        self._plan = plan
+        self._ready: "queue.Queue" = queue.Queue()
+        self._free: "queue.Queue" = queue.Queue()
+        for slot in range(plan.slots):
+            self._free.put((slot, None))
+        self._stop = threading.Event()
+        self._err_lock = threading.Lock()
+        self._err: Optional[BaseException] = None
+        self._closed = False
+        self._close_lock = threading.Lock()
+        # stats written by the uploader thread, read by stats() on the
+        # training thread — lock-guarded on both sides
+        self._stats_lock = threading.Lock()
+        self._upload_ms_total = 0.0
+        self._uploads = 0
+
+        def put_retry(obj) -> bool:
+            while not self._stop.is_set():
+                try:
+                    self._ready.put(obj, timeout=0.1)
+                    return True
+                except queue.Full:      # pragma: no cover - unbounded q
+                    continue
+            return False
+
+        def claim_slot() -> Optional[Tuple[int, Any]]:
+            while not self._stop.is_set():
+                try:
+                    return self._free.get(timeout=0.1)
+                except queue.Empty:
+                    continue
+            return None
+
+        def run():
+            try:
+                for pos in range(start, len(order)):
+                    slot = claim_slot()
+                    if slot is None:
+                        return          # closed mid-rotation
+                    slot_id, after = slot
+                    if after is not None:
+                        # shard (pos - slots)'s compute must finish
+                        # before its HBM slot is overwritten; this wait
+                        # runs HERE, overlapping the main thread's
+                        # dispatch of the shard in the other slot
+                        import jax
+                        jax.block_until_ready(after)
+                    shard_id = int(order[pos])
+                    # chaos hook: a planned uploader crash surfaces here
+                    faults.inject("data.shard_upload")
+                    t0 = time.perf_counter()
+                    host = plan.load_shard(fs, shard_id)
+                    torn = faults.fire("data.shard_torn")
+                    if torn is not None:
+                        if torn.exc is not None:
+                            raise torn.exc
+                        # a torn read delivers short rows; validation
+                        # below catches it like the real thing
+                        host = [a[:max(0, len(a) // 2)] for a in host]
+                    plan.validate_shard(host, shard_id)
+                    dev = plan.put_shard(host, ctx)
+                    del host            # release staging before waiting
+                    dt_ms = (time.perf_counter() - t0) * 1e3
+                    obs.observe("data_shard_upload_ms", dt_ms,
+                                flat="stream/shard_upload_ms")
+                    with self._stats_lock:
+                        self._upload_ms_total += dt_ms
+                        self._uploads += 1
+                    if not put_retry(ShardLease(pos, shard_id, dev,
+                                                slot_id, self)):
+                        return
+            except BaseException as e:  # propagate to consumer
+                with self._err_lock:
+                    self._err = e
+            finally:
+                put_retry(_SENTINEL)
+
+        self._thread = threading.Thread(
+            target=run, daemon=True, name="zoo-shard-uploader")
+        self._thread.start()
+
+    # -- consumer side ----------------------------------------------------
+    def get(self) -> ShardLease:
+        """Next uploaded shard; blocks while the uploader is behind
+        (the blocked time is the ``data_shard_wait_ms`` histogram — at
+        steady state it should be near zero)."""
+        t0 = time.perf_counter()
+        item = self._get()
+        obs.observe("data_shard_wait_ms", (time.perf_counter() - t0) * 1e3,
+                    flat="stream/shard_wait_ms")
+        if item is _SENTINEL:
+            self._thread.join()
+            err = self._error()
+            if err is not None:
+                if isinstance(err, StreamUploadError):
+                    raise err
+                raise StreamUploadError(
+                    f"shard uploader failed: {err}") from err
+            raise StreamUploadError(
+                "shard uploader exhausted before the rotation finished")
+        return item
+
+    def _get(self):
+        while True:
+            try:
+                return self._ready.get(timeout=1.0)
+            except queue.Empty:
+                if not self._thread.is_alive():
+                    try:
+                        return self._ready.get_nowait()
+                    except queue.Empty:
+                        err = self._error()
+                        if err is not None:
+                            raise StreamUploadError(
+                                f"shard uploader died: {err}") from err
+                        raise StreamUploadError(
+                            "shard uploader thread died without a "
+                            "sentinel") from None
+
+    def _error(self) -> Optional[BaseException]:
+        with self._err_lock:
+            return self._err
+
+    def _release_slot(self, slot: int, after: Any) -> None:
+        self._free.put((slot, after))
+
+    def stats(self) -> Dict[str, float]:
+        with self._stats_lock:
+            return {"upload_ms_total": self._upload_ms_total,
+                    "uploads": float(self._uploads)}
+
+    def close(self, timeout: float = 5.0) -> None:
+        """Stop the uploader (early exit / fallback paths).  Idempotent;
+        drains the ready queue so a producer blocked in ``put_retry``
+        observes the stop flag, then joins with a bounded timeout."""
+        with self._close_lock:
+            if self._closed:
+                return
+            self._closed = True
+        self._stop.set()
+        deadline = None
+        while self._thread.is_alive():
+            try:
+                while True:
+                    self._ready.get_nowait()
+            except queue.Empty:
+                pass
+            self._thread.join(timeout=0.05)
+            if not self._thread.is_alive():
+                break
+            if deadline is None:
+                deadline = time.monotonic() + timeout
+            elif time.monotonic() > deadline:
+                logger.warning(
+                    "shard uploader did not stop within %.1fs of "
+                    "close(); abandoned (daemon thread)", timeout)
+                break

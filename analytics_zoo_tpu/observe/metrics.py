@@ -95,13 +95,36 @@ CATALOG: Dict[str, Tuple[str, str, Tuple[str, ...]]] = {
     # training
     "train_steps_total": (
         "counter", "optimizer steps dispatched, by dispatch kind "
-        "(1|K|epoch)", ("kind",)),
+        "(1|K|epoch|shard)", ("kind",)),
     "train_step_seconds": (
         "histogram", "wall time of one step dispatch", ("kind",)),
     "train_epoch_seconds": ("histogram", "wall time of one epoch", ()),
     "train_loss": ("gauge", "last epoch mean loss", ()),
     "train_throughput_rows_per_s": (
         "gauge", "last epoch training throughput", ()),
+    # data pipeline (STREAM tier + host prefetch)
+    "data_shard_upload_ms": (
+        "histogram", "host->device staging time per streamed shard "
+        "(load + encode + device_put, paid on the uploader thread)",
+        ()),
+    "data_shard_wait_ms": (
+        "histogram", "time the training loop blocked waiting for a "
+        "shard lease (steady-state overlap target: ~0)", ()),
+    "data_stream_overlap_frac": (
+        "gauge", "fraction of shard-upload time hidden behind compute "
+        "over the last fit (1 - wait/upload, clipped to [0, 1])", ()),
+    "data_decode_bytes_total": (
+        "counter", "compressed shard bytes decoded in-kernel, by cache "
+        "dtype (uint8|int8)", ("dtype",)),
+    "data_stream_fallbacks_total": (
+        "counter", "mid-rotation uploader failures absorbed by the "
+        "host path, by reason", ("reason",)),
+    "prefetch_queue_depth": (
+        "gauge", "batches queued ahead of the consumer in the prefetch "
+        "pipeline", ()),
+    "prefetch_producer_stalls_total": (
+        "counter", "producer put() attempts that found the prefetch "
+        "queue full (consumer is the bottleneck)", ()),
     # checkpointing
     "checkpoint_seconds": (
         "histogram", "checkpoint op wall time", ("op",)),

@@ -25,7 +25,47 @@ import jax.numpy as jnp
 import numpy as np
 
 __all__ = ["quantize_tensor", "int8_dot", "Calibrator",
-           "quantize_program"]
+           "quantize_program", "quantize_feature_array",
+           "dequantize_features"]
+
+
+def quantize_feature_array(a: np.ndarray, dtype: str = "uint8"
+                           ) -> Tuple[np.ndarray, np.float32, np.float32]:
+    """Host-side per-array encode of a float feature array for the
+    compressed device cache (data/streaming.py STREAM shards): returns
+    ``(q, scale, zero)`` with ``a ≈ q * scale + zero``.
+
+    ``uint8`` is affine (min/max over the shard — tight for bounded
+    features like images/embeddings); ``int8`` is symmetric (abs-max,
+    zero == 0 — matches the MXU-native convention of
+    ``quantize_tensor``).  Scales are per-shard scalars so the decode
+    is one fused multiply-add in the kernel (``dequantize_features``).
+    """
+    a = np.asarray(a)
+    if not np.issubdtype(a.dtype, np.floating):
+        raise TypeError(f"quantize_feature_array needs floats, got "
+                        f"{a.dtype}")
+    if dtype == "int8":
+        amax = float(np.max(np.abs(a))) if a.size else 0.0
+        scale = amax / 127.0 if amax > 0 else 1.0
+        q = np.clip(np.round(a / scale), -127, 127).astype(np.int8)
+        return q, np.float32(scale), np.float32(0.0)
+    if dtype == "uint8":
+        lo = float(a.min()) if a.size else 0.0
+        hi = float(a.max()) if a.size else 0.0
+        scale = (hi - lo) / 255.0 if hi > lo else 1.0
+        q = np.clip(np.round((a - lo) / scale), 0, 255).astype(np.uint8)
+        return q, np.float32(scale), np.float32(lo)
+    raise ValueError(f"unknown feature cache dtype {dtype!r}; "
+                     "known: uint8, int8")
+
+
+def dequantize_features(q, scale, zero):
+    """In-kernel decode of a ``quantize_feature_array`` shard slice:
+    one fused multiply-add back to float32 (traced inside the jitted
+    shard program, applied AFTER the minibatch gather so only gathered
+    rows pay the decode)."""
+    return q.astype(jnp.float32) * scale + zero
 
 
 def quantize_tensor(w, axis: int = -1) -> Tuple[jnp.ndarray, jnp.ndarray]:

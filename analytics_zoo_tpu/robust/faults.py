@@ -10,6 +10,14 @@ Sites wired into the stack (call granularity in parentheses):
 
 - ``checkpoint.write``    — one per ``save_pytree`` (torn file / raise)
 - ``prefetch.producer``   — one per item the producer thread yields
+- ``data.shard_upload``   — one per shard the STREAM uploader stages
+                            (raise → uploader crash mid-rotation; the
+                            Estimator falls back to the host path for
+                            the epoch's remaining shards)
+- ``data.shard_torn``     — one per shard staged (default action:
+                            truncate the staged rows, caught by the
+                            plan's shape validation exactly like a
+                            real torn read)
 - ``estimator.step``      — one per train-step dispatch on the host
                             input paths (poison batch → NaN loss / raise)
 - ``estimator.preempt``   — one per train-step; firing simulates SIGTERM

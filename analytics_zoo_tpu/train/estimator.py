@@ -183,8 +183,11 @@ class Estimator:
         self._predict_step = None
         self._resident_epoch = None
         self._resident_epoch_key = None
+        self._stream_shard = None
+        self._stream_shard_key = None
+        self._stream_plan = None        # set by _resolve_data_path
         # which input path the last fit() ran ("device_resident" /
-        # "host_prefetch") and why — bench and tests read these
+        # "stream" / "host_prefetch") and why — bench and tests read these
         self.last_data_path: Optional[str] = None
         self.last_data_path_reason: Optional[str] = None
         # observability: the fit-level root span, the current epoch's
@@ -809,6 +812,7 @@ class Estimator:
             self._train_step = None
             self._multi_step = None
             self._resident_epoch = None
+            self._stream_shard = None
         restore_sig = self._install_preempt_handler()
         # fit-level root span + metric mark: every epoch/step span chains
         # under this trace, and training_report() deltas the registry
@@ -818,12 +822,16 @@ class Estimator:
                                       batch_size=batch_size)
         try:
             if isinstance(x, FeatureSet):
-                path, reason = self._resolve_data_path(x)
+                path, reason = self._resolve_data_path(x, batch_size)
                 self.last_data_path, self.last_data_path_reason = \
                     path, reason
                 TIMERS.incr(f"estimator/data_path_{path}")
                 if path == "device_resident":
                     out = self._fit_device_resident(
+                        x, batch_size, epochs, validation_data,
+                        end_trigger, verbose, shuffle)
+                elif path == "stream":
+                    out = self._fit_stream(
                         x, batch_size, epochs, validation_data,
                         end_trigger, verbose, shuffle)
                 else:
@@ -997,13 +1005,18 @@ class Estimator:
                         superbatch (``steps_per_execution``)
         - ``"epoch"`` — the device-resident whole-epoch program
                         (caller supplies ``epoch_fn`` + ``epoch_steps``)
+        - ``"shard"`` — the STREAM tier's whole-shard program (same
+                        calling convention as "epoch"; ``batch_x``
+                        carries the epoch loss accumulator as its first
+                        leaf and the loss out is the advanced
+                        accumulator)
 
         Returns ``(advanced_steps, loss)`` with ``loss`` still on
         device: per-step losses for "1"/"K", the epoch mean for
-        "epoch".  ``global_step`` advances here and nowhere else during
-        fit.
+        "epoch", the accumulator for "shard".  ``global_step`` advances
+        here and nowhere else during fit.
         """
-        if kind == "epoch":
+        if kind in ("epoch", "shard"):
             fn, k = epoch_fn, int(epoch_steps)
         elif kind == "K":
             # the superbatch leading axis IS the step count (tail
@@ -1295,38 +1308,65 @@ class Estimator:
             self._ckpt_mgr.wait()   # join any in-flight async write
         return self.history
 
-    def _resolve_data_path(self, fs) -> Tuple[str, str]:
+    def _resolve_data_path(self, fs, batch_size: int = 32
+                           ) -> Tuple[str, str]:
         """Which input path a FeatureSet trains through:
-        ``("device_resident" | "host_prefetch", reason)``.
+        ``("device_resident" | "stream" | "host_prefetch", reason)``.
 
-        DEVICE caching (the FeatureSet's pinned level, else the
-        ``data_cache_level`` config default) engages only when the whole
-        dataset fits ``data_device_budget_bytes`` of HBM; otherwise the
-        existing host prefetch path runs — the fallback is automatic
-        and logged, never an error (reference tier-selection semantics,
-        feature/FeatureSet.scala:690-722)."""
+        Tier router (reference tier-selection semantics,
+        feature/FeatureSet.scala:690-722), keyed on the FeatureSet's
+        pinned cache level (else the ``data_cache_level`` config
+        default) and ``data_device_budget_bytes``:
+
+        - fits the budget           → device_resident (replicated HBM)
+        - over budget / sliced      → stream (double-buffered shard
+                                      rotation), when a feasible
+                                      :func:`~analytics_zoo_tpu.data.streaming.plan_stream`
+                                      geometry exists
+        - stream infeasible / HOST  → host prefetch
+
+        Every downgrade is automatic and logged, never an error."""
+        from analytics_zoo_tpu.data import streaming as stream_lib
         from analytics_zoo_tpu.data.featureset import (CacheLevel,
                                                        SlicedFeatureSet)
 
         cfg = self.ctx.config
+        self._stream_plan = None
         level = fs.cache_level or CacheLevel.normalize(cfg.data_cache_level)
-        if level != CacheLevel.DEVICE:
+        if level == CacheLevel.HOST:
             return "host_prefetch", "cache level HOST"
-        if isinstance(fs, SlicedFeatureSet):
-            return "host_prefetch", "sliced (beyond-memory) featureset"
         if self.ctx.process_count > 1:
             # make_array_from_process_local_data would need host rows per
-            # step — residency buys nothing under multi-controller yet
+            # step — device residency (replicated or rotating) buys
+            # nothing under multi-controller yet
             return "host_prefetch", "multi-controller process"
         budget = int(cfg.data_device_budget_bytes)
-        if fs.nbytes > budget:
-            logger.warning(
-                "DEVICE cache requested but dataset (%.1f MiB) exceeds "
-                "data_device_budget_bytes (%.1f MiB); falling back to the "
-                "host prefetch path", fs.nbytes / 2 ** 20, budget / 2 ** 20)
-            return "host_prefetch", (
+        sliced = isinstance(fs, SlicedFeatureSet)
+        if not sliced and fs.nbytes <= budget:
+            # whole-dataset residency beats any rotation whenever it
+            # fits — a STREAM request downgrades to plain DEVICE
+            return "device_resident", "fits device budget"
+        d = self._data_div
+        eff_batch = int(math.ceil(max(batch_size, d) / d)) * d
+        plan, why = stream_lib.plan_stream(
+            fs, budget, eff_batch, slots=cfg.data_stream_slots,
+            cache_dtype=cfg.data_cache_dtype)
+        over = ("sliced (beyond-memory) featureset" if sliced else
                 f"dataset {fs.nbytes}B over device budget {budget}B")
-        return "device_resident", "fits device budget"
+        if plan is None:
+            logger.warning(
+                "%s and streaming is infeasible (%s); falling back to "
+                "the host prefetch path", over, why)
+            return "host_prefetch", f"{over}; stream infeasible: {why}"
+        logger.info(
+            "STREAM tier engaged: %s; rotating %d shards of %d rows "
+            "(%.1f MiB/shard in HBM, %d slots%s)", over, plan.n_shards,
+            plan.shard_rows, plan.device_shard_bytes / 2 ** 20, plan.slots,
+            f", {plan.cache_dtype} device cache" if plan.cache_dtype
+            else "")
+        self._stream_plan = plan
+        return "stream", (f"{over}; streaming {plan.n_shards} shards of "
+                          f"{plan.shard_rows} rows")
 
     def _epoch_bookkeeping(self, epoch1, mean_loss, dt, count,
                            validation_data, val_batch_default, verbose,
@@ -1442,6 +1482,257 @@ class Estimator:
             if self._epoch_bookkeeping(epoch, mean_loss, dt,
                                        steps * eff_batch, validation_data,
                                        batch_size, verbose, end_trigger):
+                break
+        if self._ckpt_mgr is not None:
+            self._ckpt_mgr.wait()   # join any in-flight async write
+        return self.history
+
+    def _build_stream_shard(self, plan, shuffle: bool):
+        """ONE jitted program per STREAM shard: permute the shard's rows
+        on device (level 2 of the two-level shuffle), then a
+        ``fori_loop`` of ``steps_per_shard`` train steps gathers each
+        minibatch from the resident shard in-step — the shard analog of
+        ``_build_resident_epoch``, compiled once and reused for every
+        shard of every epoch (all shards share one static shape).
+
+        Differences from the resident epoch program:
+
+        - the epoch loss accumulator ``{"sum", "good"}`` rides through
+          ``xs[0]`` instead of starting at zero, so per-step losses
+          accumulate across shards in the SAME device-side add order as
+          the resident single-dispatch epoch (bit-exact parity);
+        - quantized feature leaves arrive as ``{"q", "scale", "zero"}``
+          pytrees and are decoded in-kernel AFTER the minibatch gather
+          (ops/quantization.dequantize_features) — only the gathered
+          rows pay the decode, and HBM holds 1-byte rows."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from analytics_zoo_tpu.ops.quantization import dequantize_features
+
+        key = (plan.shard_rows, plan.eff_batch, plan.steps_per_shard,
+               bool(shuffle), plan.cache_dtype, plan.quantized)
+        if self._stream_shard is not None and self._stream_shard_key == key:
+            return self._stream_shard
+        if self._train_step is None:
+            self._build_train_step()
+        single = self._single_step_fn
+        mesh = self.ctx.mesh
+        data_axis = self.ctx.data_axis
+        pair_structured = getattr(self.loss_fn, "batch_structured", False)
+        n, eff_batch = plan.shard_rows, plan.eff_batch
+        steps = plan.steps_per_shard
+
+        def constrain(v):
+            return jax.lax.with_sharding_constraint(
+                v, NamedSharding(mesh, P(data_axis,
+                                         *([None] * (v.ndim - 1)))))
+
+        def gather(leaf, idx):
+            if isinstance(leaf, dict):
+                q = jnp.take(leaf["q"], idx, axis=0)
+                return constrain(
+                    dequantize_features(q, leaf["scale"], leaf["zero"]))
+            return constrain(jnp.take(leaf, idx, axis=0))
+
+        def shard(params, state, opt_state, rng, guard, xs, y):
+            acc, arrays = xs[0], xs[1:]
+            rng, prm = jax.random.split(rng)
+            perm = resident_epoch_indices(
+                prm, n, shuffle=shuffle, pair_structured=pair_structured)
+
+            def body(i, carry):
+                p, s, o, r, g, loss_sum, good = carry
+                idx = jax.lax.dynamic_slice_in_dim(perm, i * eff_batch,
+                                                   eff_batch)
+                bxs = [gather(a, idx) for a in arrays]
+                by = gather(y, idx)
+                p, s, o, r, g, loss = single(p, s, o, r, g, bxs, by)
+                finite = jnp.isfinite(loss)
+                loss_sum = loss_sum + jnp.where(finite, loss, 0.0)
+                good = good + finite.astype(jnp.int32)
+                return (p, s, o, r, g, loss_sum, good)
+
+            carry = (params, state, opt_state, rng, guard,
+                     acc["sum"], acc["good"])
+            (params, state, opt_state, rng, guard, loss_sum,
+             good) = jax.lax.fori_loop(0, steps, body, carry)
+            return (params, state, opt_state, rng, guard,
+                    {"sum": loss_sum, "good": good})
+
+        # carry donated; the shard arrays are NOT (their HBM slots are
+        # recycled by the uploader via the lease protocol), and neither
+        # is the accumulator (its leaf doubles as the release sync
+        # handle, so the buffer must survive the dispatch)
+        self._stream_shard = jax.jit(shard, donate_argnums=(0, 1, 2, 3, 4))
+        self._stream_shard_key = key
+        return self._stream_shard
+
+    def _stream_host_tail(self, fs, plan, order, from_shard, acc):
+        """Finish a STREAM epoch on the host path after an uploader
+        failure: the remaining shards of the epoch's order train through
+        per-batch ``device_put`` dispatches (contiguous rows within each
+        shard) — degraded throughput, but the epoch completes with full
+        row coverage and the losses fold into the same device
+        accumulator.  Returns ``(acc, steps_trained)``."""
+        steps = 0
+        losses = []
+        for pos in range(from_shard, plan.n_shards):
+            shard_id = int(order[pos])
+            arrays = plan.load_shard(fs, shard_id)
+            for s in range(plan.steps_per_shard):
+                sl = slice(s * plan.eff_batch, (s + 1) * plan.eff_batch)
+                bx = [np.asarray(a[sl]) for a in arrays[:-1]]
+                by = np.asarray(arrays[-1][sl])
+                bx, by = self._inject_step_faults(bx, by)
+                batch = self._shard_batch(bx + [by])
+                _, loss = self._dispatch_step("1", batch[:-1], batch[-1])
+                losses.append(loss)
+                steps += 1
+        if losses:
+            # fold the host-path step losses into the device accumulator
+            # (device->device, eager) so the epoch mean covers every
+            # trained step with the resident finite-only semantics
+            stack = jnp.stack([jnp.asarray(l) for l in losses])
+            finite = jnp.isfinite(stack)
+            acc = {"sum": acc["sum"]
+                   + jnp.sum(jnp.where(finite, stack, 0.0)),
+                   "good": acc["good"]
+                   + jnp.sum(finite.astype(jnp.int32))}
+        return acc, steps
+
+    def _fit_stream(self, fs, batch_size, epochs, validation_data,
+                    end_trigger, verbose, shuffle):
+        """The STREAM tier: rotate budget-sized shards through HBM with
+        a double-buffered background uploader
+        (data/streaming.ShardUploader) while each resident shard trains
+        as ONE jitted dispatch (``_build_stream_shard``) — datasets
+        bigger than the device budget keep the resident path's
+        zero-per-batch-transfer property, paying ``n_shards`` uploads
+        per epoch that overlap compute.
+
+        Failure story: a mid-rotation uploader crash
+        (:class:`~analytics_zoo_tpu.data.streaming.StreamUploadError`)
+        finishes the epoch's remaining shards through the host path —
+        the epoch is never lost — and the next epoch retries a fresh
+        uploader.  Preemption flushes a manifest whose
+        ``in_epoch_step`` encodes the shard cursor
+        (``shards_done * steps_per_shard``); resume re-derives the
+        epoch's shard order from (seed, epoch) and restarts at that
+        exact shard."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from analytics_zoo_tpu.data import streaming as stream_lib
+
+        cfg = self.ctx.config
+        plan = self._stream_plan
+        if plan is None:    # direct call without the router: re-derive
+            d = self._data_div
+            eff = int(math.ceil(max(batch_size, d) / d)) * d
+            plan, why = stream_lib.plan_stream(
+                fs, int(cfg.data_device_budget_bytes), eff,
+                slots=cfg.data_stream_slots,
+                cache_dtype=cfg.data_cache_dtype)
+            if plan is None:
+                raise ValueError(f"stream fit infeasible: {why}")
+        self._ensure_built(plan.probe_inputs(fs))
+        shard_fn = self._build_stream_shard(plan, shuffle)
+        steps = plan.steps_per_shard
+        if self._val_trigger is not None:
+            logger.warning(
+                "stream path dispatches whole shards; validation_trigger "
+                "is evaluated at epoch boundaries only")
+        # shard-granular resume: the manifest's in_epoch_step was written
+        # as shards_done * steps_per_shard, and the shard order re-derives
+        # from (seed, epoch) — no carried rng state to restore
+        start_shard = 0
+        if self._pending_resume is not None:
+            r_epoch, r_step, _ = self._pending_resume
+            self._pending_resume = None
+            if r_epoch == self.finished_epochs and r_step > 0:
+                start_shard = min(r_step // steps, plan.n_shards)
+                logger.info("stream resume: epoch %d restarts at shard "
+                            "%d/%d", r_epoch + 1, start_shard,
+                            plan.n_shards)
+        # commit the carry under the mesh BEFORE the first dispatch
+        # (same compile-stability reasoning as _fit_device_resident)
+        rep = NamedSharding(self.ctx.mesh, P())
+        (self.params, self.state, self.opt_state, self._rng) = \
+            jax.device_put(
+                (self.params, self.state, self.opt_state, self._rng), rep)
+        self._guard = self._fresh_guard()
+        epoch = self.finished_epochs
+        while epoch < epochs:
+            t0 = time.time()
+            order = plan.epoch_order(cfg.seed, epoch, shuffle)
+            acc = jax.device_put({"sum": np.zeros((), np.float32),
+                                  "good": np.zeros((), np.int32)}, rep)
+            uploader = stream_lib.ShardUploader(fs, plan, order, self.ctx,
+                                                start=start_shard)
+            wait_ms = 0.0
+            trained = 0
+            try:
+                shards_done = start_shard
+                while shards_done < plan.n_shards:
+                    self._maybe_preempt(epoch, shards_done * steps)
+                    try:
+                        tw = time.perf_counter()
+                        lease = uploader.get()
+                        wait_ms += (time.perf_counter() - tw) * 1e3
+                    except stream_lib.StreamUploadError as e:
+                        obs.count("data_stream_fallbacks_total",
+                                  reason="upload_error",
+                                  flat="estimator/stream_fallbacks")
+                        logger.warning(
+                            "shard uploader failed mid-rotation (%s); "
+                            "finishing epoch %d on the host path (%d/%d "
+                            "shards remain)", e, epoch + 1,
+                            plan.n_shards - shards_done, plan.n_shards)
+                        acc, tail = self._stream_host_tail(
+                            fs, plan, order, shards_done, acc)
+                        trained += tail
+                        break
+                    with timeit("estimator/stream_shard"):
+                        _, acc = self._dispatch_step(
+                            "shard", [acc] + list(lease.xs), lease.y,
+                            epoch_fn=shard_fn, epoch_steps=steps)
+                    # the accumulator leaf is this shard's sync handle:
+                    # its HBM slot may be overwritten only after this
+                    # shard's compute has finished
+                    lease.release(after=acc["sum"])
+                    trained += steps
+                    if plan.decode_bytes_per_shard:
+                        obs.count("data_decode_bytes_total",
+                                  plan.decode_bytes_per_shard,
+                                  dtype=plan.cache_dtype,
+                                  flat="stream/decode_bytes")
+                    shards_done += 1
+            finally:
+                up_stats = uploader.stats()
+                uploader.close()
+            start_shard = 0
+            if self._check_nan_guard(max(trained, 1)):
+                epoch = self.finished_epochs    # rolled back
+                continue
+            # epoch-granular sync: the mean divides in f32 host-side so
+            # it matches the resident program's on-device division bit
+            # for bit
+            g = jax.device_get(acc)  # zoolint: disable=JG-TRANSFER-HOT(one sync per epoch by design; the loop variable here is epochs, not batches)
+            mean_loss = float(np.float32(g["sum"])
+                              / np.maximum(g["good"], 1).astype(np.float32))
+            # overlap counter-proof: 1 - (consumer blocked on uploads /
+            # total upload wall time).  ~1.0 = uploads fully hidden
+            # behind compute; ~0.0 = the rotation is upload-bound
+            up = up_stats["upload_ms_total"]
+            overlap = 1.0 if up <= 0 else min(
+                1.0, max(0.0, 1.0 - wait_ms / up))
+            obs.set_gauge("data_stream_overlap_frac", overlap,
+                          flat="stream/overlap_frac")
+            dt = time.time() - t0
+            epoch += 1
+            if self._epoch_bookkeeping(epoch, mean_loss, dt,
+                                       trained * plan.eff_batch,
+                                       validation_data, batch_size,
+                                       verbose, end_trigger):
                 break
         if self._ckpt_mgr is not None:
             self._ckpt_mgr.wait()   # join any in-flight async write

@@ -25,6 +25,7 @@ import threading
 from typing import Any, Callable, Iterable, Iterator, Optional
 
 from analytics_zoo_tpu.core.profiling import timeit
+from analytics_zoo_tpu.observe import metrics as obs
 from analytics_zoo_tpu.robust import faults
 
 logger = logging.getLogger("analytics_zoo_tpu.train")
@@ -55,11 +56,24 @@ class PrefetchIterator:
 
         def put_retry(obj) -> bool:
             """Deliver unless the consumer called close(); never drop."""
+            stalled = False
             while not self._stop.is_set():
                 try:
                     self._q.put(obj, timeout=0.1)
+                    # qsize() is advisory under concurrency, which is
+                    # fine for a gauge; the flat mirror keeps legacy
+                    # health() readers working
+                    obs.set_gauge("prefetch_queue_depth", self._q.qsize(),
+                                  flat="prefetch/queue_depth")
                     return True
                 except queue.Full:
+                    if not stalled:
+                        # count once per item: the producer outran the
+                        # consumer by a full queue — the inverse signal
+                        # of prefetch/consumer_wait
+                        stalled = True
+                        obs.count("prefetch_producer_stalls_total",
+                                  flat="prefetch/producer_stalls")
                     continue
             return False
 
@@ -99,6 +113,8 @@ class PrefetchIterator:
         # of hanging the training loop
         with timeit("prefetch/consumer_wait"):
             item = self._get()
+        obs.set_gauge("prefetch_queue_depth", self._q.qsize(),
+                      flat="prefetch/queue_depth")
         if item is _SENTINEL:
             self._thread.join()
             err = self._error()

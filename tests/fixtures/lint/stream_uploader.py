@@ -1,0 +1,69 @@
+# zoolint: hot-path
+"""zoolint fixture: the STREAM shard-uploader idiom
+(data/streaming.ShardUploader + train/estimator._fit_stream).  The
+naive port commits both classic mistakes: the uploader thread's stats
+are written with no lock (THR-SHARED-MUT — the training thread reads
+them for the overlap gauge), and the consumer loop blocks on every
+shard's upload from the HOT training thread (JG-TRANSFER-HOT).  The
+shipped idiom — lock-guarded stats, the slot-recycle wait paid on the
+uploader's OWN thread, one sync per epoch — stays quiet."""
+
+import threading
+
+import jax
+
+
+class NaiveUploader:
+    """Unlocked cross-thread stats + the recycle wait on the consumer."""
+
+    def __init__(self):
+        self._upload_ms = 0.0
+        self._thread = threading.Thread(target=self._run)
+
+    def _run(self):
+        self._upload_ms = self._upload_ms + 1.0   # THR-SHARED-MUT
+        # fires: uploader-thread write, read by stats() below
+
+    def stats(self):
+        return self._upload_ms
+
+
+def naive_rotation(shards, dispatch):
+    up = NaiveUploader()
+    for dev in shards:
+        out = dispatch(dev)
+        out.block_until_ready()        # JG-TRANSFER-HOT fires: the
+        # training loop stalls on every shard instead of handing the
+        # sync to the uploader thread
+    return up.stats()
+
+
+class LockedUploader:
+    """The shipped protocol: stats under a lock on both sides, and the
+    slot-recycle ``block_until_ready`` runs on the uploader thread —
+    overlapping the main thread's next dispatch, not blocking it."""
+
+    def __init__(self):
+        self._stats_lock = threading.Lock()
+        self._upload_ms = 0.0
+        self._pending = None
+        self._thread = threading.Thread(target=self._run)
+
+    def _run(self):
+        if self._pending is not None:
+            jax.block_until_ready(self._pending)   # quiet: uploader
+            # thread pays the wait, not the hot training loop
+        with self._stats_lock:
+            self._upload_ms = self._upload_ms + 1.0   # quiet: locked
+
+    def stats(self):
+        with self._stats_lock:
+            return self._upload_ms
+
+
+def rotation_ok(shards, dispatch):
+    up = LockedUploader()
+    out = None
+    for dev in shards:
+        out = dispatch(dev)            # quiet: carry stays on device
+    return jax.device_get(out), up.stats()   # quiet: ONE epoch sync

@@ -1,0 +1,446 @@
+"""STREAM cache tier: budget router, shard-rotation training parity,
+quantized device cache, and uploader chaos/resume.
+
+Acceptance anchors (ISSUE 10):
+
+- stream vs resident loss parity on a multi-shard dataset (bit-exact
+  losses with shuffle=False; params at the repo's rtol 1e-6 cross-
+  program-fusion bar);
+- the budget router's three-way matrix (replicated / stream / host);
+- quantized-decode parity within tolerance;
+- the whole scan path runs under ``jax.transfer_guard("disallow")``
+  with ZERO per-batch host→device puts;
+- an uploader crash mid-rotation falls back to the host path without
+  losing the epoch, and a preempted stream fit resumes at the exact
+  shard cursor.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from analytics_zoo_tpu.core.profiling import TIMERS
+
+
+@pytest.fixture(autouse=True)
+def _fresh_names():
+    from analytics_zoo_tpu.nn import reset_name_scope
+
+    reset_name_scope()
+
+
+# ---------------------------------------------------------------------------
+# npy headers + SlicedFeatureSet row reads (satellite: nbytes without
+# materialization)
+# ---------------------------------------------------------------------------
+
+
+def test_npy_header_reads_shape_without_loading(tmp_path):
+    from analytics_zoo_tpu.data.featureset import npy_header
+
+    a = np.arange(60, dtype=np.float64).reshape(15, 4)
+    p = tmp_path / "a.npy"
+    np.save(p, a)
+    shape, dtype = npy_header(str(p))
+    assert shape == (15, 4)
+    assert dtype == np.float64
+
+
+def test_sliced_featureset_nbytes_from_headers(tmp_path):
+    from analytics_zoo_tpu.data.featureset import SlicedFeatureSet
+
+    paths = []
+    total = 0
+    for k in range(3):
+        x = np.random.RandomState(k).randn(20, 4).astype(np.float32)
+        y = np.zeros(20, np.float32)
+        xp, yp = tmp_path / f"x{k}.npy", tmp_path / f"y{k}.npy"
+        np.save(xp, x)
+        np.save(yp, y)
+        total += x.nbytes + y.nbytes
+        paths.append((str(xp), str(yp)))
+    fs = SlicedFeatureSet(paths)
+    assert fs.nbytes == total
+    assert len(fs) == 60
+
+
+def test_sliced_featureset_read_rows_crosses_slices(tmp_path):
+    from analytics_zoo_tpu.data.featureset import SlicedFeatureSet
+
+    xs, ys, paths = [], [], []
+    for k in range(3):
+        x = np.random.RandomState(10 + k).randn(20, 4).astype(np.float32)
+        y = np.arange(20, dtype=np.float32) + 100 * k
+        xp, yp = tmp_path / f"x{k}.npy", tmp_path / f"y{k}.npy"
+        np.save(xp, x)
+        np.save(yp, y)
+        xs.append(x)
+        ys.append(y)
+        paths.append((str(xp), str(yp)))
+    fs = SlicedFeatureSet(paths)
+    full_x, full_y = np.concatenate(xs), np.concatenate(ys)
+    # spans: inside one slice, straddling a boundary, the whole set
+    for lo, hi in ((3, 9), (15, 27), (38, 55), (0, 60)):
+        got_x, got_y = fs.read_rows(lo, hi)
+        np.testing.assert_array_equal(got_x, full_x[lo:hi])
+        np.testing.assert_array_equal(got_y, full_y[lo:hi])
+    with pytest.raises(ValueError):
+        fs.read_rows(50, 70)
+
+
+# ---------------------------------------------------------------------------
+# plan geometry + quantization primitives
+# ---------------------------------------------------------------------------
+
+
+def _float_fs(n=256, seed=0, level="STREAM"):
+    from analytics_zoo_tpu.data import FeatureSet
+
+    rs = np.random.RandomState(seed)
+    x = rs.randn(n, 12).astype(np.float32)
+    w = rs.randn(12).astype(np.float32)
+    y = (x @ w > 0).astype(np.int32)
+    return FeatureSet.from_ndarrays([x], y, cache_level=level)
+
+
+def test_plan_stream_geometry(zoo_ctx):
+    from analytics_zoo_tpu.data.streaming import plan_stream
+
+    fs = _float_fs()
+    nbytes = fs.nbytes
+    plan, why = plan_stream(fs, nbytes // 2, eff_batch=32)
+    assert plan is not None, why
+    assert plan.n_shards >= 2
+    assert plan.shard_rows % 32 == 0
+    assert plan.steps_per_shard == plan.shard_rows // 32
+    # geometry respects the budget: `slots` live shards fit it
+    assert plan.device_shard_bytes * plan.slots <= nbytes // 2 \
+        + plan.slots * 52    # rounding slack: one row per slot
+    # quantized rows shrink the device footprint → fewer shards
+    qplan, why = plan_stream(fs, nbytes // 2, eff_batch=32,
+                             cache_dtype="uint8")
+    assert qplan is not None, why
+    assert qplan.n_shards < plan.n_shards
+    assert qplan.quantized == (True, False)
+    assert qplan.decode_bytes_per_shard == \
+        qplan.steps_per_shard * qplan.eff_batch * 12
+    # infeasibility reasons, not errors
+    assert plan_stream(fs, 64, eff_batch=32)[0] is None
+    with pytest.raises(ValueError):
+        plan_stream(fs, nbytes, eff_batch=32, cache_dtype="float16")
+
+
+def test_epoch_order_deterministic(zoo_ctx):
+    from analytics_zoo_tpu.data.streaming import plan_stream
+
+    fs = _float_fs()
+    plan, _ = plan_stream(fs, fs.nbytes // 4, eff_batch=32)
+    assert plan is not None and plan.n_shards >= 3
+    a = plan.epoch_order(seed=7, epoch=2, shuffle=True)
+    b = plan.epoch_order(seed=7, epoch=2, shuffle=True)
+    np.testing.assert_array_equal(a, b)     # resume re-derives this
+    assert sorted(a.tolist()) == list(range(plan.n_shards))
+    c = plan.epoch_order(seed=7, epoch=3, shuffle=True)
+    assert not np.array_equal(a, c) or plan.n_shards < 3
+    np.testing.assert_array_equal(
+        plan.epoch_order(seed=7, epoch=2, shuffle=False),
+        np.arange(plan.n_shards))
+
+
+def test_quantize_roundtrip():
+    from analytics_zoo_tpu.ops.quantization import (dequantize_features,
+                                                    quantize_feature_array)
+
+    rs = np.random.RandomState(3)
+    a = (rs.randn(64, 8) * 4).astype(np.float32)
+    for dtype in ("uint8", "int8"):
+        q, scale, zero = quantize_feature_array(a, dtype)
+        assert q.dtype == np.dtype(dtype)
+        back = np.asarray(dequantize_features(q, scale, zero))
+        # 8-bit affine: max error is half a quantization step
+        step = float(scale)
+        assert np.max(np.abs(back - a)) <= step / 2 + 1e-6
+    with pytest.raises(TypeError):
+        quantize_feature_array(np.arange(4, dtype=np.int32), "uint8")
+
+
+# ---------------------------------------------------------------------------
+# budget router matrix (replicated < budget < stream < host fallback)
+# ---------------------------------------------------------------------------
+
+
+def test_budget_router_matrix(zoo_ctx):
+    from analytics_zoo_tpu import init_zoo_context
+    from analytics_zoo_tpu.data import FeatureSet
+    from analytics_zoo_tpu.models import NeuralCF
+    from analytics_zoo_tpu.nn import reset_name_scope
+    from analytics_zoo_tpu.train.optimizers import Adam
+
+    def router(level, budget):
+        init_zoo_context(seed=0)
+        reset_name_scope()
+        rs = np.random.RandomState(1)
+        n = 256
+        u = rs.randint(1, 51, (n, 1)).astype(np.int32)
+        i = rs.randint(1, 41, (n, 1)).astype(np.int32)
+        y = rs.randint(0, 2, n).astype(np.int32)
+        ncf = NeuralCF(user_count=50, item_count=40, class_num=2,
+                       user_embed=8, item_embed=8, mf_embed=8,
+                       hidden_layers=(16, 8))
+        ncf.compile(optimizer=Adam(lr=1e-2),
+                    loss="sparse_categorical_crossentropy")
+        est = ncf.estimator
+        est.ctx.config.data_device_budget_bytes = budget
+        fs = FeatureSet.from_ndarrays([u, i], y, cache_level=level)
+        return est._resolve_data_path(fs, batch_size=32)
+
+    nbytes = 256 * (4 + 4 + 4)
+    # fits the budget → replicated residency, even for a STREAM request
+    path, reason = router("STREAM", 10 ** 9)
+    assert path == "device_resident" and "fits" in reason
+    # over budget with a feasible rotation → stream
+    path, reason = router("DEVICE", nbytes // 2)
+    assert path == "stream" and "shards" in reason
+    # over budget AND a slot can't hold one batch → host fallback, with
+    # the over-budget reason preserved
+    path, reason = router("DEVICE", 64)
+    assert path == "host_prefetch"
+    assert "over device budget" in reason and "infeasible" in reason
+    # HOST pin short-circuits everything
+    path, reason = router("HOST", 10 ** 9)
+    assert path == "host_prefetch" and "HOST" in reason
+
+
+# ---------------------------------------------------------------------------
+# training parity through the rotation (transfer-guarded scan path)
+# ---------------------------------------------------------------------------
+
+def _train_mlp(level, budget, epochs=2, shuffle=False, cache_dtype=None,
+               seed=7):
+    from analytics_zoo_tpu import init_zoo_context
+    from analytics_zoo_tpu.nn import reset_name_scope
+    from analytics_zoo_tpu.nn.layers.core import Dense
+    from analytics_zoo_tpu.nn.topology import Sequential
+    from analytics_zoo_tpu.train.optimizers import Adam
+
+    init_zoo_context(seed=seed)
+    reset_name_scope()
+    m = Sequential()
+    m.add(Dense(16, activation="relu", input_shape=(12,)))
+    m.add(Dense(2, activation="softmax"))
+    m.compile(optimizer=Adam(lr=1e-2),
+              loss="sparse_categorical_crossentropy")
+    est = m.estimator
+    est.ctx.config.data_device_budget_bytes = budget
+    est.ctx.config.data_cache_dtype = cache_dtype
+    fs = _float_fs(level=level)
+    TIMERS.reset()
+    h = est.fit(fs, batch_size=32, epochs=epochs, verbose=False,
+                shuffle=shuffle)
+    return est, [r["loss"] for r in h]
+
+
+@pytest.mark.transfer_guard
+def test_stream_parity_with_resident(zoo_ctx):
+    """A ≥2-shard rotation must train exactly like whole-dataset
+    residency: shuffle=False gives both paths the same contiguous row
+    order, and the loss accumulator rides the shard carry in the same
+    device-side add order as the resident single-dispatch epoch —
+    losses and params at the repo's rtol 1e-6 cross-program-fusion
+    parity bar.  The whole scan path runs under
+    ``jax.transfer_guard("disallow")`` (marker) and moves ZERO
+    per-batch bytes through the host upload helper."""
+    fs_bytes = _float_fs().nbytes
+    est_s, losses_s = _train_mlp("STREAM", fs_bytes // 2)
+    assert est_s.last_data_path == "stream"
+    assert TIMERS.count("estimator/host_device_put") == 0
+    assert TIMERS.count("estimator/data_path_stream") == 1
+    params_s = jax.device_get(est_s.params)
+
+    est_r, losses_r = _train_mlp("DEVICE", 10 ** 9)
+    assert est_r.last_data_path == "device_resident"
+    params_r = jax.device_get(est_r.params)
+
+    np.testing.assert_allclose(losses_s, losses_r, rtol=1e-6,
+                               err_msg="stream epoch losses diverged")
+    for a, b in zip(jax.tree_util.tree_leaves(params_s),
+                    jax.tree_util.tree_leaves(params_r)):
+        np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-6)
+
+    # overlap counter-proof was published and is a fraction
+    from analytics_zoo_tpu.observe import metrics as obs
+
+    snap = obs.METRICS.snapshot()
+    overlap = snap.gauges.get(("data_stream_overlap_frac", ()))
+    assert overlap is not None and 0.0 <= overlap <= 1.0
+
+
+def test_stream_two_level_shuffle_trains(zoo_ctx):
+    """shuffle=True exercises both shuffle levels (epoch shard order +
+    in-shard device permutation); the run must still converge on the
+    separable toy problem."""
+    fs_bytes = _float_fs().nbytes
+    est, losses = _train_mlp("STREAM", fs_bytes // 2, epochs=4,
+                             shuffle=True)
+    assert est.last_data_path == "stream"
+    assert losses[-1] < losses[0]
+
+
+def test_stream_quantized_decode_parity(zoo_ctx):
+    """uint8 device cache: in-kernel decode after the gather trains
+    within quantization tolerance of the exact run, and the decode
+    byte counter ticks with the dtype label."""
+    from analytics_zoo_tpu.observe import metrics as obs
+
+    fs_bytes = _float_fs().nbytes
+    mark = obs.METRICS.snapshot()
+    est_q, losses_q = _train_mlp("STREAM", fs_bytes // 2,
+                                 cache_dtype="uint8")
+    assert est_q.last_data_path == "stream"
+    est_e, losses_e = _train_mlp("STREAM", fs_bytes // 2)
+    np.testing.assert_allclose(losses_q, losses_e, atol=5e-3)
+
+    snap = obs.METRICS.snapshot()
+    key = ("data_decode_bytes_total", (("dtype", "uint8"),))
+    before = mark.counters.get(key, 0)
+    assert snap.counters.get(key, 0) > before
+
+
+def test_stream_from_sliced_featureset(zoo_ctx, tmp_path):
+    """A beyond-memory SlicedFeatureSet pinned to STREAM rotates
+    straight from its .npy slices (read_rows) — the tier the DEVICE
+    level refuses."""
+    from analytics_zoo_tpu import init_zoo_context
+    from analytics_zoo_tpu.data.featureset import SlicedFeatureSet
+    from analytics_zoo_tpu.nn import reset_name_scope
+    from analytics_zoo_tpu.nn.layers.core import Dense
+    from analytics_zoo_tpu.nn.topology import Sequential
+    from analytics_zoo_tpu.train.optimizers import Adam
+
+    rs = np.random.RandomState(0)
+    w = rs.randn(12).astype(np.float32)
+    paths = []
+    for k in range(4):
+        x = rs.randn(64, 12).astype(np.float32)
+        y = (x @ w > 0).astype(np.int32)
+        xp, yp = tmp_path / f"x{k}.npy", tmp_path / f"y{k}.npy"
+        np.save(xp, x)
+        np.save(yp, y)
+        paths.append((str(xp), str(yp)))
+
+    init_zoo_context(seed=7)
+    reset_name_scope()
+    m = Sequential()
+    m.add(Dense(16, activation="relu", input_shape=(12,)))
+    m.add(Dense(2, activation="softmax"))
+    m.compile(optimizer=Adam(lr=1e-2),
+              loss="sparse_categorical_crossentropy")
+    fs = SlicedFeatureSet(paths).cache("STREAM")
+    est = m.estimator
+    est.ctx.config.data_device_budget_bytes = fs.nbytes // 2
+    h = est.fit(fs, batch_size=32, epochs=2, verbose=False, shuffle=False)
+    assert est.last_data_path == "stream"
+    assert "sliced" in est.last_data_path_reason
+    assert len(h) == 2 and all(np.isfinite(r["loss"]) for r in h)
+
+
+# ---------------------------------------------------------------------------
+# chaos: uploader crash / torn shard / preempt-resume (CI multiprocess job)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_stream_uploader_crash_falls_back_without_losing_epoch(zoo_ctx):
+    """A planned uploader crash mid-rotation (``data.shard_upload``)
+    must finish the epoch through the host path — same losses as the
+    undisturbed run (shuffle=False), fallback counter bumped — and the
+    NEXT epoch streams again (self-healing)."""
+    from analytics_zoo_tpu.observe import metrics as obs
+    from analytics_zoo_tpu.robust import FaultInjector
+
+    fs_bytes = _float_fs().nbytes
+    _, losses_ref = _train_mlp("STREAM", fs_bytes // 2)
+
+    mark = obs.METRICS.snapshot()
+    fi = FaultInjector().plan("data.shard_upload", at=1,
+                              exc=RuntimeError("hbm gone"))
+    with fi:
+        est, losses = _train_mlp("STREAM", fs_bytes // 2)
+    assert fi.fired["data.shard_upload"] == 1
+    assert est.last_data_path == "stream"
+    np.testing.assert_allclose(losses, losses_ref, rtol=1e-6,
+                               err_msg="fallback epoch diverged")
+    key = ("data_stream_fallbacks_total", (("reason", "upload_error"),))
+    assert obs.METRICS.snapshot().counters.get(key, 0) \
+        > mark.counters.get(key, 0)
+
+
+@pytest.mark.slow
+def test_stream_torn_shard_is_caught_and_survived(zoo_ctx):
+    """A torn staged read (``data.shard_torn`` truncation) must be
+    caught by the plan's shape validation — not silently trained on —
+    and the epoch completes with reference losses."""
+    from analytics_zoo_tpu.robust import FaultInjector
+
+    fs_bytes = _float_fs().nbytes
+    _, losses_ref = _train_mlp("STREAM", fs_bytes // 2)
+
+    fi = FaultInjector().plan("data.shard_torn", at=2, action="torn")
+    with fi:
+        est, losses = _train_mlp("STREAM", fs_bytes // 2)
+    assert fi.fired["data.shard_torn"] == 1
+    np.testing.assert_allclose(losses, losses_ref, rtol=1e-6)
+
+
+@pytest.mark.slow
+def test_stream_preempt_resume_restores_shard_cursor(zoo_ctx, tmp_path):
+    """Preemption mid-rotation writes a manifest whose in-epoch step
+    encodes the shard cursor; resume re-derives the epoch's shard order
+    from (seed, epoch) and restarts at that exact shard — the resumed
+    trajectory matches the uninterrupted run bit-exactly."""
+    from analytics_zoo_tpu import init_zoo_context
+    from analytics_zoo_tpu.nn import reset_name_scope
+    from analytics_zoo_tpu.nn.layers.core import Dense
+    from analytics_zoo_tpu.nn.topology import Sequential
+    from analytics_zoo_tpu.robust import FaultInjector, TrainingPreempted
+    from analytics_zoo_tpu.train.optimizers import Adam
+
+    def build(budget):
+        init_zoo_context(seed=7)
+        reset_name_scope()
+        m = Sequential()
+        m.add(Dense(16, activation="relu", input_shape=(12,)))
+        m.add(Dense(2, activation="softmax"))
+        m.compile(optimizer=Adam(lr=1e-2),
+                  loss="sparse_categorical_crossentropy")
+        m.estimator.ctx.config.data_device_budget_bytes = budget
+        return m.estimator
+
+    fs = _float_fs()
+    budget = fs.nbytes // 2
+
+    ref = build(budget)
+    ref.fit(fs, batch_size=32, epochs=3, verbose=False, shuffle=True)
+    assert ref.last_data_path == "stream"
+
+    est = build(budget)
+    est.set_checkpoint(str(tmp_path))
+    # the stream path consults the preempt site once per shard; firing
+    # at call 5 lands mid-epoch-2 with a non-zero shard cursor
+    with FaultInjector().plan("estimator.preempt", at=5):
+        with pytest.raises(TrainingPreempted):
+            est.fit(fs, batch_size=32, epochs=3, verbose=False,
+                    shuffle=True)
+
+    est2 = build(budget)
+    est2.set_checkpoint(str(tmp_path))
+    est2.fit(fs, batch_size=32, epochs=3, verbose=False, shuffle=True,
+             resume=True)
+    assert est2.finished_epochs == 3
+    for a, b in zip(jax.tree_util.tree_leaves(jax.device_get(ref.params)),
+                    jax.tree_util.tree_leaves(
+                        jax.device_get(est2.params))):
+        np.testing.assert_array_equal(a, b,
+                                      err_msg="resume diverged from the "
+                                              "uninterrupted trajectory")

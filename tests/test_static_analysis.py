@@ -116,6 +116,23 @@ def test_shm_ring_fixture():
     assert len(fs) == 1
 
 
+def test_stream_uploader_fixture():
+    """The STREAM shard-uploader idiom (data/streaming.ShardUploader):
+    unlocked cross-thread upload stats fire THR-SHARED-MUT, and a
+    training loop that blocks on every shard's upload fires
+    JG-TRANSFER-HOT; the shipped protocol — lock-guarded stats, the
+    slot-recycle wait paid on the uploader's own thread, one sync per
+    epoch — stays quiet, so the streaming tier keeps a clean lint bill
+    by construction."""
+    fs = fixture_findings("stream_uploader.py")
+    assert scopes_of(fs, "THR-SHARED-MUT") == {"NaiveUploader._run"}
+    assert scopes_of(fs, "JG-TRANSFER-HOT") == {"naive_rotation"}
+    quiet = {"LockedUploader._run", "LockedUploader.stats",
+             "rotation_ok"}
+    assert not quiet & {f.scope for f in fs}
+    assert len(fs) == 2
+
+
 def test_observe_instrumentation_fixture():
     """Span/metric instrumentation idioms: the naive retrofit fires
     (unlocked ring read, per-step host sync for a metric sample); the
