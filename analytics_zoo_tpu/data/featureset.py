@@ -188,12 +188,19 @@ class FeatureSet:
         size too.  The upload is timed under
         ``featureset/device_cache_put`` so the one-off transfer cost is
         visible next to the per-step timings it eliminates.
+
+        Multi-controller: the upload goes through ``device_put_global``,
+        whose per-device callback carves out ONLY the row spans this
+        process's devices own under ``dataset_sharding`` — each host
+        transfers its share of the dataset into its local HBM, and the
+        assembled global jax.Array spans the mesh.
         """
         import jax
 
         from analytics_zoo_tpu.core.context import get_zoo_context
         from analytics_zoo_tpu.core.profiling import timeit
-        from analytics_zoo_tpu.parallel.sharding import dataset_sharding
+        from analytics_zoo_tpu.parallel.sharding import (
+            dataset_sharding, device_put_global)
 
         ctx = ctx or get_zoo_context()
         arrays = self.arrays
@@ -206,9 +213,9 @@ class FeatureSet:
             arrays = list(batch)
         n = len(arrays[0])
         with timeit("featureset/device_cache_put"):
-            out = [jax.device_put(
-                a, dataset_sharding(ctx.mesh, n, np.ndim(a),
-                                    axis=ctx.data_axis))
+            out = [device_put_global(
+                np.asarray(a), dataset_sharding(ctx.mesh, n, np.ndim(a),
+                                                axis=ctx.data_axis))
                 for a in arrays]
             jax.block_until_ready(out)
         return out

@@ -35,6 +35,20 @@ returns the slot with a sync handle — before re-using that HBM slot
 for shard N+2 the uploader blocks on shard N's output, ON ITS OWN
 THREAD, so the wait itself overlaps the main thread's dispatch of
 shard N+1.
+
+Multi-controller (``jax.process_count() > 1``): each process stages
+ONLY the shard rows its local devices own under ``dataset_sharding``
+(:class:`ProcessRowView`), assembles the global jax.Array with
+``jax.make_array_from_single_device_arrays``, and rendezvouses with
+its peers at a per-shard ``dist_barrier`` deadline — a host that dies
+or straggles past the deadline surfaces as a typed
+``robust.HostLostError`` on every survivor (fault sites
+``data.host_lost`` / ``data.shard_skew``).  Both shuffle levels are
+pure functions of ``(seed, epoch[, shard_id])``
+(:func:`epoch_shard_order` / :func:`shard_permutation`), so all hosts
+agree on the full visit order with zero coordination — which is also
+what makes the shard cursor elastic: a run preempted at one process
+count re-derives the identical rotation at another.
 """
 
 from __future__ import annotations
@@ -49,10 +63,104 @@ import numpy as np
 
 from analytics_zoo_tpu.observe import metrics as obs
 from analytics_zoo_tpu.robust import faults
+from analytics_zoo_tpu.robust.errors import HostLostError
 
 logger = logging.getLogger("analytics_zoo_tpu.data")
 
 _SENTINEL = object()
+
+
+def epoch_shard_order(n_shards: int, seed: int, epoch: int,
+                      shuffle: bool = True) -> np.ndarray:
+    """Shard visit order for ``epoch`` — level 1 of the two-level
+    shuffle.  A pure function of ``(seed, epoch)`` consuming NO carried
+    rng state and NO process identity, so every host of a
+    multi-controller run derives the identical order with zero
+    coordination, and a mid-epoch resume re-derives it from the
+    manifest's epoch number alone."""
+    if not shuffle or n_shards == 1:
+        return np.arange(n_shards)
+    rs = np.random.RandomState(
+        (int(seed) + 7919 * (int(epoch) + 1)) % (2 ** 31 - 1))
+    return rs.permutation(n_shards)
+
+
+def shard_permutation(n_rows: int, seed: int, epoch: int, shard_id: int,
+                      *, shuffle: bool = True,
+                      pair_structured: bool = False) -> np.ndarray:
+    """Level 2 of the two-level shuffle: the in-shard row permutation,
+    a pure function of ``(seed, epoch, shard_id)`` — same zero-
+    coordination / elastic-resume contract as
+    :func:`epoch_shard_order`.  Mirrors the resident tier's
+    ``pair_structured`` layout (adjacent (even, odd) row pairs move
+    together, e.g. TextMatcher's (query, candidate) pairs)."""
+    if not shuffle:
+        return np.arange(n_rows, dtype=np.int32)
+    rs = np.random.RandomState(
+        (int(seed) + 7919 * (int(epoch) + 1)
+         + 104729 * (int(shard_id) + 1)) % (2 ** 31 - 1))
+    if pair_structured:
+        pairs = rs.permutation(n_rows // 2)
+        idx = np.stack([pairs * 2, pairs * 2 + 1], axis=1).reshape(-1)
+        if n_rows % 2:
+            idx = np.concatenate([idx, np.array([n_rows - 1])])
+        return idx.astype(np.int32)
+    return rs.permutation(n_rows).astype(np.int32)
+
+
+class ProcessRowView:
+    """The shard-local row spans one process's devices own under
+    ``dataset_sharding`` — the multi-controller staging contract.
+
+    Built once per fit from the mesh (every shard shares the same
+    static ``shard_rows`` geometry, so one view serves all shards).
+    ``load_shard`` reads only these spans from the host dataset;
+    ``put_shard`` cuts per-device chunks back out of the staged
+    concatenation via :meth:`local_slice`.
+    """
+
+    def __init__(self, spans: List[Tuple[int, int]], shard_rows: int):
+        self.spans = list(spans)        # ascending unique (start, stop)
+        self.shard_rows = shard_rows
+        self.local_rows = sum(stop - start for start, stop in self.spans)
+        self._offset: Dict[Tuple[int, int], int] = {}
+        off = 0
+        for start, stop in self.spans:
+            self._offset[(start, stop)] = off
+            off += stop - start
+
+    @property
+    def full(self) -> bool:
+        """True when this process stages every row (replicated
+        sharding, or a single-process 'mesh')."""
+        return self.spans == [(0, self.shard_rows)]
+
+    def local_slice(self, start: int, stop: int) -> slice:
+        """Map a device's global shard-row span to its offsets in the
+        locally staged concatenation."""
+        off = self._offset.get((start, stop))
+        if off is None:
+            raise StreamUploadError(
+                f"device span [{start}, {stop}) is not owned by this "
+                f"process (owned: {self.spans})")
+        return slice(off, off + (stop - start))
+
+    @classmethod
+    def build(cls, ctx, shard_rows: int) -> "ProcessRowView":
+        """Derive the view from the mesh's data-axis sharding of a
+        ``shard_rows``-row leading dimension (identical row partition
+        for every array rank — only dim 0 is ever sharded)."""
+        from analytics_zoo_tpu.parallel.sharding import dataset_sharding
+
+        sh = dataset_sharding(ctx.mesh, shard_rows, 1, axis=ctx.data_axis)
+        idx_map = sh.addressable_devices_indices_map((shard_rows,))
+        spans = set()
+        for idx in idx_map.values():
+            sl = idx[0] if idx else slice(None)
+            lo = 0 if sl.start is None else int(sl.start)
+            hi = shard_rows if sl.stop is None else int(sl.stop)
+            spans.add((lo, hi))
+        return cls(sorted(spans), shard_rows)
 
 
 class StreamUploadError(RuntimeError):
@@ -106,25 +214,44 @@ class StreamPlan:
     # -- epoch geometry ---------------------------------------------------
     def epoch_order(self, seed: int, epoch: int,
                     shuffle: bool) -> np.ndarray:
-        """Shard visit order for ``epoch`` — level 1 of the two-level
-        shuffle.  Deterministic in (seed, epoch), consuming NO carried
-        rng state, so a mid-epoch resume re-derives the identical order
-        from the manifest's epoch number alone."""
-        if not shuffle or self.n_shards == 1:
-            return np.arange(self.n_shards)
-        rs = np.random.RandomState(
-            (int(seed) + 7919 * (int(epoch) + 1)) % (2 ** 31 - 1))
-        return rs.permutation(self.n_shards)
+        """Shard visit order for ``epoch`` (:func:`epoch_shard_order`)."""
+        return epoch_shard_order(self.n_shards, seed, epoch, shuffle)
+
+    def shard_perm(self, seed: int, epoch: int, shard_id: int, *,
+                   shuffle: bool = True,
+                   pair_structured: bool = False) -> np.ndarray:
+        """In-shard row permutation (:func:`shard_permutation`) for this
+        plan's static ``shard_rows``."""
+        return shard_permutation(self.shard_rows, seed, epoch, shard_id,
+                                 shuffle=shuffle,
+                                 pair_structured=pair_structured)
+
+    def process_view(self, ctx) -> ProcessRowView:
+        """This process's :class:`ProcessRowView` of every shard."""
+        return ProcessRowView.build(ctx, self.shard_rows)
 
     # -- host staging -----------------------------------------------------
-    def load_shard(self, fs, shard_id: int) -> List[np.ndarray]:
+    def load_shard(self, fs, shard_id: int,
+                   view: Optional[ProcessRowView] = None
+                   ) -> List[np.ndarray]:
         """Stage shard ``shard_id``'s rows in host memory: a row-span
         read (DRAM view / mmap pages / SlicedFeatureSet cross-slice
         gather) plus the FeatureSet's transforms, applied once per
         shard (row-independent per the lazy per-batch protocol — same
-        contract as ``FeatureSet.device_arrays``)."""
+        contract as ``FeatureSet.device_arrays``).  With a ``view``,
+        only the spans this process's devices own are read — the
+        multi-controller contract: no host ever stages rows it doesn't
+        feed."""
         start = shard_id * self.shard_rows
-        arrays = fs.read_rows(start, start + self.shard_rows)
+        if view is None or view.full:
+            arrays = fs.read_rows(start, start + self.shard_rows)
+        else:
+            parts = [fs.read_rows(start + lo, start + hi)
+                     for lo, hi in view.spans]
+            arrays = [np.concatenate([np.asarray(p[j]) for p in parts],
+                                     axis=0)
+                      if len(parts) > 1 else parts[0][j]
+                      for j in range(len(parts[0]))]
         if fs.transforms:
             batch = tuple(np.asarray(a) for a in arrays)
             for fn in fs.transforms:
@@ -134,19 +261,45 @@ class StreamPlan:
             arrays = list(batch)
         return arrays
 
-    def validate_shard(self, arrays: Sequence[np.ndarray],
-                       shard_id: int) -> None:
+    def validate_shard(self, arrays: Sequence[np.ndarray], shard_id: int,
+                       view: Optional[ProcessRowView] = None) -> None:
         """Defense against torn reads: every staged array must match the
-        plan's static shard shape exactly, or the shard is unusable."""
+        plan's static shard shape (this process's row count under
+        ``view``) exactly, or the shard is unusable."""
+        rows = self.shard_rows if view is None else view.local_rows
         for j, (a, (shape, dtype)) in enumerate(zip(arrays, self.specs)):
-            want = (self.shard_rows,) + tuple(shape)
+            want = (rows,) + tuple(shape)
             if tuple(a.shape) != want or a.dtype != dtype:
                 raise StreamUploadError(
                     f"torn shard {shard_id}: array {j} is "
                     f"{a.shape}/{a.dtype}, expected {want}/{dtype}")
 
     # -- device staging ---------------------------------------------------
-    def put_shard(self, arrays: Sequence[np.ndarray], ctx) -> List[Any]:
+    def _stage_rows(self, a: np.ndarray, sharding, view):
+        """One row-sharded device array from locally staged rows.
+        Single-controller: a plain ``device_put``.  Multi-controller
+        (``view``): cut each addressable device's span out of the local
+        staging buffer and assemble the global array with
+        ``make_array_from_single_device_arrays`` — no host ever
+        materializes rows beyond its own."""
+        import jax
+
+        if view is None:
+            return jax.device_put(a, sharding)
+        global_shape = (self.shard_rows,) + tuple(np.shape(a)[1:])
+        idx_map = sharding.addressable_devices_indices_map(global_shape)
+        dbs = []
+        for dev, idx in idx_map.items():
+            sl = idx[0] if idx else slice(None)
+            lo = 0 if sl.start is None else int(sl.start)
+            hi = global_shape[0] if sl.stop is None else int(sl.stop)
+            chunk = np.ascontiguousarray(a[view.local_slice(lo, hi)])
+            dbs.append(jax.device_put(chunk, dev))
+        return jax.make_array_from_single_device_arrays(
+            global_shape, sharding, dbs)
+
+    def put_shard(self, arrays: Sequence[np.ndarray], ctx,
+                  view: Optional[ProcessRowView] = None) -> List[Any]:
         """Encode + upload one staged shard: quantized arrays travel as
         ``{"q", "scale", "zero"}`` pytrees (per-shard scalar scales),
         rows sharded over the mesh's data axis with the same
@@ -165,15 +318,34 @@ class StreamPlan:
             row_shard = dataset_sharding(ctx.mesh, self.shard_rows,
                                          np.ndim(a), axis=ctx.data_axis)
             if q:
+                if view is not None and not view.full:
+                    # per-process quantization would derive disagreeing
+                    # replicated scale/zero scalars; the router disables
+                    # the quantized cache under multi-controller
+                    raise StreamUploadError(
+                        "quantized stream cache is single-controller "
+                        "only (per-host scale/zero would disagree)")
                 qa, scale, zero = quantize_feature_array(
                     np.asarray(a), self.cache_dtype)
-                out.append({"q": jax.device_put(qa, row_shard),
-                            "scale": jax.device_put(scale, rep),
-                            "zero": jax.device_put(zero, rep)})
+                out.append({"q": self._stage_rows(qa, row_shard, view),
+                            "scale": self.put_replicated(scale, ctx),
+                            "zero": self.put_replicated(zero, ctx)})
             else:
-                out.append(jax.device_put(a, row_shard))
+                out.append(self._stage_rows(np.asarray(a), row_shard,
+                                            view))
         jax.block_until_ready(out)
         return out
+
+    def put_replicated(self, a, ctx) -> Any:
+        """A mesh-replicated device array (perm vectors, quant scales) —
+        every host holds the full value, so assembly is the
+        ``device_put_global`` callback path."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from analytics_zoo_tpu.parallel.sharding import device_put_global
+
+        return device_put_global(np.asarray(a),
+                                 NamedSharding(ctx.mesh, P()))
 
     def probe_inputs(self, fs) -> List[np.ndarray]:
         """Tiny (2-row) post-transform host arrays for the Estimator's
@@ -266,15 +438,17 @@ class ShardLease:
     training loop ever waiting on uploads it doesn't need yet.
     """
 
-    __slots__ = ("position", "shard_id", "xs", "y", "_slot", "_uploader",
-                 "_released")
+    __slots__ = ("position", "shard_id", "xs", "y", "perm", "_slot",
+                 "_uploader", "_released")
 
     def __init__(self, position: int, shard_id: int, arrays: List[Any],
-                 slot: int, uploader: "ShardUploader"):
+                 slot: int, uploader: "ShardUploader",
+                 perm: Any = None):
         self.position = position        # index into the epoch's order
         self.shard_id = shard_id        # which fixed partition
         self.xs = arrays[:-1]
         self.y = arrays[-1]
+        self.perm = perm                # replicated in-shard row perm
         self._slot = slot
         self._uploader = uploader
         self._released = False
@@ -291,16 +465,29 @@ class ShardUploader:
     daemon thread, ``slots`` shards ahead of the consumer at most.
 
     The ``PrefetchIterator`` contract carried over: producer exceptions
-    surface at the consumption point (as :class:`StreamUploadError`),
-    the sentinel is never dropped, and ``close()`` is idempotent and
-    bounded.  What's new is the slot protocol (see :class:`ShardLease`)
-    and the fault sites ``data.shard_upload`` (planned crash per shard)
-    and ``data.shard_torn`` (planned truncation caught by shape
-    validation).
+    surface at the consumption point (as :class:`StreamUploadError`;
+    a ``HostLostError`` passes through UNWRAPPED so the mesh-death
+    signal keeps its type), the sentinel is never dropped, and
+    ``close()`` is idempotent and bounded.  What's new is the slot
+    protocol (see :class:`ShardLease`) and the fault sites
+    ``data.shard_upload`` (planned crash per shard),
+    ``data.shard_torn`` (planned truncation caught by shape
+    validation), ``data.shard_skew`` (planned straggle — sleeps the
+    plan's payload seconds, or raises its exc), and ``data.host_lost``
+    (planned peer death — raises ``HostLostError``).
+
+    Multi-controller kwargs: ``view`` restricts staging to this
+    process's rows; ``perm_fn(shard_id)`` supplies the
+    (seed, epoch, shard)-pure in-shard permutation uploaded replicated
+    with the shard; ``barrier_fn(position)`` rendezvouses all hosts
+    after each staged shard, ON THIS THREAD — a dead or straggling
+    peer turns into a deadline ``HostLostError`` here, which ``get()``
+    re-raises typed on the training thread.
     """
 
     def __init__(self, fs, plan: StreamPlan, order: np.ndarray, ctx, *,
-                 start: int = 0):
+                 start: int = 0, view: Optional[ProcessRowView] = None,
+                 perm_fn=None, barrier_fn=None):
         self._plan = plan
         self._ready: "queue.Queue" = queue.Queue()
         self._free: "queue.Queue" = queue.Queue()
@@ -349,10 +536,24 @@ class ShardUploader:
                         import jax
                         jax.block_until_ready(after)
                     shard_id = int(order[pos])
+                    # chaos hooks: a planned straggler host sleeps (or
+                    # raises) here; a planned peer death raises typed
+                    faults_skew = faults.fire("data.shard_skew")
+                    if faults_skew is not None:
+                        if faults_skew.exc is not None:
+                            raise faults_skew.exc
+                        time.sleep(float(faults_skew.payload or 0.0))
+                    lost = faults.fire("data.host_lost")
+                    if lost is not None:
+                        raise (lost.exc if lost.exc is not None
+                               else HostLostError(
+                                   f"injected host loss while staging "
+                                   f"shard {shard_id}",
+                                   barrier="data.host_lost"))
                     # chaos hook: a planned uploader crash surfaces here
                     faults.inject("data.shard_upload")
                     t0 = time.perf_counter()
-                    host = plan.load_shard(fs, shard_id)
+                    host = plan.load_shard(fs, shard_id, view=view)
                     torn = faults.fire("data.shard_torn")
                     if torn is not None:
                         if torn.exc is not None:
@@ -360,8 +561,10 @@ class ShardUploader:
                         # a torn read delivers short rows; validation
                         # below catches it like the real thing
                         host = [a[:max(0, len(a) // 2)] for a in host]
-                    plan.validate_shard(host, shard_id)
-                    dev = plan.put_shard(host, ctx)
+                    plan.validate_shard(host, shard_id, view=view)
+                    dev = plan.put_shard(host, ctx, view=view)
+                    perm = (plan.put_replicated(perm_fn(shard_id), ctx)
+                            if perm_fn is not None else None)
                     del host            # release staging before waiting
                     dt_ms = (time.perf_counter() - t0) * 1e3
                     obs.observe("data_shard_upload_ms", dt_ms,
@@ -369,8 +572,14 @@ class ShardUploader:
                     with self._stats_lock:
                         self._upload_ms_total += dt_ms
                         self._uploads += 1
+                    if barrier_fn is not None:
+                        # all hosts staged this position, or a deadline
+                        # HostLostError fires — on the uploader thread,
+                        # overlapping the main thread's dispatch
+                        barrier_fn(pos)
                     if not put_retry(ShardLease(pos, shard_id, dev,
-                                                slot_id, self)):
+                                                slot_id, self,
+                                                perm=perm)):
                         return
             except BaseException as e:  # propagate to consumer
                 with self._err_lock:
@@ -395,7 +604,7 @@ class ShardUploader:
             self._thread.join()
             err = self._error()
             if err is not None:
-                if isinstance(err, StreamUploadError):
+                if isinstance(err, (StreamUploadError, HostLostError)):
                     raise err
                 raise StreamUploadError(
                     f"shard uploader failed: {err}") from err
@@ -414,6 +623,9 @@ class ShardUploader:
                     except queue.Empty:
                         err = self._error()
                         if err is not None:
+                            if isinstance(err, (StreamUploadError,
+                                                HostLostError)):
+                                raise err
                             raise StreamUploadError(
                                 f"shard uploader died: {err}") from err
                         raise StreamUploadError(

@@ -119,6 +119,11 @@ CATALOG: Dict[str, Tuple[str, str, Tuple[str, ...]]] = {
     "data_stream_fallbacks_total": (
         "counter", "mid-rotation uploader failures absorbed by the "
         "host path, by reason", ("reason",)),
+    "data_path_selected_total": (
+        "counter", "FeatureSet input-path router decisions, by chosen "
+        "path and bounded reason code (cache_level_host | fits_budget "
+        "| over_budget | sliced | stream_infeasible)",
+        ("path", "reason")),
     "prefetch_queue_depth": (
         "gauge", "batches queued ahead of the consumer in the prefetch "
         "pipeline", ()),

@@ -377,11 +377,17 @@ def device_put_global(x, sharding):
     chunks locally — no cross-host traffic, and it works for ANY target
     sharding, which is what makes restore elastic: a tree saved at one
     process count lays out onto whatever mesh is live now.
+
+    This IS the explicit staging chokepoint (the multi-controller
+    analog of a bare ``device_put``, which ``jax.transfer_guard``
+    exempts), so the callback's internal puts are locally exempted too
+    — transfer-guarded training paths stay runnable multi-controller.
     """
     if jax.process_count() > 1:
         a = np.asarray(x)
-        return jax.make_array_from_callback(
-            a.shape, sharding, lambda idx: a[idx])
+        with jax.transfer_guard("allow"):
+            return jax.make_array_from_callback(
+                a.shape, sharding, lambda idx: a[idx])
     import jax.numpy as jnp
 
     return jax.device_put(jnp.asarray(x), sharding)

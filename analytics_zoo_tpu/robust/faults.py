@@ -18,6 +18,17 @@ Sites wired into the stack (call granularity in parentheses):
                             truncate the staged rows, caught by the
                             plan's shape validation exactly like a
                             real torn read)
+- ``data.shard_skew``     — one per shard the STREAM uploader stages
+                            (payload: seconds this host straggles
+                            before staging; with ``exc`` it raises
+                            instead — under multi-controller the
+                            peers' ``zoo_data_shard`` barrier turns a
+                            straggle past the deadline into
+                            ``HostLostError``)
+- ``data.host_lost``      — one per shard the STREAM uploader stages
+                            (raise → typed ``HostLostError``,
+                            simulating this host discovering a dead
+                            peer during shard staging)
 - ``estimator.step``      — one per train-step dispatch on the host
                             input paths (poison batch → NaN loss / raise)
 - ``estimator.preempt``   — one per train-step; firing simulates SIGTERM

@@ -48,7 +48,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from analytics_zoo_tpu.core.context import (ZooContext,
+from analytics_zoo_tpu.core.context import (ZooContext, dist_barrier,
                                              explicit_prng_key,
                                              get_zoo_context)
 from analytics_zoo_tpu.core.profiling import TIMERS, timeit
@@ -196,6 +196,12 @@ class Estimator:
         self._fit_span = None
         self._epoch_span = None
         self._fit_metrics_mark = None
+        # training-side flight recorder (arm_flight_recorder): checked
+        # at epoch boundaries, tripped manually on a HostLostError
+        self._flight_recorder = None
+        # monotone stream-rotation counter: makes the zoo_data_* barrier
+        # names unique across NaN-rollback replays of the same epoch
+        self._data_rotation = 0
 
     # ------------------------------------------------------------------
     # configuration
@@ -225,6 +231,31 @@ class Estimator:
         from analytics_zoo_tpu.core.summary import SummaryWriter
         self._tb_writer = SummaryWriter(log_dir)
         return self
+
+    def arm_flight_recorder(self, *, window_s: float = 5.0,
+                            out_dir: Optional[str] = None,
+                            watch: Optional[Sequence] = None,
+                            **kw):
+        """Arm a training-side flight recorder (docs/OBSERVABILITY.md):
+        windows are evaluated at epoch boundaries, watching the data
+        tier's failure counters — a ``zoo_data_*`` barrier breach
+        (``dist_barrier_timeouts_total``) or a stream-path downgrade
+        (``data_stream_fallbacks_total``) trips a snapshot of the span
+        ring + metric window.  A fatal ``HostLostError`` during fit()
+        also trips it manually, so the mesh-death post-mortem keeps its
+        evidence.  Extra ``watch`` pairs and FlightRecorder kwargs pass
+        through.  Returns the recorder."""
+        from analytics_zoo_tpu.observe.recorder import FlightRecorder
+
+        counters = [("dist_barrier_timeouts_total", {}),
+                    ("data_stream_fallbacks_total", {})]
+        if watch:
+            counters.extend(watch)
+        self._flight_recorder = FlightRecorder(
+            watch_counters=counters, window_s=window_s, out_dir=out_dir,
+            **kw)
+        self._flight_recorder.check()       # open the first window
+        return self._flight_recorder
 
     # ------------------------------------------------------------------
     # initialization & compiled steps
@@ -628,6 +659,44 @@ class Estimator:
         return (self.ctx.local_device_count if self.ctx.process_count > 1
                 else self.ctx.num_devices)
 
+    def _global_eff_batch(self, batch_size: int) -> int:
+        """The GLOBAL effective batch the resident/stream programs
+        dispatch: ``batch_size`` rounded up to the per-process divisor,
+        times the process count — ``batch_size`` follows the host
+        path's convention of counting PROCESS-LOCAL rows under
+        multi-controller, so a worker passing
+        ``global_batch // process_count`` yields the same global
+        geometry (and therefore the same stream plan / shard cursor) at
+        every topology.  That invariance is what makes preempt-resume
+        elastic across process counts."""
+        d = self._data_div
+        eff = int(math.ceil(max(batch_size, d) / d)) * d
+        if self.ctx.process_count > 1:
+            eff *= self.ctx.process_count
+        return eff
+
+    def _commit_carry(self, tree):
+        """Commit the training carry (params/state/opt/rng)
+        mesh-replicated before the first resident/stream dispatch —
+        compile stability (see the call sites) AND, under
+        multi-controller, the host-local leaves must become
+        process-spanning global arrays or the jitted shard program
+        would see mixed layouts.  Leaves already laid out on the
+        global mesh (a reshard-on-restore) pass through untouched."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        rep = NamedSharding(self.ctx.mesh, P())
+        if self.ctx.process_count == 1:
+            return jax.device_put(tree, rep)
+        from analytics_zoo_tpu.parallel.sharding import device_put_global
+
+        def put(x):
+            if isinstance(x, jax.Array) and not x.is_fully_addressable:
+                return x
+            return device_put_global(x, rep)
+
+        return jax.tree_util.tree_map(put, tree)
+
     def _shard_chunk(self, arrs: List[np.ndarray]):
         from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -825,7 +894,6 @@ class Estimator:
                 path, reason = self._resolve_data_path(x, batch_size)
                 self.last_data_path, self.last_data_path_reason = \
                     path, reason
-                TIMERS.incr(f"estimator/data_path_{path}")
                 if path == "device_resident":
                     out = self._fit_device_resident(
                         x, batch_size, epochs, validation_data,
@@ -848,6 +916,14 @@ class Estimator:
             if self._epoch_span is not None:
                 self._epoch_span.end(status="error", error=str(e))
                 self._epoch_span = None
+            if (self._flight_recorder is not None
+                    and isinstance(e, HostLostError)):
+                # a mesh-death is exactly the moment operators need the
+                # span ring + metric window preserved — trip manually,
+                # the periodic check never runs again in this process
+                self._flight_recorder.trigger(
+                    "host_lost", {"barrier": e.barrier,
+                                  "timeout_s": e.timeout_s})
             self._fit_span.end(status=type(e).__name__, error=str(e))
             raise
         finally:
@@ -1318,46 +1394,68 @@ class Estimator:
         pinned cache level (else the ``data_cache_level`` config
         default) and ``data_device_budget_bytes``:
 
-        - fits the budget           → device_resident (replicated HBM)
+        - fits the budget           → device_resident (per-host HBM
+                                      residency of the rows each
+                                      process's devices own)
         - over budget / sliced      → stream (double-buffered shard
                                       rotation), when a feasible
                                       :func:`~analytics_zoo_tpu.data.streaming.plan_stream`
                                       geometry exists
         - stream infeasible / HOST  → host prefetch
 
-        Every downgrade is automatic and logged, never an error."""
+        Multi-controller runs route through the SAME matrix — each
+        process materializes or streams only its own rows
+        (docs/DATA.md "Multi-controller") — except that the quantized
+        stream cache is disabled (per-host scale/zero scalars would
+        disagree).
+
+        Every downgrade is automatic and logged, never an error; every
+        decision is counted in
+        ``data_path_selected_total{path,reason}`` with a bounded
+        reason-code vocabulary so production downgrades alert instead
+        of hiding in logs."""
         from analytics_zoo_tpu.data import streaming as stream_lib
         from analytics_zoo_tpu.data.featureset import (CacheLevel,
                                                        SlicedFeatureSet)
+
+        def pick(path: str, code: str, reason: str) -> Tuple[str, str]:
+            obs.count("data_path_selected_total", path=path, reason=code,
+                      flat=f"estimator/data_path_{path}")
+            return path, reason
 
         cfg = self.ctx.config
         self._stream_plan = None
         level = fs.cache_level or CacheLevel.normalize(cfg.data_cache_level)
         if level == CacheLevel.HOST:
-            return "host_prefetch", "cache level HOST"
-        if self.ctx.process_count > 1:
-            # make_array_from_process_local_data would need host rows per
-            # step — device residency (replicated or rotating) buys
-            # nothing under multi-controller yet
-            return "host_prefetch", "multi-controller process"
+            return pick("host_prefetch", "cache_level_host",
+                        "cache level HOST")
         budget = int(cfg.data_device_budget_bytes)
         sliced = isinstance(fs, SlicedFeatureSet)
         if not sliced and fs.nbytes <= budget:
             # whole-dataset residency beats any rotation whenever it
             # fits — a STREAM request downgrades to plain DEVICE
-            return "device_resident", "fits device budget"
-        d = self._data_div
-        eff_batch = int(math.ceil(max(batch_size, d) / d)) * d
+            return pick("device_resident", "fits_budget",
+                        "fits device budget")
+        eff_batch = self._global_eff_batch(batch_size)
+        cache_dtype = cfg.data_cache_dtype
+        if cache_dtype is not None and self.ctx.process_count > 1:
+            logger.warning(
+                "quantized stream cache (%s) is single-controller only "
+                "— per-host quantization would derive disagreeing "
+                "replicated scale/zero scalars; streaming uncompressed",
+                cache_dtype)
+            cache_dtype = None
         plan, why = stream_lib.plan_stream(
             fs, budget, eff_batch, slots=cfg.data_stream_slots,
-            cache_dtype=cfg.data_cache_dtype)
+            cache_dtype=cache_dtype)
         over = ("sliced (beyond-memory) featureset" if sliced else
                 f"dataset {fs.nbytes}B over device budget {budget}B")
         if plan is None:
             logger.warning(
                 "%s and streaming is infeasible (%s); falling back to "
                 "the host prefetch path", over, why)
-            return "host_prefetch", f"{over}; stream infeasible: {why}"
+            return pick("host_prefetch", "stream_infeasible",
+                        f"{over}; stream infeasible: {why}")
         logger.info(
             "STREAM tier engaged: %s; rotating %d shards of %d rows "
             "(%.1f MiB/shard in HBM, %d slots%s)", over, plan.n_shards,
@@ -1365,8 +1463,9 @@ class Estimator:
             f", {plan.cache_dtype} device cache" if plan.cache_dtype
             else "")
         self._stream_plan = plan
-        return "stream", (f"{over}; streaming {plan.n_shards} shards of "
-                          f"{plan.shard_rows} rows")
+        return pick("stream", "sliced" if sliced else "over_budget",
+                    f"{over}; streaming {plan.n_shards} shards of "
+                    f"{plan.shard_rows} rows")
 
     def _epoch_bookkeeping(self, epoch1, mean_loss, dt, count,
                            validation_data, val_batch_default, verbose,
@@ -1401,6 +1500,8 @@ class Estimator:
             logger.info("epoch %d: %s", epoch1, rec)
         if self._ckpt_mgr is not None and self._ckpt_trigger(tstate):
             self._save_checkpoint()
+        if self._flight_recorder is not None:
+            self._flight_recorder.check()
         return end_trigger is not None and end_trigger(tstate)
 
     def _fit_device_resident(self, fs, batch_size, epochs, validation_data,
@@ -1417,8 +1518,7 @@ class Estimator:
                 "device-resident training needs (inputs..., label) arrays")
         self._ensure_built(xs)
         n = int(arrays[0].shape[0])
-        d = self._data_div
-        eff_batch = int(math.ceil(max(batch_size, d) / d)) * d
+        eff_batch = self._global_eff_batch(batch_size)
         steps = n // eff_batch
         if steps == 0:
             raise ValueError(
@@ -1442,11 +1542,9 @@ class Estimator:
         # uncommitted host-placed params would compile a second, separate
         # executable for epoch 2+ (measured: epochs 1-2 each ~40x slower
         # than steady state on the CPU mesh)
-        from jax.sharding import NamedSharding, PartitionSpec as P
-        rep = NamedSharding(self.ctx.mesh, P())
         (self.params, self.state, self.opt_state, self._rng) = \
-            jax.device_put(
-                (self.params, self.state, self.opt_state, self._rng), rep)
+            self._commit_carry(
+                (self.params, self.state, self.opt_state, self._rng))
         self._guard = self._fresh_guard()
         epoch = self.finished_epochs
         while epoch < epochs:
@@ -1501,6 +1599,13 @@ class Estimator:
           ``xs[0]`` instead of starting at zero, so per-step losses
           accumulate across shards in the SAME device-side add order as
           the resident single-dispatch epoch (bit-exact parity);
+        - the in-shard permutation arrives as ``xs[1]`` — a replicated
+          int32 vector the uploader derives host-side from
+          ``(seed, epoch, shard_id)`` alone
+          (data/streaming.shard_permutation), NOT from the carried
+          device rng: every host of a multi-controller mesh gathers by
+          the identical permutation with zero coordination, and a
+          resumed shard cursor replays it exactly at any topology;
         - quantized feature leaves arrive as ``{"q", "scale", "zero"}``
           pytrees and are decoded in-kernel AFTER the minibatch gather
           (ops/quantization.dequantize_features) — only the gathered
@@ -1518,8 +1623,7 @@ class Estimator:
         single = self._single_step_fn
         mesh = self.ctx.mesh
         data_axis = self.ctx.data_axis
-        pair_structured = getattr(self.loss_fn, "batch_structured", False)
-        n, eff_batch = plan.shard_rows, plan.eff_batch
+        eff_batch = plan.eff_batch
         steps = plan.steps_per_shard
 
         def constrain(v):
@@ -1535,10 +1639,7 @@ class Estimator:
             return constrain(jnp.take(leaf, idx, axis=0))
 
         def shard(params, state, opt_state, rng, guard, xs, y):
-            acc, arrays = xs[0], xs[1:]
-            rng, prm = jax.random.split(rng)
-            perm = resident_epoch_indices(
-                prm, n, shuffle=shuffle, pair_structured=pair_structured)
+            acc, perm, arrays = xs[0], xs[1], xs[2:]
 
             def body(i, carry):
                 p, s, o, r, g, loss_sum, good = carry
@@ -1567,18 +1668,23 @@ class Estimator:
         self._stream_shard_key = key
         return self._stream_shard
 
-    def _stream_host_tail(self, fs, plan, order, from_shard, acc):
+    def _stream_host_tail(self, fs, plan, order, from_shard, acc,
+                          perm_fn=None):
         """Finish a STREAM epoch on the host path after an uploader
         failure: the remaining shards of the epoch's order train through
-        per-batch ``device_put`` dispatches (contiguous rows within each
-        shard) — degraded throughput, but the epoch completes with full
-        row coverage and the losses fold into the same device
-        accumulator.  Returns ``(acc, steps_trained)``."""
+        per-batch ``device_put`` dispatches (each shard's rows in the
+        same ``perm_fn`` order the stream program would have gathered) —
+        degraded throughput, but the epoch completes with full row
+        coverage and the losses fold into the same device accumulator.
+        Returns ``(acc, steps_trained)``."""
         steps = 0
         losses = []
         for pos in range(from_shard, plan.n_shards):
             shard_id = int(order[pos])
             arrays = plan.load_shard(fs, shard_id)
+            if perm_fn is not None:
+                perm = np.asarray(perm_fn(shard_id))
+                arrays = [np.asarray(a)[perm] for a in arrays]
             for s in range(plan.steps_per_shard):
                 sl = slice(s * plan.eff_batch, (s + 1) * plan.eff_batch)
                 bx = [np.asarray(a[sl]) for a in arrays[:-1]]
@@ -1618,25 +1724,38 @@ class Estimator:
         ``in_epoch_step`` encodes the shard cursor
         (``shards_done * steps_per_shard``); resume re-derives the
         epoch's shard order from (seed, epoch) and restarts at that
-        exact shard."""
-        from jax.sharding import NamedSharding, PartitionSpec as P
+        exact shard.
 
+        Multi-controller: each process streams only the shard rows its
+        devices own (``plan.process_view``), the rotation rendezvouses
+        at ``zoo_data_*`` deadline barriers (epoch start on this
+        thread, per staged shard on the uploader thread) so a dead or
+        straggling peer surfaces as a typed ``HostLostError`` on every
+        survivor instead of a hang, and the host-tail fallback is
+        DISABLED — one host degrading to per-batch dispatches while its
+        peers run the shard program would deadlock the mesh's
+        collectives, so an upload failure is fatal here.  The plan's
+        geometry is a pure function of (budget, global batch), so a
+        preempted shard cursor resumes at any process count."""
         from analytics_zoo_tpu.data import streaming as stream_lib
 
         cfg = self.ctx.config
         plan = self._stream_plan
         if plan is None:    # direct call without the router: re-derive
-            d = self._data_div
-            eff = int(math.ceil(max(batch_size, d) / d)) * d
             plan, why = stream_lib.plan_stream(
-                fs, int(cfg.data_device_budget_bytes), eff,
+                fs, int(cfg.data_device_budget_bytes),
+                self._global_eff_batch(batch_size),
                 slots=cfg.data_stream_slots,
-                cache_dtype=cfg.data_cache_dtype)
+                cache_dtype=(None if self.ctx.process_count > 1
+                             else cfg.data_cache_dtype))
             if plan is None:
                 raise ValueError(f"stream fit infeasible: {why}")
         self._ensure_built(plan.probe_inputs(fs))
         shard_fn = self._build_stream_shard(plan, shuffle)
         steps = plan.steps_per_shard
+        mc = self.ctx.process_count > 1
+        view = plan.process_view(self.ctx) if mc else None
+        pair_structured = getattr(self.loss_fn, "batch_structured", False)
         if self._val_trigger is not None:
             logger.warning(
                 "stream path dispatches whole shards; validation_trigger "
@@ -1655,19 +1774,46 @@ class Estimator:
                             plan.n_shards)
         # commit the carry under the mesh BEFORE the first dispatch
         # (same compile-stability reasoning as _fit_device_resident)
-        rep = NamedSharding(self.ctx.mesh, P())
         (self.params, self.state, self.opt_state, self._rng) = \
-            jax.device_put(
-                (self.params, self.state, self.opt_state, self._rng), rep)
+            self._commit_carry(
+                (self.params, self.state, self.opt_state, self._rng))
         self._guard = self._fresh_guard()
         epoch = self.finished_epochs
         while epoch < epochs:
             t0 = time.time()
             order = plan.epoch_order(cfg.seed, epoch, shuffle)
-            acc = jax.device_put({"sum": np.zeros((), np.float32),
-                                  "good": np.zeros((), np.int32)}, rep)
-            uploader = stream_lib.ShardUploader(fs, plan, order, self.ctx,
-                                                start=start_shard)
+            acc = self._commit_carry({"sum": np.zeros((), np.float32),
+                                      "good": np.zeros((), np.int32)})
+
+            def perm_fn(shard_id, _epoch=epoch):
+                return plan.shard_perm(cfg.seed, _epoch, shard_id,
+                                       shuffle=shuffle,
+                                       pair_structured=pair_structured)
+
+            barrier_fn = None
+            if mc:
+                # a fresh monotone rotation id per uploader keeps the
+                # zoo_data_* barrier names unique for the life of the
+                # coordination service (a NaN rollback replays an epoch,
+                # and wait_at_barrier rejects name reuse)
+                self._data_rotation += 1
+                rot = self._data_rotation
+                w = dist_barrier(f"zoo_data_epoch_r{rot}",
+                                 phase="zoo_data_epoch")
+                obs.observe("checkpoint_barrier_wait_ms", w * 1e3,
+                            phase="zoo_data_epoch",
+                            flat="checkpoint/barrier_zoo_data_epoch_ms")
+
+                def barrier_fn(pos, _rot=rot):
+                    bw = dist_barrier(f"zoo_data_shard_r{_rot}_p{pos}",
+                                      phase="zoo_data_shard")
+                    obs.observe("checkpoint_barrier_wait_ms", bw * 1e3,
+                                phase="zoo_data_shard",
+                                flat="checkpoint/barrier_zoo_data_shard_ms")
+
+            uploader = stream_lib.ShardUploader(
+                fs, plan, order, self.ctx, start=start_shard, view=view,
+                perm_fn=perm_fn, barrier_fn=barrier_fn)
             wait_ms = 0.0
             trained = 0
             try:
@@ -1679,6 +1825,12 @@ class Estimator:
                         lease = uploader.get()
                         wait_ms += (time.perf_counter() - tw) * 1e3
                     except stream_lib.StreamUploadError as e:
+                        if mc:
+                            # one host finishing on per-batch dispatches
+                            # while its peers run the shard program
+                            # would deadlock the mesh's collectives —
+                            # surface the failure instead of degrading
+                            raise
                         obs.count("data_stream_fallbacks_total",
                                   reason="upload_error",
                                   flat="estimator/stream_fallbacks")
@@ -1688,13 +1840,14 @@ class Estimator:
                             "shards remain)", e, epoch + 1,
                             plan.n_shards - shards_done, plan.n_shards)
                         acc, tail = self._stream_host_tail(
-                            fs, plan, order, shards_done, acc)
+                            fs, plan, order, shards_done, acc,
+                            perm_fn=perm_fn)
                         trained += tail
                         break
                     with timeit("estimator/stream_shard"):
                         _, acc = self._dispatch_step(
-                            "shard", [acc] + list(lease.xs), lease.y,
-                            epoch_fn=shard_fn, epoch_steps=steps)
+                            "shard", [acc, lease.perm] + list(lease.xs),
+                            lease.y, epoch_fn=shard_fn, epoch_steps=steps)
                     # the accumulator leaf is this shard's sync handle:
                     # its HBM slot may be overwritten only after this
                     # shard's compute has finished

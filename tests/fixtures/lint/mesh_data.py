@@ -1,0 +1,44 @@
+"""zoolint fixture: the per-host data-tier shard cursor
+(train/estimator._fit_stream + data/streaming.ShardUploader under a
+multi-controller mesh).  Each host's uploader thread advances a shard
+cursor the training thread consults for elastic resume; the naive port
+mutates that cross-thread cursor with no lock (THR-SHARED-MUT — a torn
+read hands the checkpoint manifest a cursor from the middle of a
+rotation).  The shipped idiom — cursor advanced and read under one
+lock — stays quiet."""
+
+import threading
+
+
+class NaiveShardCursor:
+    """Unlocked cross-thread cursor: the uploader thread bumps it, the
+    training thread snapshots it into the resume manifest."""
+
+    def __init__(self):
+        self._shards_done = 0
+        self._thread = threading.Thread(target=self._run)
+
+    def _run(self):
+        self._shards_done = self._shards_done + 1   # THR-SHARED-MUT
+        # fires: uploader-thread write, read by manifest() below
+
+    def manifest(self):
+        return {"shards_done": self._shards_done}
+
+
+class LockedShardCursor:
+    """The shipped protocol: the cursor moves and is snapshotted under
+    the same lock, so the manifest never sees a mid-rotation tear."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._shards_done = 0
+        self._thread = threading.Thread(target=self._run)
+
+    def _run(self):
+        with self._lock:
+            self._shards_done = self._shards_done + 1   # quiet: locked
+
+    def manifest(self):
+        with self._lock:
+            return {"shards_done": self._shards_done}

@@ -36,6 +36,7 @@ def run_workers(nproc: int, tmp_path, tag: str, *,
                 die_step: Optional[int] = None,
                 die_pid: Optional[int] = None,
                 barrier_timeout: Optional[float] = None,
+                data_budget: Optional[int] = None,
                 global_devices: int = 4,
                 timeout: float = 240,
                 expect_rc: Optional[Dict[int, int]] = None) -> List[Optional[dict]]:
@@ -71,6 +72,8 @@ def run_workers(nproc: int, tmp_path, tag: str, *,
             cmd += ["--die-pid", str(die_pid)]
         if barrier_timeout is not None:
             cmd += ["--barrier-timeout", str(barrier_timeout)]
+        if data_budget is not None:
+            cmd += ["--data-budget", str(data_budget)]
         procs.append(subprocess.Popen(
             cmd, env=env, stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT, text=True))

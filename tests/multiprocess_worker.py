@@ -26,6 +26,30 @@ Scenarios (``--scenario``):
                  of hanging, and the half-written step must never
                  become "latest".
 
+Data-tier scenarios (``data_*``) train a STREAM-routed FeatureSet — a
+dataset deliberately over ``--data-budget`` so every process streams
+only the shard rows its devices own (docs/DATA.md "Multi-controller"):
+
+- ``data_train``   — stream fit under ``jax.transfer_guard`` plus a
+                     same-topology stream-vs-host parity pair
+                     (shuffle=False gives both paths the identical
+                     global batch sequence).
+- ``data_resume``  — ``fit(resume=True)`` against a shard-cursor
+                     manifest (possibly written at a DIFFERENT process
+                     count — the elastic-resume contract).
+- ``data_preempt`` — planned preemption at per-shard consult
+                     ``--die-step``; the flushed manifest encodes the
+                     shard cursor.
+- ``data_die``     — every process exits hard at shard dispatch
+                     ``--die-step``; resume restarts from the newest
+                     committed epoch boundary.
+- ``data_die_mid_epoch`` — the ``--die-pid`` process exits hard at its
+                     ``--die-step``-th ``zoo_data_shard`` barrier
+                     ENTRY (uploader thread, mid-rotation); survivors
+                     must surface a typed ``HostLostError`` within the
+                     barrier deadline instead of wedging on the dead
+                     peer's collectives.
+
 Replaces (and automates) the reference's manual two-executor
 integration script (pyzoo/test/zoo/ray/integration/ray_on_yarn.py:23-33).
 """
@@ -59,12 +83,20 @@ def parse_args(argv=None) -> argparse.Namespace:
                         "(0 = global/num-processes)")
     p.add_argument("--scenario", default="train",
                    choices=["train", "resume", "preempt", "die",
-                            "die_save"])
+                            "die_save", "data_train", "data_resume",
+                            "data_preempt", "data_die",
+                            "data_die_mid_epoch"])
     p.add_argument("--ckpt-dir", default="",
                    help="checkpoint directory (enables checkpointing)")
     p.add_argument("--die-step", type=int, default=4,
                    help="0-based dispatch index (preempt/die) or save "
-                        "index (die_save) at which the fault fires")
+                        "index (die_save) or zoo_data_shard barrier "
+                        "index (data_die_mid_epoch) at which the fault "
+                        "fires")
+    p.add_argument("--data-budget", type=int, default=2304,
+                   help="data_device_budget_bytes for data_* scenarios "
+                        "(default routes the 9216B dataset into an "
+                        "8-shard x 32-row rotation)")
     p.add_argument("--die-pid", type=int, default=-1,
                    help="process the fault targets (-1 = all)")
     p.add_argument("--epochs", type=int, default=3)
@@ -83,6 +115,190 @@ def _exit_hard(code: int) -> None:
     sys.stdout.flush()
     sys.stderr.flush()
     os._exit(code)
+
+
+def _run_data(args, pid: int, nproc: int) -> None:
+    """The mesh-aware data-tier scenarios (``data_*``).
+
+    Geometry (identical at every process count, which is what makes the
+    shard cursor elastic): 256 rows x (8 f32 features + i32 label) =
+    9216 B over the default 2304 B budget -> 8 shards x 32 rows,
+    2 steps/shard at the topology-invariant global batch of 16 (local
+    ``batch_size`` = 16 / nproc).  8 shard dispatches per epoch;
+    epoch-boundary checkpoints land at global steps 16, 32, 48.
+    """
+    import jax
+    import numpy as np
+
+    from analytics_zoo_tpu.core.profiling import TIMERS
+    from analytics_zoo_tpu.data import FeatureSet
+    from analytics_zoo_tpu.nn import Sequential, reset_name_scope
+    from analytics_zoo_tpu.nn.layers.core import Dense
+    from analytics_zoo_tpu.robust import HostLostError, TrainingPreempted
+    from analytics_zoo_tpu.robust.faults import FaultInjector
+
+    rs = np.random.RandomState(0)
+    n, d, classes = 256, 8, 3
+    x = rs.randn(n, d).astype(np.float32)
+    w = rs.randn(d, classes)
+    y = np.argmax(x @ w, axis=1).astype(np.int32)
+    g_batch = 16
+    local = g_batch // nproc
+    keep = np.concatenate([
+        np.arange(k * g_batch + pid * local,
+                  k * g_batch + (pid + 1) * local)
+        for k in range(n // g_batch)])
+
+    def build():
+        reset_name_scope()
+        m = Sequential([Dense(16, activation="relu"),
+                        Dense(classes, activation="softmax")])
+        m.compile(optimizer="adam",
+                  loss="sparse_categorical_crossentropy")
+        est = m.estimator
+        est.ctx.config.data_device_budget_bytes = args.data_budget
+        if args.ckpt_dir:
+            est.set_checkpoint(args.ckpt_dir)
+        return est
+
+    def stream_fs():
+        return FeatureSet.from_ndarrays([x], y, cache_level="STREAM")
+
+    def param_sum(est):
+        return float(sum(np.asarray(leaf).sum()
+                         for leaf in jax.tree_util.tree_leaves(est.params)))
+
+    targeted = args.die_pid < 0 or args.die_pid == pid
+    fit_kw = dict(batch_size=local, epochs=args.epochs, verbose=False)
+
+    if args.scenario == "data_train":
+        est = build()
+        TIMERS.reset()
+        # the acceptance bar: the stream path moves ZERO per-batch
+        # bytes through the host upload helper, and every implicit
+        # transfer on the training thread raises at the offending line
+        with jax.transfer_guard("disallow"):
+            hist = est.fit(stream_fs(), shuffle=True, **fit_kw)
+        assert est.last_data_path == "stream", est.last_data_path
+        puts = TIMERS.count("estimator/host_device_put")
+        routed = TIMERS.count("estimator/data_path_stream")
+
+        # same-topology stream-vs-host parity pair: shuffle=False gives
+        # both paths the identical global batch sequence (the host path
+        # trains this process's `keep` rows of every global batch)
+        est_s = build()
+        hs = est_s.fit(stream_fs(), batch_size=local, epochs=2,
+                       shuffle=False, verbose=False)
+        assert est_s.last_data_path == "stream"
+        est_h = build()
+        hh = est_h.fit(x[keep], y[keep], batch_size=local, epochs=2,
+                       shuffle=False, verbose=False)
+
+        with open(args.outfile, "w") as f:
+            json.dump({"process_id": pid, "scenario": "data_train",
+                       "losses": [h["loss"] for h in hist],
+                       "finished_epochs": int(est.finished_epochs),
+                       "global_step": int(est.global_step),
+                       "param_sum": param_sum(est),
+                       "host_device_put": int(puts),
+                       "stream_routed": int(routed),
+                       "stream_losses": [h["loss"] for h in hs],
+                       "stream_param_sum": param_sum(est_s),
+                       "host_losses": [h["loss"] for h in hh],
+                       "host_param_sum": param_sum(est_h)}, f)
+        return
+
+    if args.scenario == "data_resume":
+        est = build()
+        hist = est.fit(stream_fs(), shuffle=True, resume=True, **fit_kw)
+        assert est.last_data_path == "stream", est.last_data_path
+        with open(args.outfile, "w") as f:
+            json.dump({"process_id": pid, "scenario": "data_resume",
+                       "losses": [h["loss"] for h in hist],
+                       "finished_epochs": int(est.finished_epochs),
+                       "global_step": int(est.global_step),
+                       "param_sum": param_sum(est)}, f)
+        return
+
+    if args.scenario == "data_preempt":
+        est = build()
+        fi = FaultInjector()
+        if targeted:
+            # the stream path consults the preempt site once per shard
+            # (8/epoch): at=10 lands in epoch 2 with shard cursor 2
+            fi.plan("estimator.preempt", at=args.die_step)
+        try:
+            with fi:
+                est.fit(stream_fs(), shuffle=True, **fit_kw)
+        except TrainingPreempted as e:
+            with open(args.outfile, "w") as f:
+                json.dump({"process_id": pid, "scenario": "data_preempt",
+                           "preempted_step": int(e.step)}, f)
+            _exit_hard(0)
+        raise SystemExit("data_preempt finished without preempting")
+
+    if args.scenario == "data_die":
+        from analytics_zoo_tpu.train.estimator import Estimator
+
+        orig = Estimator._dispatch_step
+        calls = {"n": 0}
+
+        def dying_dispatch(self, *a, **kw):
+            if targeted and calls["n"] == args.die_step:
+                print(f"worker {pid}: dying hard at shard dispatch "
+                      f"{calls['n']}", flush=True)
+                _exit_hard(19)
+            calls["n"] += 1
+            return orig(self, *a, **kw)
+
+        Estimator._dispatch_step = dying_dispatch
+        est = build()
+        est.fit(stream_fs(), shuffle=True, **fit_kw)
+        raise SystemExit("data_die finished without dying")
+
+    if args.scenario == "data_die_mid_epoch":
+        # kill the targeted host at its Nth zoo_data_shard barrier
+        # ENTRY (on the uploader thread): the dead peer then never
+        # arrives at that barrier, so every survivor's own uploader
+        # times out there — with every collective it has already
+        # dispatched still healthy — and `uploader.get()` re-raises the
+        # typed HostLostError on the training thread.  (Dying at a
+        # shard DISPATCH instead would race survivors into a
+        # block_until_ready on a collective the dead peer never joined
+        # — a gloo wedge, not a typed error.)
+        from analytics_zoo_tpu.train import estimator as est_mod
+
+        orig_barrier = est_mod.dist_barrier
+        calls = {"n": 0}
+
+        def dying_barrier(name, *a, **kw):
+            if targeted and kw.get("phase") == "zoo_data_shard":
+                if calls["n"] == args.die_step:
+                    print(f"worker {pid}: dying hard entering barrier "
+                          f"{name}", flush=True)
+                    _exit_hard(19)
+                calls["n"] += 1
+            return orig_barrier(name, *a, **kw)
+
+        est_mod.dist_barrier = dying_barrier
+        est = build()
+        t0 = time.monotonic()
+        try:
+            est.fit(stream_fs(), shuffle=True, **fit_kw)
+        except HostLostError as e:
+            with open(args.outfile, "w") as f:
+                json.dump({"process_id": pid,
+                           "scenario": "data_die_mid_epoch",
+                           "error": "HostLostError",
+                           "barrier": e.barrier,
+                           "timeout_s": e.timeout_s,
+                           "elapsed_s": time.monotonic() - t0,
+                           "finished_epochs": int(est.finished_epochs)},
+                          f)
+            _exit_hard(0)
+        raise SystemExit("data_die_mid_epoch finished without host loss")
+
+    raise SystemExit(f"unknown data scenario {args.scenario}")
 
 
 def main() -> None:
@@ -116,6 +332,10 @@ def main() -> None:
         ctx = init_zoo_context(**cfg_kw)
     assert ctx.num_devices == args.global_devices, ctx.num_devices
     assert ctx.process_count == nproc
+
+    if args.scenario.startswith("data_"):
+        _run_data(args, pid, nproc)
+        return
 
     # deterministic problem; every process generates the full dataset and
     # slices out its rows of each global batch (global batch 16 =

@@ -346,6 +346,195 @@ def test_stream_from_sliced_featureset(zoo_ctx, tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# multi-controller primitives: pure two-level shuffle + per-process row view
+# (the real-OS-process proofs live in tests/test_multiprocess_data.py)
+# ---------------------------------------------------------------------------
+
+
+def test_two_level_shuffle_pure_function_grid():
+    """Both shuffle levels are pure functions of (seed, epoch[, shard])
+    — no carried rng, no process identity — so every host of any
+    process count derives the identical visit order with ZERO
+    communication, and an elastic resume re-derives it from the
+    manifest alone.  Property grid: determinism, permutation coverage,
+    pair-structure preservation."""
+    from analytics_zoo_tpu.data.streaming import (epoch_shard_order,
+                                                  shard_permutation)
+
+    for seed in (0, 7, 123):
+        for epoch in (0, 1, 5):
+            for n_shards in (1, 3, 8):
+                a = epoch_shard_order(n_shards, seed, epoch)
+                # each re-derivation (any process, any time) agrees
+                for _ in range(3):
+                    np.testing.assert_array_equal(
+                        a, epoch_shard_order(n_shards, seed, epoch))
+                assert sorted(a.tolist()) == list(range(n_shards))
+            for n_rows in (1, 31, 32):
+                for shard_id in (0, 2):
+                    p = shard_permutation(n_rows, seed, epoch, shard_id)
+                    np.testing.assert_array_equal(
+                        p, shard_permutation(n_rows, seed, epoch,
+                                             shard_id))
+                    assert p.dtype == np.int32
+                    assert sorted(p.tolist()) == list(range(n_rows))
+                    q = shard_permutation(n_rows, seed, epoch, shard_id,
+                                          pair_structured=True)
+                    assert sorted(q.tolist()) == list(range(n_rows))
+                    # adjacent (even, odd) pairs move together, the
+                    # resident tier's TextMatcher layout
+                    ev = q[: (n_rows // 2) * 2].reshape(-1, 2)
+                    assert np.all(ev[:, 0] % 2 == 0)
+                    assert np.all(ev[:, 1] == ev[:, 0] + 1)
+                    if n_rows % 2:
+                        assert q[-1] == n_rows - 1
+    # epochs and shards decorrelate; shuffle=False is identity
+    assert not np.array_equal(shard_permutation(32, 7, 0, 0),
+                              shard_permutation(32, 7, 1, 0))
+    assert not np.array_equal(shard_permutation(32, 7, 0, 0),
+                              shard_permutation(32, 7, 0, 1))
+    np.testing.assert_array_equal(
+        shard_permutation(32, 7, 0, 0, shuffle=False), np.arange(32))
+    np.testing.assert_array_equal(
+        epoch_shard_order(5, 7, 0, shuffle=False), np.arange(5))
+
+
+def test_process_row_view_span_mapping(zoo_ctx):
+    """ProcessRowView maps each device's global shard-row span onto the
+    locally staged concatenation; spans outside this process's
+    ownership are a typed staging error, never a silent mis-slice."""
+    from analytics_zoo_tpu.core.context import get_zoo_context
+    from analytics_zoo_tpu.data.streaming import (ProcessRowView,
+                                                  StreamUploadError)
+
+    ctx = get_zoo_context()
+    view = ProcessRowView.build(ctx, 32)
+    # one span per addressable device (single process: all of them)
+    assert view.local_rows == 32
+    assert view.spans[0][0] == 0 and view.spans[-1][1] == 32
+    lo, hi = view.spans[0]
+    assert view.local_slice(lo, hi) == slice(lo, hi)
+    with pytest.raises(StreamUploadError):
+        view.local_slice(1, 5)      # not a device-owned span
+    # a replicated layout (axis can't divide the rows) is one full span
+    full = ProcessRowView([(0, 32)], 32)
+    assert full.full and full.local_slice(0, 32) == slice(0, 32)
+
+
+# ---------------------------------------------------------------------------
+# chaos: uploader crash / torn shard / preempt-resume (CI multiprocess job)
+# ---------------------------------------------------------------------------
+
+
+def test_data_host_lost_fault_is_typed_and_trips_recorder(zoo_ctx):
+    """A planned peer death during shard staging (``data.host_lost``)
+    surfaces through the stream fit as a typed ``HostLostError`` — and
+    the armed flight recorder trips manually on the way out, so the
+    mesh-death post-mortem keeps its span/metric evidence."""
+    from analytics_zoo_tpu import init_zoo_context
+    from analytics_zoo_tpu.nn import reset_name_scope
+    from analytics_zoo_tpu.nn.layers.core import Dense
+    from analytics_zoo_tpu.nn.topology import Sequential
+    from analytics_zoo_tpu.robust import FaultInjector, HostLostError
+    from analytics_zoo_tpu.train.optimizers import Adam
+
+    init_zoo_context(seed=7)
+    reset_name_scope()
+    m = Sequential()
+    m.add(Dense(16, activation="relu", input_shape=(12,)))
+    m.add(Dense(2, activation="softmax"))
+    m.compile(optimizer=Adam(lr=1e-2),
+              loss="sparse_categorical_crossentropy")
+    est = m.estimator
+    fs = _float_fs()
+    est.ctx.config.data_device_budget_bytes = fs.nbytes // 2
+    rec = est.arm_flight_recorder(window_s=60.0)
+
+    fi = FaultInjector().plan("data.host_lost", at=1)
+    with fi:
+        with pytest.raises(HostLostError) as ei:
+            est.fit(fs, batch_size=32, epochs=2, verbose=False,
+                    shuffle=False)
+    assert fi.fired["data.host_lost"] == 1
+    assert ei.value.barrier == "data.host_lost"
+    last = rec.last_record()
+    assert last is not None and last["reason"] == "host_lost"
+    assert last["details"][0]["barrier"] == "data.host_lost"
+
+
+def test_data_shard_skew_straggle_and_crash(zoo_ctx):
+    """``data.shard_skew``: a payload straggle (this host staging late)
+    is absorbed by the rotation with reference losses; the exc variant
+    crashes the uploader, which single-controller downgrades to the
+    host path (multi-controller turns the same lateness into the
+    peers' barrier-deadline ``HostLostError`` —
+    tests/test_multiprocess_data.py)."""
+    from analytics_zoo_tpu.observe import metrics as obs
+    from analytics_zoo_tpu.robust import FaultInjector
+
+    fs_bytes = _float_fs().nbytes
+    _, losses_ref = _train_mlp("STREAM", fs_bytes // 2)
+
+    fi = FaultInjector().plan("data.shard_skew", at=1, payload=0.05)
+    with fi:
+        est, losses = _train_mlp("STREAM", fs_bytes // 2)
+    assert fi.fired["data.shard_skew"] == 1
+    assert est.last_data_path == "stream"
+    np.testing.assert_allclose(losses, losses_ref, rtol=1e-6)
+
+    mark = obs.METRICS.snapshot()
+    fi = FaultInjector().plan("data.shard_skew", at=1,
+                              exc=RuntimeError("host wedged"))
+    with fi:
+        est, losses = _train_mlp("STREAM", fs_bytes // 2)
+    np.testing.assert_allclose(losses, losses_ref, rtol=1e-6)
+    key = ("data_stream_fallbacks_total", (("reason", "upload_error"),))
+    assert obs.METRICS.snapshot().counters.get(key, 0) \
+        > mark.counters.get(key, 0)
+
+
+def test_data_path_selected_counter_labels(zoo_ctx):
+    """Every router decision ticks
+    ``data_path_selected_total{path,reason}`` with the bounded reason
+    vocabulary (docs/OBSERVABILITY.md) — the alertable form of a
+    production job silently downgrading its input tier."""
+    from analytics_zoo_tpu import init_zoo_context
+    from analytics_zoo_tpu.nn import reset_name_scope
+    from analytics_zoo_tpu.nn.layers.core import Dense
+    from analytics_zoo_tpu.nn.topology import Sequential
+    from analytics_zoo_tpu.observe import metrics as obs
+    from analytics_zoo_tpu.train.optimizers import Adam
+
+    init_zoo_context(seed=0)
+    reset_name_scope()
+    m = Sequential()
+    m.add(Dense(4, activation="relu", input_shape=(12,)))
+    m.add(Dense(2, activation="softmax"))
+    m.compile(optimizer=Adam(lr=1e-2),
+              loss="sparse_categorical_crossentropy")
+    est = m.estimator
+    fs = _float_fs()
+
+    mark = obs.METRICS.snapshot()
+    est.ctx.config.data_device_budget_bytes = fs.nbytes // 2
+    assert est._resolve_data_path(fs, batch_size=32)[0] == "stream"
+    est.ctx.config.data_device_budget_bytes = 10 ** 9
+    assert est._resolve_data_path(fs, batch_size=32)[0] \
+        == "device_resident"
+    est.ctx.config.data_device_budget_bytes = 64
+    assert est._resolve_data_path(fs, batch_size=32)[0] == "host_prefetch"
+    snap = obs.METRICS.snapshot()
+
+    for path, reason in (("stream", "over_budget"),
+                         ("device_resident", "fits_budget"),
+                         ("host_prefetch", "stream_infeasible")):
+        key = ("data_path_selected_total",
+               (("path", path), ("reason", reason)))
+        assert snap.counters.get(key, 0) == mark.counters.get(key, 0) + 1, \
+            (path, reason)
+
+
+# ---------------------------------------------------------------------------
 # chaos: uploader crash / torn shard / preempt-resume (CI multiprocess job)
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,176 @@
+"""Multi-process chaos for the mesh-aware data tier: per-host shard
+streaming, host-death-mid-rotation, and elastic shard-cursor resume.
+
+Real OS processes, real gloo coordination, real kills — no mocks.  The
+scenarios assert the acceptance criteria of the multi-controller STREAM
+tier (docs/DATA.md "Multi-controller", docs/ROBUSTNESS.md):
+
+- under a 2+-process ``jax.distributed`` mesh a STREAM-eligible
+  FeatureSet trains through the stream path (the router returns
+  "stream"), with ZERO per-batch host ``device_put`` under
+  ``jax.transfer_guard`` and stream-vs-host loss parity at rtol 1e-6 on
+  the same topology;
+- hard-killing one host mid-epoch surfaces a typed ``HostLostError`` on
+  every survivor within the ``zoo_data_shard`` barrier deadline — no
+  hang, no torn on-disk state;
+- a preempted 2-process run resumes at 1 AND 4 processes with the shard
+  cursor replayed (the stream plan's geometry is topology-invariant)
+  and loss parity against an uninterrupted run.
+
+Worker data geometry (multiprocess_worker.py ``_run_data``): 256 rows
+over a 2304 B budget -> 8 shards x 32 rows, 2 steps/shard at global
+batch 16, so 8 shard dispatches + 8 ``zoo_data_shard`` barriers per
+epoch; epoch-boundary checkpoints land at global steps 16, 32, 48.
+"""
+
+import os
+import shutil
+
+import pytest
+
+from tests.mp_harness import run_workers
+
+SHARDS_PER_EPOCH = 8
+STEPS_PER_SHARD = 2
+
+
+@pytest.fixture(scope="module")
+def data_ref(tmp_path_factory):
+    """Uninterrupted single-process 3-epoch stream run: the parity
+    baseline every chaos scenario is measured against."""
+    tmp = tmp_path_factory.mktemp("mpd_ref")
+    return run_workers(1, tmp, "dref", scenario="data_train")[0]
+
+
+@pytest.mark.slow
+def test_stream_path_engages_multicontroller(tmp_path, data_ref):
+    """2-process mesh: the router picks "stream" (not the host bailout),
+    the rotation moves zero per-batch bytes through the host upload
+    helper, stream losses match the host path at rtol 1e-6 on the same
+    topology, and the whole trajectory is invariant to the process
+    count."""
+    res = run_workers(2, tmp_path, "dtrain2", scenario="data_train")
+    for r in res:
+        assert r["stream_routed"] == 1
+        assert r["host_device_put"] == 0
+        assert r["finished_epochs"] == 3
+        # same-topology stream-vs-host parity (identical global batch
+        # sequence under shuffle=False)
+        assert r["stream_losses"] == pytest.approx(r["host_losses"],
+                                                   rel=1e-6)
+        assert r["stream_param_sum"] == pytest.approx(
+            r["host_param_sum"], rel=1e-6)
+    # the loss stream is replicated: both hosts observe the same run
+    assert res[0]["losses"] == pytest.approx(res[1]["losses"], rel=1e-6)
+    # topology invariance: both shuffle levels are pure functions of
+    # (seed, epoch[, shard]), so 2-proc streaming = 1-proc streaming
+    assert res[0]["losses"] == pytest.approx(data_ref["losses"], rel=1e-5)
+    assert res[0]["param_sum"] == pytest.approx(data_ref["param_sum"],
+                                                rel=1e-3)
+    # the single-process baseline holds the same bars
+    assert data_ref["stream_routed"] == 1
+    assert data_ref["host_device_put"] == 0
+    assert data_ref["stream_losses"] == pytest.approx(
+        data_ref["host_losses"], rel=1e-6)
+
+
+@pytest.mark.slow
+def test_data_preempt_resumes_elastically(tmp_path, data_ref):
+    """2-process run preempted mid-epoch-2 (shard cursor 2) resumes at
+    1 AND 4 processes: the manifest's in-epoch step replays the shard
+    cursor on the re-derived (seed, epoch) order, landing both resumed
+    topologies on the uninterrupted trajectory."""
+    ckpt = tmp_path / "ckpt"
+    pre = run_workers(2, tmp_path, "dpre", scenario="data_preempt",
+                      ckpt_dir=ckpt, die_step=10)
+    # per-shard preempt consult #10 = epoch 2, shards_done 2 -> global
+    # step 16 + 2*2 = 20
+    assert [r["preempted_step"] for r in pre] == [20, 20]
+    d20 = ckpt / "dstep_0000000020"
+    assert sorted(f for f in os.listdir(d20)
+                  if f.startswith("PREEMPT_")) == \
+        ["PREEMPT_00000", "PREEMPT_00001"]
+    assert not (d20 / "COMMITTED").exists()
+    assert (ckpt / "dstep_0000000016" / "COMMITTED").exists()
+
+    # resume each topology from its own copy of the preempted state
+    # (a completed resume writes newer checkpoints into the dir)
+    ckpt1, ckpt4 = tmp_path / "ckpt_r1", tmp_path / "ckpt_r4"
+    shutil.copytree(ckpt, ckpt1)
+    shutil.copytree(ckpt, ckpt4)
+
+    res1 = run_workers(1, tmp_path, "dres1", scenario="data_resume",
+                       ckpt_dir=ckpt1)[0]
+    assert res1["finished_epochs"] == 3
+    assert res1["losses"][-1] == pytest.approx(data_ref["losses"][-1],
+                                               rel=1e-4)
+    assert res1["param_sum"] == pytest.approx(data_ref["param_sum"],
+                                              rel=1e-3)
+
+    res4 = run_workers(4, tmp_path, "dres4", scenario="data_resume",
+                       ckpt_dir=ckpt4)
+    for a in res4[1:]:
+        assert a["losses"] == pytest.approx(res4[0]["losses"], rel=1e-6)
+    assert res4[0]["finished_epochs"] == 3
+    assert res4[0]["losses"][-1] == pytest.approx(data_ref["losses"][-1],
+                                                  rel=1e-4)
+    assert res4[0]["param_sum"] == pytest.approx(data_ref["param_sum"],
+                                                 rel=1e-3)
+
+
+@pytest.mark.slow
+def test_data_hard_death_resumes_from_boundary(tmp_path, data_ref):
+    """Every host dies hard (``os._exit``, no flush) at shard dispatch
+    #10 (mid-epoch-2); the run restarts at a DIFFERENT process count
+    from the committed epoch-1 boundary and re-lands the uninterrupted
+    trajectory — including the re-trained epoch 2."""
+    ckpt = tmp_path / "ckpt"
+    run_workers(2, tmp_path, "dhard", scenario="data_die", ckpt_dir=ckpt,
+                die_step=10, expect_rc={0: 19, 1: 19})
+
+    assert (ckpt / "dstep_0000000016" / "COMMITTED").exists()
+
+    res = run_workers(1, tmp_path, "dhard_res", scenario="data_resume",
+                      ckpt_dir=ckpt)[0]
+    assert res["finished_epochs"] == 3
+    # resumed from the epoch-1 boundary: epochs 2 and 3 re-run whole,
+    # so BOTH resumed loss rows match the uninterrupted run
+    assert res["losses"] == pytest.approx(data_ref["losses"][1:],
+                                          rel=1e-4)
+    assert res["param_sum"] == pytest.approx(data_ref["param_sum"],
+                                             rel=1e-3)
+
+
+@pytest.mark.slow
+def test_data_host_death_mid_epoch_surfaces_typed(tmp_path, data_ref):
+    """Process 1 dies hard mid-rotation (its 11th ``zoo_data_shard``
+    barrier = epoch 2, position 3): the survivor must surface a typed
+    ``HostLostError`` naming a ``zoo_data_shard`` barrier within the
+    deadline — no hang — with every on-disk checkpoint step fully
+    committed (no torn shard), and the job must restart cleanly from
+    the boundary at a different topology."""
+    ckpt = tmp_path / "ckpt"
+    res = run_workers(2, tmp_path, "ddie", scenario="data_die_mid_epoch",
+                      ckpt_dir=ckpt, die_step=11, die_pid=1,
+                      barrier_timeout=12, expect_rc={1: 19})
+
+    surv = res[0]
+    assert surv["error"] == "HostLostError"
+    assert surv["barrier"].startswith("zoo_data_shard")
+    assert surv["timeout_s"] == 12
+    # surfaced promptly: one epoch of training + part of epoch 2 + the
+    # 12s barrier deadline, well under the harness kill timeout
+    assert surv["elapsed_s"] < 150
+    assert surv["finished_epochs"] == 1
+
+    # no torn on-disk state: every dstep dir present is fully committed
+    dsteps = [d for d in os.listdir(ckpt) if d.startswith("dstep_")]
+    assert dsteps, "epoch-1 boundary checkpoint missing"
+    for d in dsteps:
+        assert (ckpt / d / "COMMITTED").exists(), f"torn step {d}"
+
+    res1 = run_workers(1, tmp_path, "ddie_res", scenario="data_resume",
+                       ckpt_dir=ckpt)[0]
+    assert res1["finished_epochs"] == 3
+    assert res1["losses"][-1] == pytest.approx(data_ref["losses"][-1],
+                                               rel=1e-4)

@@ -133,6 +133,20 @@ def test_stream_uploader_fixture():
     assert len(fs) == 2
 
 
+def test_mesh_data_cursor_fixture():
+    """The per-host data-tier shard cursor (multi-controller
+    _fit_stream): an uploader thread advancing the elastic-resume
+    cursor with no lock fires THR-SHARED-MUT — a torn read would hand
+    the checkpoint manifest a mid-rotation cursor; the shipped
+    advance-and-snapshot-under-one-lock protocol stays quiet, so the
+    mesh-aware data tier keeps a clean lint bill by construction."""
+    fs = fixture_findings("mesh_data.py")
+    assert scopes_of(fs, "THR-SHARED-MUT") == {"NaiveShardCursor._run"}
+    quiet = {"LockedShardCursor._run", "LockedShardCursor.manifest"}
+    assert not quiet & {f.scope for f in fs}
+    assert len(fs) == 1
+
+
 def test_observe_instrumentation_fixture():
     """Span/metric instrumentation idioms: the naive retrofit fires
     (unlocked ring read, per-step host sync for a metric sample); the
