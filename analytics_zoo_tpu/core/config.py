@@ -80,6 +80,11 @@ class ZooConfig:
     # effective device budget ~4x for image/embedding features.
     # Labels and integer arrays always pass through unquantized.
     data_cache_dtype: Optional[str] = None
+    # Fused embedding-bag kernel routing (ops/embedding_bag.py) for the
+    # recommenders' multi-hot lookups: "auto" lets ops.dispatch pick
+    # (Pallas on TPU above its win threshold), "on" insists on the
+    # kernel wherever shapes allow, "off" pins the XLA gather path.
+    fused_embedding: str = "auto"
 
     # --- serving ---------------------------------------------------------
     # Pipelined serving engine (docs/SERVING.md).  The DynamicBatcher
@@ -140,6 +145,12 @@ class ZooConfig:
     serving_shm_slots: int = 256
     serving_shm_slot_bytes: int = 1 << 20
     serving_shm_result_slot_bytes: int = 1 << 20
+    # Replica weight storage (deploy/inference.py): "float32" keeps full
+    # precision; "int8" / "int4" store weights quantized per output
+    # channel (1/4, resp. 1/8 of the f32 HBM footprint) and dequantize
+    # inside the serving forward — on TPU through the fused
+    # dequantize-matmul kernel (ops/dequant_matmul.py).
+    serving_weight_dtype: str = "float32"
 
     # --- observability ---------------------------------------------------
     # Bounded ring of completed spans kept by observe.TRACER; any

@@ -89,19 +89,30 @@ def _f32_out(out):
 # int8 calibration — replaced by a native AQT-style pass)
 # ---------------------------------------------------------------------------
 
-def quantize_pytree(params, min_size: int = 1024):
-    """Per-channel symmetric int8 quantization of float leaves.
+def quantize_pytree(params, min_size: int = 1024, bits: int = 8):
+    """Per-channel symmetric quantization of float leaves.
 
-    Returns a pytree where each quantized leaf becomes
-    ``{"q": int8 array, "scale": f32 per-last-axis-channel}``; small or
-    non-float leaves pass through unchanged.
+    ``bits=8``: each quantized leaf becomes ``{"q": int8 array, "scale":
+    f32 per-last-axis-channel}``.  ``bits=4``: 2-D leaves with an even
+    row count become ``{"q4": nibble-packed int8, "scale": f32}`` at 1/8
+    the f32 footprint (ops/dequant_matmul.pack_int4); other leaves keep
+    the int8 scheme (int4 packs along the contraction axis, which only
+    a matmul weight has).  Small or non-float leaves pass through
+    unchanged.  The leaf KEY ("q" vs "q4") carries the storage format —
+    pytree structure stays static under jit, so the serving forward can
+    route on it.
     """
+    from analytics_zoo_tpu.ops.dequant_matmul import quantize_weights
     from analytics_zoo_tpu.ops.quantization import quantize_tensor
 
     def one(leaf):
         a = np.asarray(leaf)
         if a.dtype.kind != "f" or a.size < min_size or a.ndim == 0:
             return leaf
+        if bits == 4 and a.ndim == 2 and a.shape[0] % 2 == 0:
+            q4, scale = quantize_weights(a, bits=4)
+            return {"q4": np.asarray(q4),
+                    "scale": np.asarray(scale, np.float32)}
         # per-channel (last axis) for >=2-D; 1-D uses the same machinery
         # with its single axis (ONE shared int8 scheme — see
         # ops/quantization.quantize_tensor)
@@ -119,18 +130,54 @@ def quantize_pytree(params, min_size: int = 1024):
 
 
 def _is_qleaf(x) -> bool:
-    return (isinstance(x, dict) and set(x) == {"q", "scale"})
+    return (isinstance(x, dict)
+            and set(x) in ({"q", "scale"}, {"q4", "scale"}))
 
 
 def dequantize_pytree(qparams):
     """Inverse of quantize_pytree — runs inside jit so XLA fuses the
     int8→f32 dequant into the consuming matmul (weights stay int8 in HBM)."""
+    from analytics_zoo_tpu.ops.dequant_matmul import unpack_int4
+
     def one(x):
-        if _is_qleaf(x):
-            return x["q"].astype(jnp.float32) * x["scale"]
-        return x
+        if not _is_qleaf(x):
+            return x
+        if "q4" in x:  # zoolint: disable=JG-TRACED-BRANCH(dict-key membership is static pytree structure, not a traced value)
+            q = unpack_int4(x["q4"], 2 * x["q4"].shape[0])
+            return q.astype(jnp.float32) * x["scale"]
+        return x["q"].astype(jnp.float32) * x["scale"]
 
     return jax.tree_util.tree_map(one, qparams, is_leaf=_is_qleaf)
+
+
+def _dense_layer_names(net) -> set:
+    """Names of Dense layers in a net — their quantized kernels stay
+    packed through the serving forward (Dense fuses the dequant into the
+    matmul via ops/dequant_matmul.py); every other quantized leaf is
+    dequantized up front."""
+    from analytics_zoo_tpu.nn.layers.core import Dense
+
+    try:
+        return {lyr.name for lyr in net.layers if isinstance(lyr, Dense)}
+    except Exception:
+        return set()
+
+
+def _dequant_for_forward(qparams, dense_names):
+    """Dequantize quantized leaves, EXCEPT Dense kernels, which pass
+    through as q-leaves for the fused dequantize-matmul path."""
+    if not isinstance(qparams, dict):
+        return dequantize_pytree(qparams)
+    out = {}
+    for lname, sub in qparams.items():
+        if lname in dense_names and isinstance(sub, dict):  # zoolint: disable=JG-TRACED-BRANCH(layer names are static python strings, not traced values)
+            out[lname] = {
+                k: (v if k == "kernel" and _is_qleaf(v)
+                    else dequantize_pytree(v))
+                for k, v in sub.items()}
+        else:
+            out[lname] = dequantize_pytree(sub)
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -182,6 +229,7 @@ class InferenceModel:
         self._seen_shapes = set()
         self._shape_lock = threading.Lock()
         self._net = None
+        self._weight_dtype = "float32"
 
     # expose the bucket lowering on the class (callers/tests reach it as
     # InferenceModel._next_bucket)
@@ -210,7 +258,8 @@ class InferenceModel:
 
     # -- loaders -----------------------------------------------------------
     @classmethod
-    def load(cls, path: str, int8: bool = False, **kw) -> "InferenceModel":
+    def load(cls, path: str, int8: bool = False,
+             weight_dtype: Optional[str] = None, **kw) -> "InferenceModel":
         """Load the native format written by ``ZooModel.save_model`` (a dir
         with config.json + weights.npz) — reference doLoad
         (InferenceModel.scala:86)."""
@@ -222,11 +271,29 @@ class InferenceModel:
         if tree is None:
             raise FileNotFoundError(f"{path} has no weights.npz")
         return cls.from_keras_net(net, tree["params"], tree.get("state", {}),
-                                  int8=int8, **kw)
+                                  int8=int8, weight_dtype=weight_dtype, **kw)
+
+    @staticmethod
+    def _resolve_weight_dtype(weight_dtype: Optional[str],
+                              int8: bool) -> str:
+        """None defers to the legacy ``int8`` flag, then to the global
+        ``serving_weight_dtype`` knob (no context = float32)."""
+        if weight_dtype is None:
+            if int8:
+                return "int8"
+            from analytics_zoo_tpu.ops.dispatch import config_knob
+
+            weight_dtype = config_knob("serving_weight_dtype", "float32")
+        if weight_dtype not in ("float32", "int8", "int4"):
+            raise ValueError(
+                f"serving weight_dtype must be float32|int8|int4, got "
+                f"{weight_dtype!r}")
+        return weight_dtype
 
     @classmethod
     def from_keras_net(cls, net, params, state=None, int8: bool = False,
                        preprocess: Optional[Callable] = None,
+                       weight_dtype: Optional[str] = None,
                        **kw) -> "InferenceModel":
         """Wrap a built KerasNet + weights as a serving model.
 
@@ -234,17 +301,29 @@ class InferenceModel:
         compiled program as the forward pass (fn(*raw) -> model input(s)).
         Lets clients ship compact wire dtypes — e.g. uint8 images
         normalized on-chip — so the host→device link carries 4x fewer
-        bytes than float32 (see ``deploy.imagenet_preprocess``)."""
-        state = state or {}
-        qparams = quantize_pytree(params) if int8 else None
+        bytes than float32 (see ``deploy.imagenet_preprocess``).
 
-        if int8:
+        ``weight_dtype``: replica weight storage — "float32", "int8"
+        (1/4 HBM footprint) or "int4" (1/8); ``None`` resolves the
+        legacy ``int8`` flag, then the ``serving_weight_dtype`` config
+        knob.  Quantized Dense kernels stay packed end-to-end: the
+        forward dequantizes them inside the matmul
+        (ops/dequant_matmul.py — the fused Pallas kernel on TPU)."""
+        state = state or {}
+        weight_dtype = cls._resolve_weight_dtype(weight_dtype, int8)
+        quantized = weight_dtype != "float32"
+        qparams = (quantize_pytree(params,
+                                   bits=4 if weight_dtype == "int4" else 8)
+                   if quantized else None)
+        dense_names = _dense_layer_names(net) if quantized else set()
+
+        if quantized:
             @jax.jit
             def fwd(*xs):
                 if preprocess is not None:
                     xs = _as_tuple(preprocess(*xs))
-                p, s2 = _match_compute_dtype(dequantize_pytree(qparams),
-                                             state, xs)
+                p, s2 = _match_compute_dtype(
+                    _dequant_for_forward(qparams, dense_names), state, xs)
                 out, _ = net.call(p, s2, *xs, training=False)
                 return _f32_out(out)
         else:
@@ -260,7 +339,8 @@ class InferenceModel:
             return fwd(*[jnp.asarray(x) for x in inputs])
 
         m = cls(forward, **kw)
-        m._net, m._params, m._int8 = net, params, int8
+        m._net, m._params, m._int8 = net, params, quantized
+        m._weight_dtype = weight_dtype
         m._state, m._preprocess, m._qparams = state, preprocess, qparams
         return m
 
@@ -272,13 +352,14 @@ class InferenceModel:
         ``top_n`` fuses top-k into the program (scores never leave the
         chip: the readback is 2*top_n scalars per row, not the logits)."""
         net, pre, int8 = self._net, self._preprocess, self._int8
+        dense_names = _dense_layer_names(net) if int8 else set()
 
         @jax.jit
         def fwd(p, s, *xs):
             if pre is not None:
                 xs = _as_tuple(pre(*xs))
             if int8:
-                p = dequantize_pytree(p)
+                p = _dequant_for_forward(p, dense_names)
             p2, s2 = _match_compute_dtype(p, s, xs)
             out, _ = net.call(p2, s2, *xs, training=False)
             out = _f32_out(out)

@@ -24,7 +24,7 @@ from analytics_zoo_tpu.core.context import explicit_prng_key
 from analytics_zoo_tpu.models.common import ZooModel, register_model
 from analytics_zoo_tpu.nn import Input, Model
 from analytics_zoo_tpu.nn.layers.core import Dense, Dropout, Flatten
-from analytics_zoo_tpu.nn.layers.embedding import Embedding
+from analytics_zoo_tpu.nn.layers.embedding import Embedding, EmbeddingBag
 from analytics_zoo_tpu.nn.layers.merge import merge
 from analytics_zoo_tpu.nn.layers.recurrent import GRU
 
@@ -202,11 +202,13 @@ class WideAndDeep(Recommender):
                             name="wide_input")
             inputs.append(wide_in)
             total = int(np.sum(wide_dims))
-            wide_e = Embedding(total, self.class_num, init="zero",
-                               name="wide_linear")(wide_in)
-            from analytics_zoo_tpu.nn.layers.core import Lambda
-            wide_sum = Lambda(lambda t: jnp.sum(t, axis=1),
-                              name="wide_sum")(wide_e)
+            # one fused gather+sum (ops/embedding_bag.py) instead of an
+            # Embedding followed by a Lambda-sum: the (B, n_wide,
+            # class_num) gathered rows never materialise.  pad_id=None —
+            # every wide id is a live feature (offsets start at 0).
+            wide_sum = EmbeddingBag(total, self.class_num, combiner="sum",
+                                    init="zero", pad_id=None,
+                                    name="wide_linear")(wide_in)
             towers.append(wide_sum)
 
         if self.model_type in ("deep", "wide_n_deep"):

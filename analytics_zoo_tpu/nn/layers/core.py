@@ -49,7 +49,21 @@ class Dense(StatelessLayer):
         return params
 
     def forward(self, params, x, training=False, rng=None):
-        y = jnp.dot(x, params["kernel"])
+        kernel = params["kernel"]
+        if isinstance(kernel, dict):
+            # quantized serving leaf ({"q"|"q4", "scale"}, see
+            # deploy.quantize_pytree): dequant fused into the matmul —
+            # the Pallas kernel on TPU (ops/dequant_matmul.py), so the
+            # kernel never materialises at f32 in HBM
+            from analytics_zoo_tpu.ops.dequant_matmul import dequant_matmul
+
+            if "q4" in kernel:
+                y = dequant_matmul(x, kernel["q4"], kernel["scale"],
+                                   bits=4, rows=x.shape[-1])
+            else:
+                y = dequant_matmul(x, kernel["q"], kernel["scale"])
+        else:
+            y = jnp.dot(x, kernel)
         if self.use_bias:
             y = y + params["bias"]
         if self.activation is not None:

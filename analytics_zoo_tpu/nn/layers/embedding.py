@@ -50,13 +50,17 @@ class Embedding(StatelessLayer):
         return {"table": table}
 
     def forward(self, params, ids, training=False, rng=None):
+        from analytics_zoo_tpu.ops.embedding_bag import embedding_gather
+
         table = params["table"]
         if not self.trainable:
             table = jax.lax.stop_gradient(table)
         ids = ids.astype(jnp.int32)
         if not self.zero_based:
             ids = ids - 1
-        return jnp.take(table, ids, axis=0)
+        # routed through the fused bag kernel on TPU (singleton bags);
+        # exactly jnp.take elsewhere
+        return embedding_gather(table, ids)
 
 
 class WordEmbedding(Embedding):
@@ -128,14 +132,70 @@ class SparseEmbedding(StatelessLayer):
         return {"table": table}
 
     def forward(self, params, x, training=False, rng=None):
+        from analytics_zoo_tpu.ops.embedding_bag import embedding_bag
+
         ids = x.astype(jnp.int32)                     # (B, max_nnz)
-        mask = (ids != self.pad_id).astype(jnp.float32)[..., None]
-        emb = jnp.take(params["table"], ids, axis=0) * mask
-        out = jnp.sum(emb, axis=-2)
-        if self.combiner != "sum":
-            n = jnp.maximum(jnp.sum(mask, axis=-2), 1.0)
-            out = out / (n if self.combiner == "mean" else jnp.sqrt(n))
-        return out
+        # fused gather+combine: the Pallas kernel on TPU (fused_embedding
+        # knob), the XLA gather+masked-sum reference elsewhere
+        return embedding_bag(params["table"], ids, self.combiner,
+                             self.pad_id)
+
+
+class EmbeddingBag(StatelessLayer):
+    """Dense multi-hot lookup + combine in one layer: ``(B, n_ids)`` int
+    input -> ``(B, dim)``, ``combine_j table[ids[b, j]]``.
+
+    The combine-after-gather pattern the recommenders spell as
+    ``Embedding`` followed by a sum (Wide&Deep's wide tower, NCF's
+    flattened single-id lookups) — expressed as one op so the fused
+    Pallas kernel (ops/embedding_bag.py) sees the whole bag and never
+    materialises the (B, n_ids, dim) gathered rows.  ``pad_id=None``
+    (default) counts every slot — dense multi-hot, e.g. cross-column
+    feature ids; set a ``pad_id`` for ragged bags padded to fixed width.
+    """
+
+    def __init__(self, input_dim: int, output_dim: int,
+                 combiner: str = "sum", init="uniform",
+                 pad_id: Optional[int] = None, trainable: bool = True,
+                 weights: Optional[np.ndarray] = None,
+                 zero_based: bool = True, **kw):
+        super().__init__(**kw)
+        if combiner not in ("sum", "mean", "sqrtn"):
+            raise ValueError(f"combiner must be sum|mean|sqrtn, got "
+                             f"{combiner!r}")
+        self.input_dim = input_dim
+        self.output_dim = output_dim
+        self.combiner = combiner
+        self.initializer = initializers.get(init)
+        self.pad_id = pad_id
+        self.trainable = trainable
+        self.pretrained = weights
+        self.zero_based = zero_based
+
+    def build_params(self, rng, input_shape):
+        if self.pretrained is not None:
+            table = jnp.asarray(self.pretrained, jnp.float32)
+            if table.shape != (self.input_dim, self.output_dim):
+                raise ValueError(
+                    f"pretrained weights {table.shape} != "
+                    f"({self.input_dim}, {self.output_dim})")
+        else:
+            table = self.initializer(
+                rng, (self.input_dim, self.output_dim), jnp.float32)
+        if self.pad_id is not None:
+            table = table.at[self.pad_id].set(0.0)
+        return {"table": table}
+
+    def forward(self, params, x, training=False, rng=None):
+        from analytics_zoo_tpu.ops.embedding_bag import embedding_bag
+
+        table = params["table"]
+        if not self.trainable:
+            table = jax.lax.stop_gradient(table)
+        ids = x.astype(jnp.int32)
+        if not self.zero_based:
+            ids = ids - 1
+        return embedding_bag(table, ids, self.combiner, self.pad_id)
 
 
 class SparseDense(StatelessLayer):

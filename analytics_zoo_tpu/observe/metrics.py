@@ -130,6 +130,11 @@ CATALOG: Dict[str, Tuple[str, str, Tuple[str, ...]]] = {
     "prefetch_producer_stalls_total": (
         "counter", "producer put() attempts that found the prefetch "
         "queue full (consumer is the bottleneck)", ()),
+    # ops/ kernel dispatch
+    "ops_kernel_selected_total": (
+        "counter", "kernel backend-routing decisions (trace-time, once "
+        "per compilation), by kernel and chosen path "
+        "(pallas | interpret | reference)", ("kernel", "path")),
     # checkpointing
     "checkpoint_seconds": (
         "histogram", "checkpoint op wall time", ("op",)),

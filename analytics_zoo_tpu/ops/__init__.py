@@ -3,6 +3,18 @@ from analytics_zoo_tpu.ops.attention import (  # noqa: F401
     dot_product_attention,
     reference_attention,
 )
+from analytics_zoo_tpu.ops.dequant_matmul import (  # noqa: F401
+    dequant_matmul,
+    dequant_matmul_reference,
+    pack_int4,
+    quantize_weights,
+    unpack_int4,
+)
+from analytics_zoo_tpu.ops.dispatch import select_path  # noqa: F401
+from analytics_zoo_tpu.ops.embedding_bag import (  # noqa: F401
+    embedding_bag,
+    embedding_bag_reference,
+)
 from analytics_zoo_tpu.ops.flash_attention import flash_attention  # noqa: F401
 from analytics_zoo_tpu.ops.quantization import (  # noqa: F401
     Calibrator,

@@ -176,18 +176,24 @@ def dot_product_attention(q, k, v, mask=None, causal: bool = False,
     dropout (reference attn_drop semantics) via the blockwise path, which
     keeps the O(Lq · block) memory bound during training.
     """
+    from analytics_zoo_tpu.ops import dispatch
+
     dropping = dropout_rate > 0.0 and dropout_rng is not None
-    if use_flash is None:
-        # r5 true-time routing: the hand-written kernel wins from
-        # L≈2048 up (1.31× stock at 2048, 1.53× at 8192 fwd) but the
-        # XLA blockwise path is faster below that (0.27 vs 0.35 ms at
-        # 1024) — kernel grid overhead dominates short sequences
-        use_flash = (jax.default_backend() == "tpu" and mask is None
-                     and not dropping
-                     and q.shape[-1] % 128 == 0 and q.shape[2] % 128 == 0
-                     and k.shape[2] % 128 == 0
-                     and max(q.shape[2], k.shape[2]) >= 2048)
-    if use_flash:
+    # r5 true-time routing: the hand-written kernel wins from L≈2048 up
+    # (1.31× stock at 2048, 1.53× at 8192 fwd) but the XLA blockwise path
+    # is faster below that (0.27 vs 0.35 ms at 1024) — kernel grid
+    # overhead dominates short sequences
+    path = dispatch.select_path(
+        "flash_attention",
+        shapes_ok=(mask is None and not dropping
+                   and q.shape[-1] % 128 == 0 and q.shape[2] % 128 == 0
+                   and k.shape[2] % 128 == 0),
+        min_work_met=max(q.shape[2], k.shape[2]) >= 2048,
+        force=(None if use_flash is None else
+               (dispatch.PATH_PALLAS if use_flash
+                else dispatch.PATH_REFERENCE)),
+    )
+    if path == dispatch.PATH_PALLAS:
         if mask is not None:
             raise ValueError("flash kernel does not take a mask; pass "
                              "use_flash=False (or None for auto dispatch)")

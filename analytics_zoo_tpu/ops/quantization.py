@@ -79,14 +79,24 @@ def quantize_tensor(w, axis: int = -1) -> Tuple[jnp.ndarray, jnp.ndarray]:
     return q, scale.astype(jnp.float32)
 
 
-def int8_dot(x, w_q, w_scale, x_scale=None):
+def int8_dot(x, w_q, w_scale, x_scale=None, weight_only: bool = False):
     """``x @ dequant(w_q)`` computed as an int8 x int8 MXU matmul.
 
     ``x_scale``: static per-tensor activation scale from calibration;
     None = dynamic (abs-max of the live batch — one extra reduction).
     Accumulation is int32 (``preferred_element_type``), rescale is one
     fused f32 multiply.
+
+    ``weight_only=True`` keeps activations in float and routes through
+    the fused dequantize-matmul (ops/dequant_matmul.py): weights stay
+    int8 in HBM, tiles decode in-registers after the VMEM load — the
+    serving path when ``serving_weight_dtype`` != float32, and the
+    right choice when activation quantization error is unacceptable.
     """
+    if weight_only:
+        from analytics_zoo_tpu.ops.dequant_matmul import dequant_matmul
+
+        return dequant_matmul(x, w_q, jnp.reshape(w_scale, (1, -1)))
     if x_scale is None:
         amax = jnp.max(jnp.abs(x))
         x_scale = jnp.where(amax == 0, 1.0, amax / 127.0)

@@ -211,3 +211,53 @@ class TestBenchNonFiniteGuards:
         r = b._measure_scan(lambda c, n: c, np.zeros(4), K=16,
                             rounds=2, probe=False)
         assert r is None
+
+    def test_roofline_rows_guard_degenerate_inputs(self):
+        b = _bench()
+        row = b._roofline(int(1e8), int(3e8), 1e-3)
+        assert row["bytes_ideal"] == int(1e8)
+        assert row["bytes_moved"] == int(3e8)
+        assert row["traffic_ratio"] == 3.0
+        assert row["gbps_achieved"] == 300.0
+        # no measured time: the GB/s row is ABSENT, not 0/Infinity
+        assert "gbps_achieved" not in b._roofline(100, 300, None)
+        assert b._roofline(100, 0, 1.0)["traffic_ratio"] is None
+
+
+class TestBenchKernelLegProfiler:
+    """The FlightRecorder wired through the kernel bench legs: a
+    speedup-floor breach lands BOTH a flight record and a device
+    profiler trace under BENCH_PROFILE_DIR/<leg>, so the trace that
+    explains a regression ships with the artifact."""
+
+    def test_breach_trace_file_lands(self, tmp_path, monkeypatch):
+        import time
+
+        import jax.numpy as jnp
+
+        b = _bench()
+        monkeypatch.setenv("BENCH_PROFILE_DIR", str(tmp_path))
+        jnp.zeros(1).block_until_ready()    # backend up pre-profiler
+        out = {"fused_vs_unfused_speedup": 0.5}
+        b._breach_check(out, "embedding_bag",
+                        "fused_vs_unfused_speedup", 1.3)
+        assert "breach_recorder_error" not in out, out
+        rec = out.get("breach_flight_record")
+        assert rec and Path(rec).exists()
+        leg_dir = tmp_path / "embedding_bag"
+        deadline = time.time() + 20.0       # trace thread is async
+        trace = []
+        while time.time() < deadline and not trace:
+            trace = list(leg_dir.glob("plugins/profile/*/*.xplane.pb"))
+            time.sleep(0.1)
+        assert trace, "profiler trace never landed under profile_dir"
+
+    def test_no_breach_no_record(self, tmp_path, monkeypatch):
+        b = _bench()
+        monkeypatch.setenv("BENCH_PROFILE_DIR", str(tmp_path))
+        for spd in (2.0, 1.3, None):        # unresolved is NOT a breach
+            out = {"fused_vs_unfused_speedup": spd}
+            b._breach_check(out, "embedding_bag",
+                            "fused_vs_unfused_speedup", 1.3)
+            assert "breach_flight_record" not in out, spd
+        assert not list(tmp_path.iterdir())

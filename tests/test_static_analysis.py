@@ -133,6 +133,17 @@ def test_stream_uploader_fixture():
     assert len(fs) == 2
 
 
+def test_fused_kernel_driver_fixture():
+    """The kernel-bench driver idiom behind bench.py's Pallas legs:
+    draining every tile with a per-iteration block_until_ready fires
+    JG-TRANSFER-HOT; the shipped drivers enqueue the sweep and sync
+    once on the last handle — quiet by construction."""
+    fs = fixture_findings("fused_kernel.py")
+    assert scopes_of(fs, "JG-TRANSFER-HOT") == {"per_tile_block"}
+    assert "batched_tiles_ok" not in {f.scope for f in fs}
+    assert len(fs) == 1
+
+
 def test_mesh_data_cursor_fixture():
     """The per-host data-tier shard cursor (multi-controller
     _fit_stream): an uploader thread advancing the elastic-resume
