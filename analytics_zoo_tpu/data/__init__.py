@@ -3,6 +3,9 @@ from analytics_zoo_tpu.data.featureset import (  # noqa: F401
     FeatureSet,
     SlicedFeatureSet,
 )
+from analytics_zoo_tpu.data.giant_table import (  # noqa: F401
+    SyntheticGiantTable,
+)
 from analytics_zoo_tpu.data.image import (  # noqa: F401
     ImageFeature,
     ImagePreprocessing,

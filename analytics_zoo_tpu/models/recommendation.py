@@ -27,6 +27,39 @@ from analytics_zoo_tpu.nn.layers.core import Dense, Dropout, Flatten
 from analytics_zoo_tpu.nn.layers.embedding import Embedding, EmbeddingBag
 from analytics_zoo_tpu.nn.layers.merge import merge
 from analytics_zoo_tpu.nn.layers.recurrent import GRU
+from analytics_zoo_tpu.nn.layers.sharded_embedding import ShardedEmbeddingTable
+
+TABLE_PLACEMENTS = ("auto", "replicated", "sharded")
+
+
+def _route_tables(requested: str, tables: Sequence[Tuple[str, int, int]]
+                  ) -> Tuple[str, ...]:
+    """Which of ``(name, rows, dim)`` embedding tables get the sharded
+    layer.  ``replicated`` keeps every table on the original dense
+    layers (byte-for-byte the pre-sharding build); ``sharded`` forces
+    the sharded layer for ALL tables (its ROW_ALIGN-padded param shape
+    is topology-invariant, so checkpoints move across mesh widths even
+    if the current mesh can't actually split the rows — the layer just
+    lowers dense); ``auto`` asks the placement router per table from
+    its nbytes vs the device budget and the live mesh
+    (parallel/table_sharding.py, counted in
+    ``table_placement_selected_total``)."""
+    if requested not in TABLE_PLACEMENTS:
+        raise ValueError(f"table_placement must be one of "
+                         f"{TABLE_PLACEMENTS}, got {requested!r}")
+    if requested == "replicated":
+        return ()
+    from analytics_zoo_tpu.parallel.table_sharding import (
+        choose_table_placement, padded_rows)
+    picked = []
+    for name, rows, dim in tables:
+        nbytes = padded_rows(rows) * dim * 4
+        decision = choose_table_placement(nbytes=nbytes, rows=rows,
+                                          requested=requested)
+        if requested == "sharded" or decision.placement in ("sharded",
+                                                            "stream"):
+            picked.append(name)
+    return tuple(picked)
 
 
 class Recommender(ZooModel):
@@ -83,7 +116,7 @@ class NeuralCF(Recommender):
                  user_embed: int = 20, item_embed: int = 20,
                  hidden_layers: Sequence[int] = (40, 20, 10),
                  include_mf: bool = True, mf_embed: int = 20,
-                 dropout: float = 0.0):
+                 dropout: float = 0.0, table_placement: str = "auto"):
         super().__init__()
         if class_num < 2:
             # softmax over 1 class is constant 1.0 — the model would
@@ -107,6 +140,7 @@ class NeuralCF(Recommender):
         # regularization knob beyond the reference (its NeuralCF has no
         # dropout); applied between MLP tower layers at training time
         self.dropout = dropout
+        self.table_placement = table_placement
         self.build()
 
     def config(self):
@@ -115,7 +149,8 @@ class NeuralCF(Recommender):
                     item_embed=self.item_embed,
                     hidden_layers=list(self.hidden_layers),
                     include_mf=self.include_mf, mf_embed=self.mf_embed,
-                    dropout=self.dropout)
+                    dropout=self.dropout,
+                    table_placement=self.table_placement)
 
     def build(self):
         user = Input(shape=(1,), dtype=jnp.int32, name="user")
@@ -123,10 +158,22 @@ class NeuralCF(Recommender):
 
         # +1: ids are 1-based at the API surface (MovieLens convention kept
         # from the reference); row 0 is an unused pad row.
-        mlp_u = Flatten()(Embedding(self.user_count + 1, self.user_embed,
-                                    name="mlp_user_embed")(user))
-        mlp_i = Flatten()(Embedding(self.item_count + 1, self.item_embed,
-                                    name="mlp_item_embed")(item))
+        specs = [("mlp_user_embed", self.user_count + 1, self.user_embed),
+                 ("mlp_item_embed", self.item_count + 1, self.item_embed)]
+        if self.include_mf:
+            specs += [("mf_user_embed", self.user_count + 1, self.mf_embed),
+                      ("mf_item_embed", self.item_count + 1, self.mf_embed)]
+        sharded = _route_tables(self.table_placement, specs)
+
+        def embed(name, rows, dim, ids):
+            if name in sharded:
+                return ShardedEmbeddingTable(rows, dim, name=name)(ids)
+            return Embedding(rows, dim, name=name)(ids)
+
+        mlp_u = Flatten()(embed("mlp_user_embed", self.user_count + 1,
+                                self.user_embed, user))
+        mlp_i = Flatten()(embed("mlp_item_embed", self.item_count + 1,
+                                self.item_embed, item))
         h = merge([mlp_u, mlp_i], mode="concat")
         for k, width in enumerate(self.hidden_layers):
             h = Dense(width, activation="relu", name=f"mlp_dense_{k}")(h)
@@ -134,15 +181,20 @@ class NeuralCF(Recommender):
                 h = Dropout(self.dropout, name=f"mlp_drop_{k}")(h)
 
         if self.include_mf:
-            mf_u = Flatten()(Embedding(self.user_count + 1, self.mf_embed,
-                                       name="mf_user_embed")(user))
-            mf_i = Flatten()(Embedding(self.item_count + 1, self.mf_embed,
-                                       name="mf_item_embed")(item))
+            mf_u = Flatten()(embed("mf_user_embed", self.user_count + 1,
+                                   self.mf_embed, user))
+            mf_i = Flatten()(embed("mf_item_embed", self.item_count + 1,
+                                   self.mf_embed, item))
             gmf = merge([mf_u, mf_i], mode="mul")
             h = merge([gmf, h], mode="concat")
 
         out = Dense(self.class_num, activation="softmax", name="ncf_head")(h)
         self.model = Model([user, item], out, name="NeuralCF")
+        # manifests the Estimator reads: which tables shard over the
+        # model axis (strategy wrap), and which may grow rows elastically
+        # between a snapshot and a restore
+        self.model._sharded_tables = sharded
+        self.model._elastic_tables = tuple(n for n, _, _ in specs)
         return self
 
 
@@ -164,7 +216,8 @@ class WideAndDeep(Recommender):
                  embed_in_dims: Sequence[int] = (),
                  embed_out_dims: Sequence[int] = (),
                  continuous_cols: int = 0,
-                 hidden_layers: Sequence[int] = (40, 20, 10)):
+                 hidden_layers: Sequence[int] = (40, 20, 10),
+                 table_placement: str = "auto"):
         super().__init__()
         if class_num < 2:
             raise ValueError(
@@ -179,6 +232,7 @@ class WideAndDeep(Recommender):
         self.embed_out_dims = tuple(embed_out_dims)
         self.continuous_cols = continuous_cols
         self.hidden_layers = tuple(hidden_layers)
+        self.table_placement = table_placement
         self.build()
 
     def config(self):
@@ -189,12 +243,23 @@ class WideAndDeep(Recommender):
                     embed_in_dims=list(self.embed_in_dims),
                     embed_out_dims=list(self.embed_out_dims),
                     continuous_cols=self.continuous_cols,
-                    hidden_layers=list(self.hidden_layers))
+                    hidden_layers=list(self.hidden_layers),
+                    table_placement=self.table_placement)
 
     def build(self):
         inputs = []
         towers = []
         wide_dims = self.wide_base_dims + self.wide_cross_dims
+
+        specs = []
+        if self.model_type in ("wide", "wide_n_deep") and wide_dims:
+            specs.append(("wide_linear", int(np.sum(wide_dims)),
+                          self.class_num))
+        if self.model_type in ("deep", "wide_n_deep"):
+            specs += [(f"deep_embed_{k}", in_d + 1, out_d)
+                      for k, (in_d, out_d) in enumerate(
+                          zip(self.embed_in_dims, self.embed_out_dims))]
+        sharded = _route_tables(self.table_placement, specs)
 
         if self.model_type in ("wide", "wide_n_deep") and wide_dims:
             # wide input: one id per wide column, offset into a shared table
@@ -206,9 +271,15 @@ class WideAndDeep(Recommender):
             # Embedding followed by a Lambda-sum: the (B, n_wide,
             # class_num) gathered rows never materialise.  pad_id=None —
             # every wide id is a live feature (offsets start at 0).
-            wide_sum = EmbeddingBag(total, self.class_num, combiner="sum",
-                                    init="zero", pad_id=None,
-                                    name="wide_linear")(wide_in)
+            if "wide_linear" in sharded:
+                wide_sum = ShardedEmbeddingTable(
+                    total, self.class_num, combiner="sum", init="zero",
+                    pad_id=None, name="wide_linear")(wide_in)
+            else:
+                wide_sum = EmbeddingBag(total, self.class_num,
+                                        combiner="sum", init="zero",
+                                        pad_id=None,
+                                        name="wide_linear")(wide_in)
             towers.append(wide_sum)
 
         if self.model_type in ("deep", "wide_n_deep"):
@@ -225,8 +296,12 @@ class WideAndDeep(Recommender):
                 for k, (in_d, out_d) in enumerate(
                         zip(self.embed_in_dims, self.embed_out_dims)):
                     col = embed_in.slice(1, k, 1)
-                    deep_parts.append(Flatten()(
-                        Embedding(in_d + 1, out_d, name=f"deep_embed_{k}")(col)))
+                    name = f"deep_embed_{k}"
+                    layer = (ShardedEmbeddingTable(in_d + 1, out_d,
+                                                   name=name)
+                             if name in sharded
+                             else Embedding(in_d + 1, out_d, name=name))
+                    deep_parts.append(Flatten()(layer(col)))
             if self.continuous_cols:
                 cont_in = Input(shape=(self.continuous_cols,),
                                 name="continuous_input")
@@ -243,6 +318,8 @@ class WideAndDeep(Recommender):
         from analytics_zoo_tpu.nn.layers.core import Activation
         out = Activation("softmax", name="wnd_softmax")(logits)
         self.model = Model(inputs, out, name="WideAndDeep")
+        self.model._sharded_tables = sharded
+        self.model._elastic_tables = tuple(n for n, _, _ in specs)
         return self
 
 

@@ -1,0 +1,137 @@
+"""Row-sharded embedding table: the giant-vocabulary lookup layer.
+
+``ShardedEmbeddingTable`` is the mesh-scale sibling of ``Embedding`` /
+``EmbeddingBag`` (same id semantics, same fused-kernel lookup) whose
+``table`` param is row-partitioned over the mesh's ``model`` axis by
+``parallel.table_sharding.TableShardedStrategy``.  The layer itself is
+topology-agnostic:
+
+- its param shape is ALWAYS ``(padded_rows(input_dim), output_dim)``
+  (rows rounded up to ``ROW_ALIGN``), so the checkpoint layout is
+  identical whether the mesh shards the table 1/2/4/8 ways — that
+  invariance is what lets a 2-way snapshot restore onto a 1-way or
+  4-way mesh through the plain ``tree_put_global`` reshard path;
+- at trace time it consults ``current_table_sharding()`` (published by
+  the strategy's ``activate()``): when its own name is listed AND the
+  live mesh actually shards its rows, the lookup lowers to the
+  local-gather + single-psum exchange (``table_sharding.sharded_bag``);
+  otherwise it falls back to the ordinary dense ``embedding_bag`` /
+  ``embedding_gather`` lookup — same math, no collective.
+
+The padding rows are inert: initialized, never indexed by valid ids
+(vocab ids are ``< input_dim``), and their gradient is exactly zero, so
+they cost ``ROW_ALIGN·D·4`` bytes at most and nothing else.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from analytics_zoo_tpu.nn import initializers
+from analytics_zoo_tpu.nn.module import StatelessLayer
+from analytics_zoo_tpu.parallel.mode import current_table_sharding
+from analytics_zoo_tpu.parallel.table_sharding import (padded_rows,
+                                                       resolve_table_ways)
+
+
+class ShardedEmbeddingTable(StatelessLayer):
+    """Integer ids -> dense vectors, shardable row-wise over the model
+    mesh axis.
+
+    ``combiner=None`` gives ``Embedding`` semantics: ``(B, ...)`` int
+    ids -> ``(B, ..., dim)``.  ``combiner="sum"|"mean"|"sqrtn"`` gives
+    ``EmbeddingBag`` semantics: ``(B, n_ids)`` -> ``(B, dim)`` with the
+    bag combined in-kernel (``pad_id`` slots excluded).  Either way the
+    sharded lowering exchanges only the combined ``(B, D)`` (or the
+    gathered ``ids.shape + (D,)``) output via one psum — the table's
+    rows never leave their owning shard replicated.
+    """
+
+    def __init__(self, input_dim: int, output_dim: int,
+                 combiner: Optional[str] = None, init="uniform",
+                 pad_id: Optional[int] = None, trainable: bool = True,
+                 weights: Optional[np.ndarray] = None,
+                 zero_based: bool = True, axis: str = "model",
+                 dtype=jnp.float32, **kw):
+        super().__init__(**kw)
+        if combiner not in (None, "sum", "mean", "sqrtn"):
+            raise ValueError(f"combiner must be None|sum|mean|sqrtn, got "
+                             f"{combiner!r}")
+        self.input_dim = int(input_dim)
+        self.output_dim = int(output_dim)
+        self.combiner = combiner
+        self.initializer = initializers.get(init)
+        self.pad_id = pad_id
+        self.trainable = trainable
+        self.pretrained = weights
+        self.zero_based = zero_based
+        self.axis = axis
+        self.dtype = dtype
+
+    @property
+    def table_rows(self) -> int:
+        """The stored (ROW_ALIGN-padded) row count."""
+        return padded_rows(self.input_dim)
+
+    @property
+    def table_nbytes(self) -> int:
+        return self.table_rows * self.output_dim * \
+            jnp.dtype(self.dtype).itemsize
+
+    def build_params(self, rng, input_shape):
+        rows = self.table_rows
+        if self.pretrained is not None:
+            given = np.asarray(self.pretrained, jnp.dtype(self.dtype).name)
+            if given.shape not in ((self.input_dim, self.output_dim),
+                                   (rows, self.output_dim)):
+                raise ValueError(
+                    f"pretrained weights {given.shape} != "
+                    f"({self.input_dim}, {self.output_dim})")
+            if given.shape[0] < rows:    # pad tail rows with zeros
+                given = np.concatenate(
+                    [given, np.zeros((rows - given.shape[0],
+                                      self.output_dim), given.dtype)])
+            table = jnp.asarray(given, self.dtype)
+        else:
+            table = self.initializer(rng, (rows, self.output_dim),
+                                     self.dtype)
+        if self.pad_id is not None and 0 <= self.pad_id < rows:
+            table = table.at[self.pad_id].set(0.0)
+        return {"table": table}
+
+    def _sharding_for_trace(self):
+        """(mesh, axis) iff the active strategy shards THIS table on a
+        mesh that can actually split its rows; else None."""
+        mode = current_table_sharding()
+        if mode is None or self.name not in mode.tables:
+            return None
+        if resolve_table_ways(mode.mesh, mode.axis, self.table_rows) <= 1:
+            return None
+        return mode.mesh, mode.axis
+
+    def forward(self, params, ids, training=False, rng=None):
+        from analytics_zoo_tpu.ops.embedding_bag import (embedding_bag,
+                                                         embedding_gather)
+        from analytics_zoo_tpu.parallel.table_sharding import (
+            sharded_bag, sharded_gather)
+
+        table = params["table"]
+        if not self.trainable:
+            table = jax.lax.stop_gradient(table)
+        ids = ids.astype(jnp.int32)
+        if not self.zero_based:
+            ids = ids - 1
+        shard = self._sharding_for_trace()
+        if shard is None:                       # dense fallback
+            if self.combiner is None:
+                return embedding_gather(table, ids)
+            return embedding_bag(table, ids, self.combiner, self.pad_id)
+        mesh, axis = shard
+        if self.combiner is None:
+            return sharded_gather(table, ids, mesh=mesh, axis=axis)
+        return sharded_bag(table, ids, self.combiner, self.pad_id,
+                           mesh=mesh, axis=axis)

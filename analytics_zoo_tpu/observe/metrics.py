@@ -124,6 +124,12 @@ CATALOG: Dict[str, Tuple[str, str, Tuple[str, ...]]] = {
         "path and bounded reason code (cache_level_host | fits_budget "
         "| over_budget | sliced | stream_infeasible)",
         ("path", "reason")),
+    "table_placement_selected_total": (
+        "counter", "embedding-table placement router decisions "
+        "(replicated | sharded | stream), by bounded reason code "
+        "(requested | no_model_axis | axis_indivisible | fits_budget "
+        "| over_budget | sharded_over_budget)",
+        ("placement", "reason")),
     "prefetch_queue_depth": (
         "gauge", "batches queued ahead of the consumer in the prefetch "
         "pipeline", ()),

@@ -12,9 +12,26 @@ from analytics_zoo_tpu.parallel.sharding import (  # noqa: F401
 from analytics_zoo_tpu.parallel.mode import (  # noqa: F401
     PipelineMode,
     SeqParallelMode,
+    TableShardMode,
     current_pipeline,
     current_seq_parallel,
+    current_table_sharding,
     parallel_mode,
+    table_mode,
+)
+from analytics_zoo_tpu.parallel.table_sharding import (  # noqa: F401
+    ROW_ALIGN,
+    TablePlacement,
+    TableShardedStrategy,
+    choose_table_placement,
+    ensure_table_sharding,
+    grow_restored_opt_state,
+    grow_restored_tree,
+    init_table_sharded,
+    padded_rows,
+    resolve_table_ways,
+    sharded_bag,
+    sharded_gather,
 )
 from analytics_zoo_tpu.parallel.sequence import (  # noqa: F401
     ring_attention,

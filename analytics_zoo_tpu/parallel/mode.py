@@ -7,7 +7,10 @@ that have a parallel lowering consult this module:
 - ``MultiHeadAttention`` switches to ring attention over the sequence
   axis when ``current_seq_parallel()`` is set (parallel/sequence.py);
 - ``TransformerLayer(stacked=True)`` routes its block stack through the
-  GPipe schedule when ``current_pipeline()`` is set (parallel/pipeline.py).
+  GPipe schedule when ``current_pipeline()`` is set (parallel/pipeline.py);
+- ``ShardedEmbeddingTable`` lowers its lookup to the local-gather + psum
+  exchange when ``current_table_sharding()`` lists it
+  (parallel/table_sharding.py).
 
 This is trace-time-only state (a thread-local read while jit traces the
 step); the compiled program embeds the parallel lowering, so nothing here
@@ -20,7 +23,7 @@ from __future__ import annotations
 import contextlib
 import threading
 from dataclasses import dataclass
-from typing import Optional
+from typing import Optional, Tuple
 
 from jax.sharding import Mesh
 
@@ -47,6 +50,22 @@ class PipelineMode:
     batch_axis: Optional[str] = None   # compose pp with dp
 
 
+@dataclass(frozen=True)
+class TableShardMode:
+    """Row-sharded embedding lookup over ``mesh[axis]`` for the named
+    tables (parallel/table_sharding.py).  ``tables`` holds layer NAMES
+    — a ``ShardedEmbeddingTable`` only lowers to the sharded exchange
+    when its own name is listed, so strategies shard exactly the
+    tables the placement router picked."""
+    mesh: Mesh
+    axis: str
+    tables: Tuple[str, ...] = ()
+
+
+def current_table_sharding() -> Optional[TableShardMode]:
+    return getattr(_ACTIVE, "table", None)
+
+
 def current_seq_parallel() -> Optional[SeqParallelMode]:
     return getattr(_ACTIVE, "seq", None)
 
@@ -64,3 +83,17 @@ def parallel_mode(seq: Optional[SeqParallelMode] = None,
         yield
     finally:
         _ACTIVE.seq, _ACTIVE.pipe = prev
+
+
+@contextlib.contextmanager
+def table_mode(mode: Optional[TableShardMode]):
+    """Publish table sharding for the trace.  Deliberately separate
+    from ``parallel_mode`` (touches ONLY ``_ACTIVE.table``) so a
+    table-sharded strategy can wrap a seq/pipe base strategy without
+    clobbering the base's trace-time state."""
+    prev = getattr(_ACTIVE, "table", None)
+    _ACTIVE.table = mode
+    try:
+        yield
+    finally:
+        _ACTIVE.table = prev

@@ -282,6 +282,15 @@ class Estimator:
         if isinstance(strat, str):
             strat = make_strategy(strat, self.ctx.mesh)
         assert isinstance(strat, ShardingStrategy)
+        # models that routed embedding tables to the sharded placement
+        # carry a ``_sharded_tables`` manifest (models/recommendation.py);
+        # wrap the user's strategy so those tables split row-wise over
+        # the model axis and the trace sees the sharded lowering
+        tables = getattr(self.model, "_sharded_tables", None)
+        if tables:
+            from analytics_zoo_tpu.parallel.table_sharding import \
+                ensure_table_sharding
+            strat = ensure_table_sharding(strat, tables)
         return strat
 
     def _param_shardings(self, params):
@@ -292,26 +301,14 @@ class Estimator:
 
     def _opt_shardings(self):
         """Sharding tree for the optimizer state: subtrees shaped like the
-        params pytree (adam mu/nu, momentum...) take the param shardings;
-        everything else (step counts) is replicated."""
-        rep = self.ctx.replicated_sharding()
-        ptree = jax.tree_util.tree_structure(self.params)
-        pshard = self._param_shardings(self.params)
-        opt_shapes = jax.eval_shape(self.tx.init, self.params)
-
-        def is_params_like(sub):
-            try:
-                return jax.tree_util.tree_structure(sub) == ptree
-            except Exception:
-                return False
-
-        def map_sub(sub):
-            if is_params_like(sub):
-                return pshard
-            return jax.tree_util.tree_map(lambda _: rep, sub)
-
-        return jax.tree_util.tree_map(map_sub, opt_shapes,
-                                      is_leaf=is_params_like)
+        params pytree (adam mu/nu, momentum...) take the param shardings —
+        so e.g. a row-sharded embedding table's Adam moments are sharded
+        identically — everything else (step counts) is replicated
+        (train/optimizers.py opt_state_shardings)."""
+        from analytics_zoo_tpu.train.optimizers import opt_state_shardings
+        return opt_state_shardings(
+            self.tx, self.params, self._param_shardings(self.params),
+            self.ctx.replicated_sharding())
 
     def _ensure_built(self, inputs: List[np.ndarray]):
         if self.params is not None:
@@ -2146,6 +2143,20 @@ class Estimator:
         from analytics_zoo_tpu.parallel.sharding import tree_put_global
         step, tree = self._ckpt_mgr.restore()
         rep = self.ctx.replicated_sharding()
+        # Elastic table growth: if the live model was built with MORE
+        # embedding rows than the snapshot (vocabulary grew between
+        # runs), merge the restored rows into the freshly built tables —
+        # snapshot rows bit-exact, new rows keep fresh init, new rows'
+        # optimizer moments zero (== fresh tx.init).
+        tables = getattr(self.model, "_sharded_tables", None) or \
+            getattr(self.model, "_elastic_tables", None)
+        if tables and self.params is not None:
+            from analytics_zoo_tpu.parallel.table_sharding import (
+                grow_restored_opt_state, grow_restored_tree)
+            tree["params"] = grow_restored_tree(
+                tree["params"], self.params, tables)
+            tree["opt_state"] = grow_restored_opt_state(
+                tree["opt_state"], jax.eval_shape(self.tx.init, self.params))
         # tree_put_global is the reshard-on-restore seam: restore hands
         # back the FULL global host tree on every process, and placement
         # re-lays it onto whatever mesh is live now — so a checkpoint

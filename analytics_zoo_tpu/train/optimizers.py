@@ -7,15 +7,52 @@ reachable through string lowering (sgd/rmsprop/adagrad/adadelta/adamax).
 
 Everything returns an ``optax.GradientTransformation`` so the train step is
 one fused XLA program (no per-parameter Python loops).
+
+``opt_state_shardings`` is the partition rule that keeps optimizer
+state co-located with the params it updates: any opt-state subtree
+shaped like the params pytree (Adam mu/nu, SGD momentum, Adagrad
+accumulators...) inherits the params' shardings leaf-for-leaf — so a
+row-sharded embedding table's moments are row-sharded over the same
+mesh axis, and the update never allgathers them — while scalar
+bookkeeping (step counts) replicates.
 """
 
 from __future__ import annotations
 
 from typing import Callable, Optional, Union
 
+import jax
 import optax
 
 ScheduleOrFloat = Union[float, Callable[[int], float]]
+
+
+def opt_state_shardings(tx: optax.GradientTransformation, params,
+                        param_shardings, replicated):
+    """Sharding pytree for ``tx.init(params)``: params-shaped subtrees
+    take ``param_shardings`` (optimizer moments follow their params'
+    placement — the rule that keeps a sharded table's Adam state
+    sharded); every other leaf takes ``replicated``.
+
+    Matching is structural (``tree_structure`` equality against the
+    params pytree), so the rule covers any optax chain without
+    per-optimizer special cases."""
+    ptree = jax.tree_util.tree_structure(params)
+    opt_shapes = jax.eval_shape(tx.init, params)
+
+    def is_params_like(sub):
+        try:
+            return jax.tree_util.tree_structure(sub) == ptree
+        except Exception:
+            return False
+
+    def map_sub(sub):
+        if is_params_like(sub):
+            return param_shardings
+        return jax.tree_util.tree_map(lambda _: replicated, sub)
+
+    return jax.tree_util.tree_map(map_sub, opt_shapes,
+                                  is_leaf=is_params_like)
 
 
 def make_schedule(lr: ScheduleOrFloat, schedule: Optional[str] = None,

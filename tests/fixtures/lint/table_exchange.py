@@ -1,0 +1,37 @@
+# zoolint: hot-path
+"""zoolint fixture: the sharded embedding-table exchange idiom
+(parallel/table_sharding.py lookups).  Assembling a row-sharded
+lookup by pulling every model shard's partial rows to the host —
+one ``jax.device_get`` per shard per step — fires JG-TRANSFER-HOT:
+that is exactly the all-to-host exchange the psum path exists to
+avoid.  The shipped idiom combines the partials on-device in ONE
+collective exchange and syncs once on the combined handle, which is
+the twin that must stay quiet."""
+
+import jax
+
+
+def per_shard_host_exchange(table_shards, ids, lookup_fn):
+    parts = []
+    for shard in table_shards:
+        part = lookup_fn(shard, ids)
+        parts.append(jax.device_get(part))   # JG-TRANSFER-HOT fires:
+        # each shard's partial rows hauled to the host every step
+    return sum(parts)
+
+
+def per_shard_drain(table_shards, ids, lookup_fn):
+    parts = []
+    for shard in table_shards:
+        part = lookup_fn(shard, ids)
+        part.block_until_ready()             # JG-TRANSFER-HOT fires:
+        # dispatch drained once per shard
+        parts.append(part)
+    return parts
+
+
+def psum_exchange_ok(table_shards, ids, lookup_fn, combine_fn):
+    parts = [lookup_fn(shard, ids) for shard in table_shards]
+    total = combine_fn(parts)          # quiet: ONE on-device exchange
+    total.block_until_ready()          # quiet: ONE sync, after combine
+    return total

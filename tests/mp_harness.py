@@ -37,6 +37,7 @@ def run_workers(nproc: int, tmp_path, tag: str, *,
                 die_pid: Optional[int] = None,
                 barrier_timeout: Optional[float] = None,
                 data_budget: Optional[int] = None,
+                mesh: Optional[str] = None,
                 global_devices: int = 4,
                 timeout: float = 240,
                 expect_rc: Optional[Dict[int, int]] = None) -> List[Optional[dict]]:
@@ -74,6 +75,8 @@ def run_workers(nproc: int, tmp_path, tag: str, *,
             cmd += ["--barrier-timeout", str(barrier_timeout)]
         if data_budget is not None:
             cmd += ["--data-budget", str(data_budget)]
+        if mesh is not None:
+            cmd += ["--mesh", mesh]
         procs.append(subprocess.Popen(
             cmd, env=env, stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT, text=True))

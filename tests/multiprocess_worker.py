@@ -50,6 +50,17 @@ only the shard rows its devices own (docs/DATA.md "Multi-controller"):
                      barrier deadline instead of wedging on the dead
                      peer's collectives.
 
+Sharded-table scenarios (``table_*``) exercise the giant-embedding
+topology-change contract (parallel/table_sharding.py) across REAL
+process boundaries:
+
+- ``table_save``    — train a ``table_placement="sharded"`` NeuralCF on
+                      a ``--mesh`` with a model axis, snapshot, report
+                      per-table sha256 of the host-gathered rows.
+- ``table_restore`` — rebuild at this run's topology, restore the
+                      snapshot, report the same hashes (must be
+                      bit-identical whatever the process count).
+
 Replaces (and automates) the reference's manual two-executor
 integration script (pyzoo/test/zoo/ray/integration/ray_on_yarn.py:23-33).
 """
@@ -85,7 +96,8 @@ def parse_args(argv=None) -> argparse.Namespace:
                    choices=["train", "resume", "preempt", "die",
                             "die_save", "data_train", "data_resume",
                             "data_preempt", "data_die",
-                            "data_die_mid_epoch"])
+                            "data_die_mid_epoch", "table_save",
+                            "table_restore"])
     p.add_argument("--ckpt-dir", default="",
                    help="checkpoint directory (enables checkpointing)")
     p.add_argument("--die-step", type=int, default=4,
@@ -99,6 +111,9 @@ def parse_args(argv=None) -> argparse.Namespace:
                         "8-shard x 32-row rotation)")
     p.add_argument("--die-pid", type=int, default=-1,
                    help="process the fault targets (-1 = all)")
+    p.add_argument("--mesh", default="",
+                   help="mesh shape as 'DxM' (data x model axes, e.g. "
+                        "2x2); empty = the default data-only mesh")
     p.add_argument("--epochs", type=int, default=3)
     p.add_argument("--seed", type=int, default=7)
     p.add_argument("--barrier-timeout", type=float, default=20.0,
@@ -301,6 +316,70 @@ def _run_data(args, pid: int, nproc: int) -> None:
     raise SystemExit(f"unknown data scenario {args.scenario}")
 
 
+def _run_table(args, pid: int, nproc: int) -> None:
+    """Sharded embedding-table topology scenarios (``table_*``).
+
+    ``table_save`` trains a ``table_placement="sharded"`` NeuralCF on a
+    ``--mesh`` with a model axis and snapshots to ``--ckpt-dir``;
+    ``table_restore`` rebuilds at whatever topology THIS run was given
+    and restores the snapshot.  Both report a sha256 per table over the
+    host-gathered global rows, so the driving test can assert a 2-way
+    snapshot restores bit-exactly at 1-way / 4-way process counts —
+    the cross-process form of tests/test_sharded_embedding.py's
+    in-process topology tests.
+    """
+    import hashlib
+
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    from analytics_zoo_tpu.models.recommendation import NeuralCF
+
+    rs = np.random.RandomState(0)
+    n, g_batch = 64, 16
+    u = rs.randint(1, 32, (n, 1)).astype(np.int32)
+    i = rs.randint(1, 48, (n, 1)).astype(np.int32)
+    y = rs.randint(0, 2, (n,)).astype(np.int32)
+
+    model = NeuralCF(user_count=31, item_count=47, class_num=2,
+                     user_embed=8, item_embed=8, mf_embed=8,
+                     hidden_layers=(16, 8), table_placement="sharded")
+    model.compile(optimizer="adam",
+                  loss="sparse_categorical_crossentropy")
+    est = model.estimator
+
+    def table_hashes():
+        out = {}
+        for name, sub in est.params.items():
+            if "table" not in sub:
+                continue
+            host = np.asarray(multihost_utils.process_allgather(
+                sub["table"], tiled=True))
+            out[name] = hashlib.sha256(
+                np.ascontiguousarray(host).tobytes()).hexdigest()
+        return out
+
+    if args.scenario == "table_save":
+        est.set_checkpoint(args.ckpt_dir)
+        # data axis is process-major: feed this process's contiguous
+        # slice of every global batch (same layout as the train scenario)
+        local = g_batch // nproc
+        keep = np.concatenate([
+            np.arange(k * g_batch + pid * local,
+                      k * g_batch + (pid + 1) * local)
+            for k in range(n // g_batch)])
+        model.fit([u[keep], i[keep]], y[keep], batch_size=local,
+                  epochs=args.epochs, shuffle=False, verbose=False)
+    else:                                   # table_restore
+        est._ensure_built([u, i])
+        est.load_checkpoint(args.ckpt_dir)
+
+    with open(args.outfile, "w") as f:
+        json.dump({"process_id": pid, "scenario": args.scenario,
+                   "global_step": int(est.global_step),
+                   "table_hashes": table_hashes()}, f)
+
+
 def main() -> None:
     args = parse_args()
     pid, nproc = args.process_id, args.num_processes
@@ -320,6 +399,10 @@ def main() -> None:
     cfg_kw = dict(seed=args.seed,
                   dist_barrier_timeout_s=args.barrier_timeout,
                   async_checkpoint=bool(args.async_checkpoint))
+    if args.mesh:
+        dims = tuple(int(d) for d in args.mesh.split("x"))
+        cfg_kw.update(mesh_shape=dims,
+                      axis_names=("data", "model")[:len(dims)])
     if nproc > 1:
         ctx = init_zoo_context(
             multihost=True,
@@ -335,6 +418,10 @@ def main() -> None:
 
     if args.scenario.startswith("data_"):
         _run_data(args, pid, nproc)
+        return
+
+    if args.scenario.startswith("table_"):
+        _run_table(args, pid, nproc)
         return
 
     # deterministic problem; every process generates the full dataset and

@@ -57,3 +57,29 @@ def test_four_process_topology_from_cli(tmp_path):
     assert quad[0]["losses"] == pytest.approx(quad[3]["losses"], rel=1e-6)
     assert quad[0]["losses"] == pytest.approx(single["losses"], rel=1e-4)
     assert sum(q["pred_rows"] for q in quad) == 128
+
+
+@pytest.mark.slow
+def test_sharded_table_checkpoint_topology_change(tmp_path):
+    """The giant-embedding topology-change contract across REAL process
+    boundaries: two processes train NeuralCF with its tables sharded
+    2-ways over the model axis of a (2, 2) mesh and snapshot; the
+    snapshot then restores BIT-EXACTLY (sha256 per table over the
+    host-gathered global rows) on a 4-process (1, 4) mesh that shards
+    the same tables 4-ways, and on a single process with no model axis
+    at all — the multi-host form of tests/test_sharded_embedding.py's
+    in-process topology tests."""
+    ckpt = str(tmp_path / "table_ckpt")
+    save = run_workers(2, tmp_path, "tsave", scenario="table_save",
+                       ckpt_dir=ckpt, mesh="2x2", epochs=1)
+    want = save[0]["table_hashes"]
+    assert save[1]["table_hashes"] == want
+    assert set(want) == {"mlp_user_embed", "mlp_item_embed",
+                         "mf_user_embed", "mf_item_embed"}
+    for nproc, mesh, tag in ((4, "1x4", "trestore_tp4"),
+                             (1, None, "trestore_single")):
+        got = run_workers(nproc, tmp_path, tag, scenario="table_restore",
+                          ckpt_dir=ckpt, mesh=mesh)
+        for r in got:
+            assert r["table_hashes"] == want, tag
+            assert r["global_step"] == save[0]["global_step"]

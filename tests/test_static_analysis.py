@@ -90,6 +90,21 @@ def test_transfer_hot_fires_only_in_hot_modules():
     assert fixture_findings("cold_path.py") == []
 
 
+def test_table_exchange_fixture():
+    """The sharded embedding-table exchange idiom behind
+    parallel/table_sharding.py: assembling a row-sharded lookup by
+    hauling each model shard's partial rows to the host (or draining
+    dispatch per shard) fires JG-TRANSFER-HOT; the shipped lookup —
+    one on-device psum exchange, one sync on the combined handle —
+    stays quiet, so the giant-table serving path keeps a clean lint
+    bill by construction."""
+    fs = fixture_findings("table_exchange.py")
+    assert scopes_of(fs, "JG-TRANSFER-HOT") == \
+        {"per_shard_host_exchange", "per_shard_drain"}
+    assert "psum_exchange_ok" not in {f.scope for f in fs}
+    assert len(fs) == 2
+
+
 def test_concurrency_fixture():
     fs = fixture_findings("threads.py")
     assert scopes_of(fs, "THR-GUARD") == {"Counter.snapshot"}
