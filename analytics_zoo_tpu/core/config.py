@@ -151,6 +151,22 @@ class ZooConfig:
     # inside the serving forward — on TPU through the fused
     # dequantize-matmul kernel (ops/dequant_matmul.py).
     serving_weight_dtype: str = "float32"
+    # Persistent AOT compile cache (docs/SERVING.md "Warm start &
+    # multi-model"): directory where serialized XLA executables are
+    # stored per (model fingerprint, bucket signature, mesh); a
+    # restarted worker reaches full bucket coverage from disk instead
+    # of re-compiling.  Empty string = off.
+    serving_compile_cache_dir: str = ""
+    # Shared HBM budget for multi-model replica planning (0 = no cap):
+    # a replica-grow request that would push the summed weight bytes of
+    # every hosted model's replicas past this is refused.
+    serving_hbm_budget_bytes: int = 0
+    # Metrics-driven autoscaler (deploy/autoscale.py): grows/shrinks
+    # decode workers, per-model replicas and the batch deadline from
+    # the stage gauges, with hysteresis + cooldown.
+    serving_autoscale: bool = False
+    serving_autoscale_cooldown_s: float = 5.0
+    serving_autoscale_interval_s: float = 1.0
 
     # --- observability ---------------------------------------------------
     # Bounded ring of completed spans kept by observe.TRACER; any

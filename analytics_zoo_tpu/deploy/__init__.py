@@ -8,8 +8,12 @@ Redis-stream streaming inference), plus the Python client
 
 from analytics_zoo_tpu.deploy.inference import (  # noqa: F401
     BatchRequest, DynamicBatcher, InferenceModel, ModelReplica,
-    dequantize_pytree, imagenet_preprocess, quantize_pytree,
-    scatter_batch_results)
+    dequantize_pytree, imagenet_preprocess, plan_buckets,
+    quantize_pytree, scatter_batch_results)
+from analytics_zoo_tpu.deploy.autoscale import (  # noqa: F401
+    AutoscalePolicy, Autoscaler)
+from analytics_zoo_tpu.deploy.compile_cache import (  # noqa: F401
+    CompileCache, CompileCacheCorrupt)
 from analytics_zoo_tpu.deploy.codec import (  # noqa: F401
     pack_record, pack_result, packed_nbytes, unpack_record, unpack_result)
 from analytics_zoo_tpu.deploy.serving import (  # noqa: F401

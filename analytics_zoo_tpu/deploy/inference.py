@@ -35,7 +35,11 @@ import numpy as np
 
 __all__ = ["InferenceModel", "DynamicBatcher", "BatchRequest",
            "ModelReplica", "scatter_batch_results", "quantize_pytree",
-           "dequantize_pytree"]
+           "dequantize_pytree", "plan_buckets", "DEFAULT_MODEL"]
+
+# the implicit model name for single-model serving paths; multi-model
+# callers (ClusterServing with a dict of models) use their own names
+DEFAULT_MODEL = "default"
 
 
 def _as_tuple(x):
@@ -59,6 +63,25 @@ def _next_bucket(n: int, buckets: Sequence[int]) -> int:
         if n <= b:
             return b
     return buckets[-1]
+
+
+def plan_buckets(n: int, buckets: Sequence[int]) -> List[tuple]:
+    """Split ``n`` rows into ``[(rows, bucket), ...]`` chunks.
+
+    Full ``buckets[-1]``-row chunks first, then one tail chunk padded up
+    to its nearest bucket.  This is THE bucket-overflow policy: both the
+    compile-shape ledger (`InferenceModel.predict`) and the executor's
+    replica dispatch (`serving.DeviceExecutor._dispatch`) plan through
+    it, so the set of program shapes they produce can never disagree.
+    """
+    out: List[tuple] = []
+    cap = buckets[-1]
+    s = 0
+    while s < n:
+        m = min(n - s, cap)
+        out.append((m, _next_bucket(m, buckets)))
+        s += m
+    return out
 
 
 def _match_compute_dtype(p, s, xs):
@@ -215,21 +238,35 @@ class InferenceModel:
     """
 
     def __init__(self, forward: Callable, batch_buckets: Sequence[int] =
-                 (1, 8, 64, 256), dtype=None):
+                 (1, 8, 64, 256), dtype=None, name: str = DEFAULT_MODEL):
         """``forward``: fn(list_of_np_inputs_padded) -> np output(s) for a
-        full padded batch.  Wrapped by bucket padding in predict()."""
+        full padded batch.  Wrapped by bucket padding in predict().
+        ``name`` labels this model's series in every serving metric."""
         self._forward = forward
         self.batch_buckets = tuple(sorted(batch_buckets))
         self.dtype = dtype
+        self.name = str(name)
         # program-shape ledger: one entry per distinct batch signature
-        # actually dispatched — i.e. per compiled program.  Tests assert
+        # actually dispatched that paid a LIVE XLA compile.  Tests assert
         # on it to prove the bounded-program contract (novel large
         # batches split into full-bucket programs instead of compiling
-        # one-off shapes).
+        # one-off shapes).  Signatures pre-installed from the persistent
+        # compile cache land in ``_warm_shapes`` instead, so a warm
+        # restart holds ``compile_count == 0`` — the warm-start proof.
         self._seen_shapes = set()
+        self._warm_shapes = set()
         self._shape_lock = threading.Lock()
         self._net = None
         self._weight_dtype = "float32"
+        # persistent AOT compile cache (deploy/compile_cache.py):
+        # attached via attach_compile_cache(); _programs maps a JSON sig
+        # key to a loaded/compiled executable
+        self._cache = None
+        self._fingerprint_cache: Optional[str] = None
+        self._programs: Dict[str, Any] = {}
+        self._param_fwds: Dict[Any, Any] = {}
+        self._programs_lock = threading.Lock()
+        self._pred_weights = None
 
     # expose the bucket lowering on the class (callers/tests reach it as
     # InferenceModel._next_bucket)
@@ -238,23 +275,168 @@ class InferenceModel:
     def _note_shapes(self, xs, tag: str = "") -> bool:
         """Record the batch signature about to be dispatched; True (and a
         ``inference/novel_batch_shape`` counter bump) on first sight —
-        i.e. when this dispatch pays an XLA compile."""
+        i.e. when this dispatch pays an XLA compile.  Signatures the
+        compile cache pre-installed (``warm()``) are not novel: their
+        executable is already resident, no compile is paid."""
         sig = (tag,) + tuple((tuple(np.shape(x)),
                               str(getattr(x, "dtype", ""))) for x in xs)
         with self._shape_lock:
-            if sig in self._seen_shapes:
+            if sig in self._seen_shapes or sig in self._warm_shapes:
                 return False
             self._seen_shapes.add(sig)
-        from analytics_zoo_tpu.core.profiling import count_event
+            live = len(self._seen_shapes)
+        from analytics_zoo_tpu.observe import metrics as obs
 
-        count_event("inference/novel_batch_shape")
+        obs.count("inference_novel_batch_shapes_total", model=self.name,
+                  flat="inference/novel_batch_shape")
+        obs.set_gauge("inference_compile_count", live, model=self.name)
         return True
 
     @property
     def compile_count(self) -> int:
-        """Number of distinct program shapes dispatched so far."""
+        """Number of distinct program shapes that paid a live compile
+        (cache-warmed shapes excluded)."""
         with self._shape_lock:
             return len(self._seen_shapes)
+
+    @property
+    def warm_count(self) -> int:
+        """Number of program shapes pre-installed from the compile cache."""
+        with self._shape_lock:
+            return len(self._warm_shapes)
+
+    # -- persistent AOT compile cache --------------------------------------
+    def fingerprint(self) -> str:
+        """Content hash of this model's weights: net class + weight dtype
+        + per-leaf (path, shape, dtype, CRC32 of the bytes).  The compile
+        cache keys on it so an executable can never be replayed against
+        different weights/architecture than it was compiled for."""
+        if self._fingerprint_cache is not None:
+            return self._fingerprint_cache
+        import hashlib
+        import struct
+        import zlib
+
+        h = hashlib.sha256()
+        h.update((type(self._net).__name__ if self._net is not None
+                  else "<fn>").encode())
+        h.update(self._weight_dtype.encode())
+        weights = (self._qparams if getattr(self, "_int8", False)
+                   else getattr(self, "_params", None))
+        for tree in (weights, getattr(self, "_state", None)):
+            if tree is None:
+                continue
+            for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+                a = np.asarray(leaf)
+                h.update(jax.tree_util.keystr(path).encode())
+                h.update(str(a.shape).encode())
+                h.update(str(a.dtype).encode())
+                h.update(struct.pack(
+                    "<I", zlib.crc32(a.tobytes()) & 0xFFFFFFFF))
+        self._fingerprint_cache = h.hexdigest()[:16]
+        return self._fingerprint_cache
+
+    def weight_nbytes(self) -> int:
+        """Per-replica HBM weight footprint — what the multi-model HBM
+        budget (`serving_hbm_budget_bytes`) charges per replica slot.
+        Function/foreign models have no explicit weight tree: 0."""
+        weights = (self._qparams if getattr(self, "_int8", False)
+                   else getattr(self, "_params", None))
+        if weights is None:
+            return 0
+        total = 0
+        for leaf in jax.tree_util.tree_leaves(weights):
+            total += np.asarray(leaf).nbytes
+        for leaf in jax.tree_util.tree_leaves(getattr(self, "_state", None)
+                                              or {}):
+            total += np.asarray(leaf).nbytes
+        return total
+
+    def attach_compile_cache(self, cache, name: Optional[str] = None
+                             ) -> "InferenceModel":
+        """Wire a ``deploy.compile_cache.CompileCache`` into the dispatch
+        path: every bucketed program is AOT-lowered
+        (``fwd.lower(...).compile()``), persisted on first compile, and
+        reloaded from disk on the next process start (``warm()``).
+
+        Only models with a native net qualify — foreign forwards
+        (TF/torch/function) have no param-explicit program to serialize.
+        """
+        if self._net is None:
+            raise ValueError(
+                "attach_compile_cache needs a native net (from_keras_net/"
+                "load); TF/torch/function models have no param-explicit "
+                "XLA program to serialize")
+        self._cache = cache
+        if name:
+            self.name = str(name)
+        return self
+
+    @staticmethod
+    def _aot_sig(xs, device, top_n) -> Dict[str, Any]:
+        """JSON-able program signature: input shapes/dtypes + target
+        device + fused top-N.  Joined with ``fingerprint()`` (and the
+        mesh descriptor, added by the cache) it addresses one executable."""
+        return {"in": [[list(np.shape(x)), str(getattr(x, "dtype", ""))]
+                       for x in xs],
+                "dev": str(device) if device is not None else "",
+                "top_n": int(top_n or 0)}
+
+    @staticmethod
+    def _warm_sig(sig: Dict[str, Any]):
+        """The ``_note_shapes`` ledger key a cached sig corresponds to."""
+        return ((sig.get("dev", ""),)
+                + tuple((tuple(s), d) for s, d in sig["in"]))
+
+    def _param_forward_for(self, top_n):
+        with self._programs_lock:
+            fwd = self._param_fwds.get(top_n)
+            if fwd is None:
+                fwd = self._build_param_forward(top_n=top_n)
+                self._param_fwds[top_n] = fwd
+        return fwd
+
+    def _aot_program(self, p, s, xs, device=None, top_n=None):
+        """The executable for one program signature: in-memory table →
+        disk cache → live ``lower().compile()`` (which is then persisted
+        so the NEXT process start skips it)."""
+        sig = self._aot_sig(xs, device, top_n)
+        import json
+        key = json.dumps(sig, sort_keys=True)
+        with self._programs_lock:
+            prog = self._programs.get(key)
+        if prog is not None:
+            return prog
+        prog = self._cache.load(self.fingerprint(), sig, model=self.name)
+        if prog is None:
+            fwd = self._param_forward_for(top_n)
+            prog = fwd.lower(p, s, *xs).compile()
+            self._cache.store(self.fingerprint(), sig, prog,
+                              model=self.name)
+        with self._programs_lock:
+            self._programs[key] = prog
+        return prog
+
+    def warm(self) -> int:
+        """Pre-install every cached executable for this model's
+        fingerprint.  A restarted process reaches full bucket coverage
+        here, in deserialization time, instead of after N live compiles
+        — and ``compile_count`` stays 0 for every warmed shape (the
+        acceptance proof for the ``serving_restart_to_slo`` bench).
+        Returns the number of programs installed."""
+        if self._cache is None:
+            return 0
+        import json
+        n = 0
+        for sig, prog in self._cache.load_all(self.fingerprint(),
+                                              model=self.name):
+            key = json.dumps(sig, sort_keys=True)
+            with self._programs_lock:
+                self._programs[key] = prog
+            with self._shape_lock:
+                self._warm_shapes.add(self._warm_sig(sig))
+            n += 1
+        return n
 
     # -- loaders -----------------------------------------------------------
     @classmethod
@@ -418,8 +600,12 @@ class InferenceModel:
                 # immediately with future-backed arrays — readback (the
                 # only blocking part) happens in harvest()
                 self._note_shapes(xs, tag=str(_d))
-                return fwd(_p, _s, *[jax.device_put(jnp.asarray(x), _d)
-                                     for x in xs])
+                xd = [jax.device_put(jnp.asarray(x), _d) for x in xs]
+                if self._cache is not None:
+                    prog = self._aot_program(_p, _s, xd, device=_d,
+                                             top_n=top_n)
+                    return prog(_p, _s, *xd)
+                return fwd(_p, _s, *xd)
 
             def harvest(h):
                 hs = h if isinstance(h, (list, tuple)) else [h]
@@ -567,18 +753,38 @@ class InferenceModel:
                 [x, np.repeat(x[-1:], bucket - n, axis=0)], axis=0)
                 for x in xs]
         elif bucket < n:  # larger than biggest bucket (or capped): chunk
-            outs = [self.predict([x[s:s + bucket] for x in xs],
-                                 batch_size=bucket)
-                    for s in range(0, n, bucket)]
+            eff = (tuple(b for b in self.batch_buckets if b <= bucket)
+                   or (bucket,))
+            outs, s = [], 0
+            for m, b in plan_buckets(n, eff):
+                outs.append(self.predict([x[s:s + m] for x in xs],
+                                         batch_size=b))
+                s += m
             if isinstance(outs[0], list):
                 return [np.concatenate([o[i] for o in outs], axis=0)
                         for i in range(len(outs[0]))]
             return np.concatenate(outs, axis=0)
         self._note_shapes(xs)
-        out = self._forward(xs)
+        if self._cache is not None and self._net is not None:
+            out = self._aot_forward(xs)
+        else:
+            out = self._forward(xs)
         if isinstance(out, (list, tuple)):
             return [np.asarray(o)[:n] for o in out]
         return np.asarray(out)[:n]
+
+    def _aot_forward(self, xs):
+        """Cache-backed predict() forward: same program as the closure-
+        jitted ``_forward`` but param-explicit, so it routes through the
+        persistent AOT table (warm shapes execute with zero live
+        compiles)."""
+        if self._pred_weights is None:
+            w = self._qparams if self._int8 else self._params
+            self._pred_weights = (w, self._state)
+        p, s = self._pred_weights
+        xj = [jnp.asarray(x) for x in xs]
+        prog = self._aot_program(p, s, xj, device=None, top_n=None)
+        return prog(p, s, *xj)
 
     # reference predict-API aliases (InferenceModel.scala:762-830)
     do_predict = predict
@@ -603,17 +809,22 @@ class BatchRequest:
     ``DeadlineExpired`` instead of wasting a device slot.  ``span``
     (optional observe.Span) is the record's batch_wait leg — the
     batcher ends it when the request flushes, sheds, or the batcher
-    closes, so the request's timeline never dangles."""
+    closes, so the request's timeline never dangles.  ``model`` names
+    the target model in a multi-model pipeline (None = single-model
+    legacy path); it rides into the bucket key so two models' requests
+    never fuse, and into every per-request metric as a label."""
 
-    __slots__ = ("xs", "n", "callback", "t_submit", "deadline", "span")
+    __slots__ = ("xs", "n", "callback", "t_submit", "deadline", "span",
+                 "model")
 
-    def __init__(self, xs, callback, deadline=None, span=None):
+    def __init__(self, xs, callback, deadline=None, span=None, model=None):
         self.xs = xs
         self.n = xs[0].shape[0]
         self.callback = callback
         self.t_submit = time.monotonic()
         self.deadline = deadline
         self.span = span
+        self.model = model
 
 
 def scatter_batch_results(out, reqs: List[BatchRequest]) -> None:
@@ -676,18 +887,22 @@ class DynamicBatcher:
 
     # -- front doors -------------------------------------------------------
     def submit(self, inputs, callback: Callable,
-               deadline: Optional[float] = None, span=None) -> None:
+               deadline: Optional[float] = None, span=None,
+               model: Optional[str] = None) -> None:
         """Async enqueue; ``callback(out, error)`` fires from the
         dispatch side when this request's slice is ready.  ``deadline``
         (monotonic) sheds the request with ``DeadlineExpired`` if it is
         still queued when the bucket flushes past it.  ``span`` is the
-        caller's batch_wait span, ended by the batcher at flush/shed."""
+        caller's batch_wait span, ended by the batcher at flush/shed.
+        ``model`` scopes the bucket: requests for different models never
+        fuse into one device batch."""
         if self._stop.is_set():
             raise RuntimeError("DynamicBatcher is closed")
         xs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
         xs = [np.asarray(x) for x in xs]
-        req = BatchRequest(xs, callback, deadline=deadline, span=span)
-        key = self._key(xs)
+        req = BatchRequest(xs, callback, deadline=deadline, span=span,
+                           model=model)
+        key = self._key(xs) if model is None else (model,) + self._key(xs)
         full_reqs = None
         with self._cv:
             self._buckets.setdefault(key, []).append(req)
@@ -836,8 +1051,10 @@ class DynamicBatcher:
             # shed before paying the dispatch: the client's TTL already
             # elapsed while the request batched, so answer the typed
             # error now and keep the device slot for live work
-            obs.count("serving_shed_total", len(expired), code="expired",
-                      flat=f"{self.name}/shed_expired")
+            for r in expired:
+                obs.count("serving_shed_total", code="expired",
+                          model=r.model or DEFAULT_MODEL,
+                          flat=f"{self.name}/shed_expired")
             err = DeadlineExpired(
                 "client TTL expired while the request batched")
             for r in expired:
@@ -851,7 +1068,8 @@ class DynamicBatcher:
                     else f"{self.name}/flush_deadline")
         for r in reqs:
             obs.observe("serving_stage_seconds", now - r.t_submit,
-                        stage="batch_wait", flat=f"{self.name}/batch_wait")
+                        stage="batch_wait", model=r.model or DEFAULT_MODEL,
+                        flat=f"{self.name}/batch_wait")
             if r.span is not None:
                 r.span.end(rows=r.n, full=full)
         try:

@@ -70,7 +70,7 @@ import numpy as np
 from analytics_zoo_tpu.core.profiling import TIMERS
 from analytics_zoo_tpu.deploy import codec as wire_codec
 from analytics_zoo_tpu.deploy.inference import (
-    DynamicBatcher, _next_bucket, scatter_batch_results)
+    DEFAULT_MODEL, DynamicBatcher, plan_buckets, scatter_batch_results)
 from analytics_zoo_tpu.observe import metrics as obs
 from analytics_zoo_tpu.observe.export import JsonlEventLog, to_prometheus
 from analytics_zoo_tpu.observe.recorder import SLO, FlightRecorder
@@ -648,7 +648,8 @@ class InputQueue:
         return float(ttl_ms)
 
     def enqueue(self, uri: Optional[str] = None,
-                ttl_ms: Optional[float] = None, **data) -> str:
+                ttl_ms: Optional[float] = None,
+                model: Optional[str] = None, **data) -> str:
         """Enqueue arbitrary named arrays (reference enqueue:58).
 
         Native-client records carry ``ts`` (enqueue wall-clock, feeding
@@ -669,6 +670,10 @@ class InputQueue:
         ttl = self._validated_ttl(ttl_ms)
         if ttl is not None:
             rec["ttl_ms"] = ttl
+        if model is not None:
+            # routes the record to one named model in a multi-model
+            # worker; rides the record meta (str, not a tensor field)
+            rec["model"] = str(model)
         if not data:
             raise MalformedRecordError("record carries no tensor fields")
         for k, v in data.items():
@@ -772,12 +777,19 @@ class ServingConfig:
                  harvest_deadline_s: float = 30.0,
                  default_ttl_ms: Optional[float] = None,
                  supervise: bool = True,
-                 slo_p99_ms: float = 0.0,
+                 slo_p99_ms=0.0,
                  slo_window_s: float = 5.0,
                  flight_dir: Optional[str] = None,
                  jsonl_path: Optional[str] = None,
                  profile_on_breach: bool = False,
-                 span_ring: Optional[int] = None):
+                 span_ring: Optional[int] = None,
+                 compile_cache_dir: Optional[str] = None,
+                 compile_cache_entries: int = 512,
+                 hbm_budget_bytes: int = 0,
+                 autoscale: bool = False,
+                 autoscale_cooldown_s: float = 5.0,
+                 autoscale_interval_s: float = 1.0,
+                 autoscale_policy=None):
         self.model_path = model_path
         self.batch_size = batch_size
         self.backpressure_maxlen = backpressure_maxlen
@@ -799,13 +811,42 @@ class ServingConfig:
         self.supervise = supervise
         # observability (docs/OBSERVABILITY.md): slo_p99_ms > 0 arms the
         # flight recorder's e2e-p99 SLO; breaker trips are watched
-        # regardless whenever supervision is on
-        self.slo_p99_ms = float(slo_p99_ms)
+        # regardless whenever supervision is on.  Multi-model workers
+        # pass a dict {model: p99_ms} — each model gets its own SLO
+        # series and admission weight (docs/SERVING.md).
+        if isinstance(slo_p99_ms, dict):
+            self.slo_p99_ms = {str(k): float(v)
+                               for k, v in slo_p99_ms.items()}
+        else:
+            self.slo_p99_ms = float(slo_p99_ms)
         self.slo_window_s = float(slo_window_s)
         self.flight_dir = flight_dir
         self.jsonl_path = jsonl_path
         self.profile_on_breach = bool(profile_on_breach)
         self.span_ring = span_ring
+        # warm start + capacity control (docs/SERVING.md "Warm start &
+        # multi-model")
+        self.compile_cache_dir = compile_cache_dir or None
+        self.compile_cache_entries = max(1, int(compile_cache_entries))
+        self.hbm_budget_bytes = max(0, int(hbm_budget_bytes or 0))
+        self.autoscale = bool(autoscale)
+        self.autoscale_cooldown_s = float(autoscale_cooldown_s)
+        self.autoscale_interval_s = float(autoscale_interval_s)
+        self.autoscale_policy = autoscale_policy
+
+    def slo_for(self, model: str) -> float:
+        """The e2e-p99 SLO (ms) for one model: its dict entry, or the
+        scalar applied to every model; 0.0 = unbounded."""
+        if isinstance(self.slo_p99_ms, dict):
+            return float(self.slo_p99_ms.get(model, 0.0))
+        return float(self.slo_p99_ms)
+
+    def slo_models(self) -> Dict[str, float]:
+        """Every model with a nonzero SLO (empty for scalar configs —
+        the scalar arms the legacy unlabeled watcher instead)."""
+        if isinstance(self.slo_p99_ms, dict):
+            return {m: v for m, v in self.slo_p99_ms.items() if v > 0}
+        return {}
 
     @classmethod
     def from_yaml(cls, path: str) -> "ServingConfig":
@@ -837,7 +878,12 @@ class ServingConfig:
             jsonl_path=zoo_cfg.observe_jsonl_path or None,
             profile_on_breach=zoo_cfg.observe_profile_on_breach,
             span_ring=zoo_cfg.observe_span_ring,
-            tensorboard_dir=zoo_cfg.tensorboard_dir)
+            tensorboard_dir=zoo_cfg.tensorboard_dir,
+            compile_cache_dir=zoo_cfg.serving_compile_cache_dir or None,
+            hbm_budget_bytes=zoo_cfg.serving_hbm_budget_bytes,
+            autoscale=zoo_cfg.serving_autoscale,
+            autoscale_cooldown_s=zoo_cfg.serving_autoscale_cooldown_s,
+            autoscale_interval_s=zoo_cfg.serving_autoscale_interval_s)
         kw.update(overrides)
         return cls(**kw)
 
@@ -861,14 +907,15 @@ def _decode_record(rec: Dict) -> Dict[str, np.ndarray]:
 
 class _ReplicaSlot:
     """One supervised replica position: the replica object, its circuit
-    breaker, and the rebuild bookkeeping."""
+    breaker, the owning model's name, and the rebuild bookkeeping."""
 
-    __slots__ = ("replica", "breaker", "index", "rebuilt")
+    __slots__ = ("replica", "breaker", "index", "rebuilt", "model")
 
-    def __init__(self, replica, breaker, index):
+    def __init__(self, replica, breaker, index, model=DEFAULT_MODEL):
         self.replica = replica
         self.breaker = breaker
         self.index = index
+        self.model = model
         self.rebuilt = False    # set by rebuild_slot; cleared (and
         #                         counted as restored) on first success
 
@@ -883,13 +930,14 @@ class _Batch:
 
     __slots__ = ("key", "fused", "reqs", "attempt", "slot", "handles",
                  "t_dispatch", "t_harvest", "claimed", "first_blocked_t",
-                 "span")
+                 "span", "model")
 
-    def __init__(self, key, fused, reqs, attempt=0):
+    def __init__(self, key, fused, reqs, attempt=0, model=DEFAULT_MODEL):
         self.key = key
         self.fused = fused
         self.reqs = reqs
         self.attempt = attempt
+        self.model = model
         self.slot = None
         self.handles = None
         self.t_dispatch = None
@@ -899,8 +947,35 @@ class _Batch:
         self.span = None  # device-batch span linking member traces
 
 
+class _ModelGroup:
+    """One named model's executor state: its replica slots, round-robin
+    cursor, shape buckets and (optional) sync fallback.  The executor
+    multiplexes every group over the same dispatch/harvest threads and
+    inflight budget — the chips don't care which model a batch belongs
+    to, only the slots and ledgers are per-model."""
+
+    __slots__ = ("name", "slots", "rr", "buckets", "fallback")
+
+    def __init__(self, name, slots, buckets, fallback=None):
+        self.name = name
+        self.slots = slots
+        self.rr = 0
+        self.buckets = tuple(sorted(buckets))
+        self.fallback = fallback
+
+
 class DeviceExecutor:
     """Stage 3: keeps the chips busy with double-buffered async dispatch.
+
+    Multi-model (docs/SERVING.md "Warm start & multi-model"): the
+    ``replicas`` / ``buckets`` / ``fallback`` ctor arguments accept
+    either the legacy single-model shapes (a list / a tuple / one
+    callable — they become the ``"default"`` model) or dicts keyed by
+    model name.  One executor then multiplexes N models over the same
+    dispatch+harvest threads and ``max_inflight`` budget, with
+    *per-model* replica slots, breaker quarantine, round-robin cursors
+    and bucket sets; every batch carries its model name into the
+    ``{model}`` label of the serving metrics.
 
     A dispatch thread pulls full batches off a bounded inbox, pads them
     to the model's shape buckets, round-robins them over per-device
@@ -932,19 +1007,20 @@ class DeviceExecutor:
 
     IDLE_EPS_S = 0.005  # harvest→dispatch gaps above this count as idle
 
-    def __init__(self, replicas: List, buckets=(1, 32),
+    def __init__(self, replicas, buckets=(1, 32),
                  max_inflight: int = 2, name: str = "serving",
                  breaker_threshold: int = 3, breaker_cooldown_s: float = 2.0,
-                 fallback: Optional[Callable] = None, max_retries: int = 2):
-        if not replicas:
-            raise ValueError("DeviceExecutor needs at least one replica")
-        self.buckets = tuple(sorted(buckets))
+                 fallback=None, max_retries: int = 2):
+        rep_map = (dict(replicas) if isinstance(replicas, dict)
+                   else {DEFAULT_MODEL: list(replicas or [])})
+        if not rep_map or not all(rep_map.values()):
+            raise ValueError("DeviceExecutor needs at least one replica "
+                             "per model")
         self.max_inflight = max(1, int(max_inflight))
         self.name = name
         self.breaker_threshold = max(1, int(breaker_threshold))
         self.breaker_cooldown_s = float(breaker_cooldown_s)
         self.max_retries = max(0, int(max_retries))
-        self._fallback = fallback
         self._heartbeat: Optional[Callable[[], None]] = None
         self._inbox: "pyqueue.Queue" = pyqueue.Queue(
             maxsize=max(2, self.max_inflight * 4))
@@ -952,13 +1028,22 @@ class DeviceExecutor:
             maxsize=self.max_inflight)
         self._retryq: "deque[_Batch]" = deque()
         self._lock = threading.Lock()
-        self._slots: List[_ReplicaSlot] = self._make_slots(replicas)
+        bucket_map = buckets if isinstance(buckets, dict) else {}
+        fb_map = fallback if isinstance(fallback, dict) else {}
+        self._groups: Dict[str, _ModelGroup] = {}
+        for mname, reps in rep_map.items():
+            self._groups[mname] = _ModelGroup(
+                mname, self._make_slots(reps, mname),
+                bucket_map.get(mname, buckets if not isinstance(
+                    buckets, dict) else (1, 32)),
+                fb_map.get(mname) if isinstance(fallback, dict)
+                else fallback)
+        self._default_model = next(iter(self._groups))
         self._inflight = 0
-        self._rr = 0
         self._last_harvest_t: Optional[float] = None
         self._harvesting: Optional[_Batch] = None
         self._harvest_epoch = 0
-        self._swap: Optional[List] = None
+        self._swap: Optional[Dict[str, List]] = None
         self._stop = threading.Event()
         self._log = logging.getLogger("analytics_zoo_tpu.deploy")
         self._dispatch_thread = threading.Thread(
@@ -969,18 +1054,45 @@ class DeviceExecutor:
         self._dispatch_thread.start()
         self._harvest_thread.start()
 
-    def _make_slots(self, replicas: List) -> List["_ReplicaSlot"]:
+    def _make_slots(self, replicas: List, model: str = DEFAULT_MODEL
+                    ) -> List["_ReplicaSlot"]:
+        prefix = (f"{self.name}_replica" if model == DEFAULT_MODEL
+                  else f"{self.name}_{model}_replica")
         return [_ReplicaSlot(
             rep, CircuitBreaker(failure_threshold=self.breaker_threshold,
                                 cooldown_s=self.breaker_cooldown_s,
-                                name=f"{self.name}_replica{i}"), i)
+                                name=f"{prefix}{i}"), i, model=model)
             for i, rep in enumerate(replicas)]
+
+    # -- legacy single-model views (tests/callers from before multi-model
+    # address the default group through these) -----------------------------
+    @property
+    def _slots(self) -> List["_ReplicaSlot"]:
+        return self._groups[self._default_model].slots
+
+    @property
+    def buckets(self) -> tuple:
+        return self._groups[self._default_model].buckets
+
+    @property
+    def _fallback(self):
+        return self._groups[self._default_model].fallback
+
+    def models(self) -> List[str]:
+        return list(self._groups)
+
+    def group_size(self, model: str) -> int:
+        with self._lock:
+            g = self._groups.get(model)
+            return len(g.slots) if g is not None else 0
 
     @property
     def replicas(self) -> List:
-        """The live replica objects (compat view over the slots)."""
+        """The live replica objects (compat view over the slots; every
+        group's slots flattened in insertion order)."""
         with self._lock:
-            return [s.replica for s in self._slots]
+            return [s.replica for g in self._groups.values()
+                    for s in g.slots]
 
     # -- producer side -----------------------------------------------------
     def submit(self, key, fused: List[np.ndarray], reqs: List) -> None:
@@ -989,7 +1101,9 @@ class DeviceExecutor:
         pipeline's backpressure toward the batcher/decoders."""
         if self._stop.is_set():
             raise RuntimeError("DeviceExecutor is stopped")
-        self._inbox.put(_Batch(key, fused, reqs))
+        model = (getattr(reqs[0], "model", None) if reqs else None) \
+            or self._default_model
+        self._inbox.put(_Batch(key, fused, reqs, model=model))
 
     def busy(self) -> bool:
         """True while any batch is dispatched-but-not-harvested."""
@@ -1001,12 +1115,21 @@ class DeviceExecutor:
         with self._lock:
             return self._inflight
 
-    def swap_replicas(self, replicas: List) -> None:
+    def swap_replicas(self, replicas, model: Optional[str] = None) -> None:
         """Hot reload: the new replica set takes over at the next
         dispatch (in-flight batches finish on the old weights).  The new
-        slots start with fresh (closed) breakers."""
+        slots start with fresh (closed) breakers.  ``replicas`` may be a
+        list (the default — or the named — model) or a dict of per-model
+        lists; partial swaps merge into one pending swap."""
+        if isinstance(replicas, dict):
+            swap = {str(k): list(v) for k, v in replicas.items()}
+        else:
+            swap = {model or self._default_model: list(replicas)}
         with self._lock:
-            self._swap = list(replicas)
+            if self._swap is None:
+                self._swap = swap
+            else:
+                self._swap.update(swap)
 
     def stop(self, timeout: float = 5.0) -> None:
         self._stop.set()
@@ -1020,18 +1143,22 @@ class DeviceExecutor:
     # -- supervision surface ----------------------------------------------
     def replica_states(self) -> List[Dict[str, Any]]:
         """Per-slot health for ``health()``: breaker state machine plus
-        device identity."""
+        device identity and owning model."""
         with self._lock:
-            slots = list(self._slots)
-        return [dict(slot=s.index,
+            slots = [s for g in self._groups.values() for s in g.slots]
+        return [dict(slot=s.index, model=s.model,
                      device=str(getattr(s.replica, "device", "host")),
                      rebuilt_pending_probe=s.rebuilt,
                      **s.breaker.snapshot())
                 for s in slots]
 
-    def healthy_replicas(self) -> int:
+    def healthy_replicas(self, model: Optional[str] = None) -> int:
         with self._lock:
-            slots = list(self._slots)
+            if model is not None:
+                g = self._groups.get(model)
+                slots = list(g.slots) if g is not None else []
+            else:
+                slots = [s for g in self._groups.values() for s in g.slots]
         return sum(1 for s in slots if s.breaker.health != "quarantined")
 
     def quarantined_slots(self, min_open_s: float = 0.0
@@ -1043,7 +1170,7 @@ class DeviceExecutor:
         persistently-bad replica cycles probes without ever *aging* in
         the open state."""
         with self._lock:
-            slots = list(self._slots)
+            slots = [s for g in self._groups.values() for s in g.slots]
         out = []
         for s in slots:
             snap = s.breaker.snapshot()
@@ -1052,12 +1179,17 @@ class DeviceExecutor:
                 out.append(s)
         return out
 
-    def rebuild_slot(self, index: int, replica) -> None:
+    def rebuild_slot(self, index: int, replica,
+                     model: Optional[str] = None) -> None:
         """Supervisor repair: swap a fresh replica into one slot.  The
         breaker resets to closed; the first successful harvest through
         the slot counts ``<name>/replica_restored``."""
+        model = model or self._default_model
         with self._lock:
-            for s in self._slots:
+            group = self._groups.get(model)
+            if group is None:
+                return
+            for s in group.slots:
                 if s.index == index:
                     s.replica = replica
                     s.breaker.reset()
@@ -1066,9 +1198,10 @@ class DeviceExecutor:
             else:
                 return
         obs.count("serving_replica_events_total", event="rebuilt",
-                  replica=index, flat=f"{self.name}/replica_rebuilt")
-        self._log.warning("%s: replica %d rebuilt and swapped in",
-                          self.name, index)
+                  replica=index, model=model,
+                  flat=f"{self.name}/replica_rebuilt")
+        self._log.warning("%s: replica %d (%s) rebuilt and swapped in",
+                          self.name, index, model)
 
     def ensure_threads(self) -> None:
         """Supervisor repair: respawn a dead executor thread (the loops
@@ -1128,7 +1261,7 @@ class DeviceExecutor:
             slot.index if slot is not None else "?", len(batch.reqs))
         if slot is not None and slot.breaker.force_open():
             obs.count("serving_replica_events_total", event="quarantined",
-                      replica=slot.index,
+                      replica=slot.index, model=slot.model,
                       flat=f"{self.name}/replica_quarantined")
         self._requeue_or_fail(
             batch, ServingError("device harvest exceeded "
@@ -1158,12 +1291,12 @@ class DeviceExecutor:
         object stays claimed so a late abandoned readback is inert), or
         answer typed errors once retries are spent."""
         if batch.attempt < self.max_retries:
-            obs.count("serving_batch_retries_total",
+            obs.count("serving_batch_retries_total", model=batch.model,
                       flat=f"{self.name}/batch_retries")
             if batch.span is not None:
                 batch.span.end(status="retry", error=str(exc))
             fresh = _Batch(batch.key, batch.fused, batch.reqs,
-                           attempt=batch.attempt + 1)
+                           attempt=batch.attempt + 1, model=batch.model)
             self._retryq.append(fresh)
         else:
             self._fail_batch(batch, exc)
@@ -1172,7 +1305,7 @@ class DeviceExecutor:
                         exc: BaseException) -> None:
         if slot.breaker.record_failure():
             obs.count("serving_replica_events_total", event="quarantined",
-                      replica=slot.index,
+                      replica=slot.index, model=slot.model,
                       flat=f"{self.name}/replica_quarantined")
             self._log.warning(
                 "%s: replica %d quarantined after %d consecutive "
@@ -1191,12 +1324,13 @@ class DeviceExecutor:
         except pyqueue.Empty:
             return None
 
-    def _pick_slot_locked(self) -> Optional["_ReplicaSlot"]:
-        n = len(self._slots)
+    def _pick_slot_locked(self, group: "_ModelGroup"
+                          ) -> Optional["_ReplicaSlot"]:
+        n = len(group.slots)
         for k in range(n):
-            s = self._slots[(self._rr + k) % n]
+            s = group.slots[(group.rr + k) % n]
             if s.breaker.allow():
-                self._rr = (self._rr + k + 1) % n
+                group.rr = (group.rr + k + 1) % n
                 return s
         return None
 
@@ -1221,10 +1355,22 @@ class DeviceExecutor:
     def _dispatch_one(self, batch: "_Batch") -> None:
         with self._lock:
             if self._swap is not None:
-                self._slots = self._make_slots(self._swap)
-                self._swap, self._rr = None, 0
-            slot = self._pick_slot_locked()
-            if slot is not None:
+                for mname, reps in self._swap.items():
+                    g = self._groups.get(mname)
+                    if g is None:
+                        self._groups[mname] = _ModelGroup(
+                            mname, self._make_slots(reps, mname),
+                            self._groups[self._default_model].buckets)
+                    else:
+                        g.slots = self._make_slots(reps, mname)
+                        g.rr = 0
+                self._swap = None
+            group = self._groups.get(batch.model)
+            slot = (self._pick_slot_locked(group)
+                    if group is not None else None)
+            if group is None:
+                pass
+            elif slot is not None:
                 now = time.monotonic()
                 if (self._inflight == 0 and self._last_harvest_t is not None
                         and now - self._last_harvest_t > self.IDLE_EPS_S):
@@ -1238,14 +1384,21 @@ class DeviceExecutor:
                 # synchronous fallback forward reads busy() == True while
                 # it computes
                 self._inflight += 1
+        if group is None:
+            # a record named a model this executor doesn't host —
+            # answer typed, don't poison the dispatch loop
+            self._fail_batch(batch, ServingError(
+                f"unknown model {batch.model!r}", code="malformed"))
+            return
         if slot is None:
-            self._no_healthy_replica(batch)
+            self._no_healthy_replica(batch, group)
             return
         # the batch span links its member record spans: each request's
         # batch_wait span carries the record's trace id
         if batch.span is None:
             batch.span = TRACER.start(
                 "serving/device_batch", replica=slot.index,
+                model=batch.model,
                 rows=batch.fused[0].shape[0], attempt=batch.attempt,
                 members=[r.span.trace for r in batch.reqs
                          if getattr(r, "span", None) is not None])
@@ -1253,7 +1406,8 @@ class DeviceExecutor:
             plan = faults.fire(f"{self.name}.replica_crash")
             if plan is not None and plan.exc is not None:
                 raise plan.exc
-            batch.handles = self._dispatch(slot.replica, batch.fused)
+            batch.handles = self._dispatch(slot.replica, batch.fused,
+                                           group.buckets)
         except Exception as e:
             with self._lock:
                 self._inflight -= 1
@@ -1262,27 +1416,31 @@ class DeviceExecutor:
         batch.slot = slot
         batch.t_dispatch = time.monotonic()
         obs.count("serving_batches_total", replica=slot.index,
-                  flat=f"{self.name}/device_batches")
+                  model=batch.model, flat=f"{self.name}/device_batches")
         obs.count("serving_batch_rows_total", batch.fused[0].shape[0],
-                  replica=slot.index, flat=f"{self.name}/device_rows")
+                  replica=slot.index, model=batch.model,
+                  flat=f"{self.name}/device_rows")
         self._pending.put(batch)
 
-    def _no_healthy_replica(self, batch: "_Batch") -> None:
+    def _no_healthy_replica(self, batch: "_Batch",
+                            group: "_ModelGroup") -> None:
         """Every replica is quarantined.  With a ``fallback`` (the
         owning worker's sync predict — the ``serve_once`` path) the
         batch still serves, synchronously, while the supervisor rebuilds
         replicas; without one, the batch waits for a half-open probe
         window and eventually fails typed rather than hanging."""
-        if self._fallback is not None:
+        if group.fallback is not None:
             with self._lock:
                 self._inflight += 1
             try:
-                out = self._fallback(batch.fused)
+                out = group.fallback(batch.fused)
                 obs.count("serving_batches_total", replica="fallback",
+                          model=batch.model,
                           flat=f"{self.name}/sync_fallback_batches")
                 TIMERS.incr(f"{self.name}/device_batches")
                 obs.count("serving_batch_rows_total",
                           batch.fused[0].shape[0], replica="fallback",
+                          model=batch.model,
                           flat=f"{self.name}/device_rows")
                 if batch.span is not None:
                     batch.span.end(fallback=True)
@@ -1305,17 +1463,18 @@ class DeviceExecutor:
         time.sleep(0.01)  # wait for a probe window / supervisor rebuild
         self._retryq.append(batch)
 
-    def _dispatch(self, rep, fused: List[np.ndarray]):
+    def _dispatch(self, rep, fused: List[np.ndarray], buckets):
         """Pad to the bucket set and dispatch; a batch larger than the
         biggest bucket splits into full-bucket programs (never compiles
-        a one-off shape).  Returns [(handle, rows), ...]."""
+        a one-off shape).  The split/pad plan comes from the SAME
+        ``plan_buckets`` the predict path uses, so the executor and the
+        compile-shape ledger can never disagree.
+        Returns [(handle, rows), ...]."""
         n = fused[0].shape[0]
         if not rep.pads_input:  # fallback replica: predict() pads itself
             return [(rep.dispatch(fused), n)]
         out, s = [], 0
-        while s < n:
-            m = min(n - s, self.buckets[-1])
-            bucket = _next_bucket(m, self.buckets)
+        for m, bucket in plan_buckets(n, buckets):
             chunk = [x[s:s + m] for x in fused]
             if bucket > m:
                 chunk = [np.concatenate(
@@ -1379,19 +1538,78 @@ class DeviceExecutor:
             return
         dt = time.monotonic() - batch.t_dispatch
         obs.observe("serving_stage_seconds", dt, stage="device",
-                    flat=f"{self.name}/device")
+                    model=batch.model, flat=f"{self.name}/device")
         if batch.span is not None:
             batch.span.end(device_s=dt)
         scatter_batch_results(out, batch.reqs)
         if slot.breaker.record_success():
             obs.count("serving_replica_events_total", event="restored",
-                      replica=slot.index,
+                      replica=slot.index, model=slot.model,
                       flat=f"{self.name}/replica_restored")
         if slot.rebuilt:
             slot.rebuilt = False
             obs.count("serving_replica_events_total", event="restored",
-                      replica=slot.index,
+                      replica=slot.index, model=slot.model,
                       flat=f"{self.name}/replica_restored")
+
+
+class _SloAdmission:
+    """Weighted per-model admission (docs/SERVING.md "Warm start &
+    multi-model").  Each model with a nonzero SLO gets a sliding window
+    of recent e2e latencies; while its observed p99 exceeds its SLO the
+    poller admits only a ``slo/p99`` fraction of that model's incoming
+    records (deterministic fractional accumulator, not a coin flip) and
+    sheds the rest with a typed ``overloaded`` error — the over-SLO
+    model's queue pressure never starves its neighbours."""
+
+    WINDOW = 256        # samples kept per model
+    MIN_SAMPLES = 20    # below this, always admit (cold start)
+    MIN_FRACTION = 0.05  # never shed more than 95%
+
+    def __init__(self, slos: Dict[str, float]):
+        self._slos = {m: float(v) for m, v in slos.items() if v > 0}
+        self._lock = threading.Lock()
+        self._win: Dict[str, deque] = {
+            m: deque(maxlen=self.WINDOW) for m in self._slos}
+        self._acc: Dict[str, float] = {m: 0.0 for m in self._slos}
+
+    @property
+    def active(self) -> bool:
+        return bool(self._slos)
+
+    def note(self, model: str, e2e_s: float) -> None:
+        win = self._win.get(model)
+        if win is None:
+            return
+        with self._lock:
+            win.append(float(e2e_s))
+
+    def p99(self, model: str) -> float:
+        """Observed e2e p99 (ms) over the window; 0.0 = not enough
+        samples yet."""
+        win = self._win.get(model)
+        if win is None:
+            return 0.0
+        with self._lock:
+            xs = sorted(win)
+        if len(xs) < self.MIN_SAMPLES:
+            return 0.0
+        return xs[min(len(xs) - 1, int(0.99 * len(xs)))] * 1e3
+
+    def admit(self, model: str) -> bool:
+        slo = self._slos.get(model)
+        if slo is None:
+            return True
+        p99 = self.p99(model)
+        if p99 <= slo:
+            return True
+        frac = max(self.MIN_FRACTION, slo / p99)
+        with self._lock:
+            self._acc[model] += frac
+            if self._acc[model] >= 1.0:
+                self._acc[model] -= 1.0
+                return True
+        return False
 
 
 class ClusterServing:
@@ -1414,7 +1632,22 @@ class ClusterServing:
 
     def __init__(self, model, queue, config: Optional[ServingConfig] = None,
                  preprocess: Optional[Callable] = None):
-        self.model = model  # InferenceModel
+        # ``model`` is one InferenceModel (legacy) or a dict of named
+        # models multiplexed by one executor under a shared HBM budget
+        # (docs/SERVING.md "Warm start & multi-model").  ``self.model``
+        # stays the single/default model for existing callers.
+        if isinstance(model, dict):
+            if not model:
+                raise ValueError("ClusterServing needs at least one model")
+            self.models: Dict[str, Any] = dict(model)
+            for mname, m in self.models.items():
+                if getattr(m, "name", None) != mname:
+                    m.name = mname
+        else:
+            self.models = {getattr(model, "name", None)
+                           or DEFAULT_MODEL: model}
+        self._default_model = next(iter(self.models))
+        self.model = self.models[self._default_model]
         self.queue = queue
         self._wire = getattr(queue, "wire", "json")
         self.cfg = config or ServingConfig()
@@ -1428,8 +1661,26 @@ class ClusterServing:
         self._hb: Optional[Heartbeat] = None
         self._supervisor: Optional[Supervisor] = None
         self._topn_on_device = False
+        self._topn_by_model: Dict[str, bool] = {}
         self.records_served = 0
         self._count_lock = threading.Lock()
+        # warm start: one shared CompileCache for every hosted model
+        self._compile_cache = None
+        if self.cfg.compile_cache_dir:
+            from analytics_zoo_tpu.deploy.compile_cache import CompileCache
+            self._compile_cache = CompileCache(
+                self.cfg.compile_cache_dir,
+                max_entries=self.cfg.compile_cache_entries)
+            for mname, m in self.models.items():
+                if getattr(m, "_net", None) is not None:
+                    m.attach_compile_cache(self._compile_cache)
+        # per-model SLO admission + autoscaler actuator state
+        self._admission = _SloAdmission(
+            {m: self.cfg.slo_for(m) for m in self.models})
+        self._autoscaler = None
+        self._scale_lock = threading.Lock()
+        self._decode_target = self.cfg.decode_workers
+        self._replica_plan: Dict[str, int] = {}
         self._tb = None
         self._tb_last_t = time.monotonic()
         self._tb_last_n = 0
@@ -1461,22 +1712,81 @@ class ClusterServing:
             self._thread.start()
         return self
 
-    def _build_replicas(self) -> List:
-        return self.model.replica_forwards(
-            n=self.cfg.replicas, top_n=self.cfg.postprocess_top_n)
+    def _build_replicas(self, model: Optional[str] = None,
+                        n: Optional[int] = None) -> List:
+        mname = model or self._default_model
+        if n is None:
+            n = self._replica_plan.get(mname, self.cfg.replicas)
+        return self.models[mname].replica_forwards(
+            n=n, top_n=self.cfg.postprocess_top_n)
+
+    def _plan_replicas(self) -> Dict[str, int]:
+        """Per-model replica counts under the shared HBM budget: every
+        model starts at ``cfg.replicas``; while the summed weight bytes
+        exceed ``hbm_budget_bytes`` the heaviest group sheds one replica
+        (never below 1 — the budget bounds *copies*, not presence)."""
+        plan = {m: self.cfg.replicas for m in self.models}
+        budget = self.cfg.hbm_budget_bytes
+        if not budget:
+            return plan
+        sizes = {m: max(1, int(getattr(mdl, "weight_nbytes",
+                                       lambda: 0)() or 1))
+                 for m, mdl in self.models.items()}
+        def cost(p):
+            return sum(sizes[m] * p[m] for m in p)
+        while cost(plan) > budget and any(v > 1 for v in plan.values()):
+            heavy = max((m for m in plan if plan[m] > 1),
+                        key=lambda m: sizes[m] * plan[m])
+            plan[heavy] -= 1
+        if cost(plan) > budget:
+            logging.getLogger("analytics_zoo_tpu.deploy").warning(
+                "serving: even one replica per model (%d bytes) exceeds "
+                "the HBM budget (%d bytes); proceeding at 1 each",
+                cost(plan), budget)
+        return plan
+
+    def _warm_models(self) -> None:
+        """Pre-install every cached executable before replica build, so
+        a restarted worker's first request hits full bucket coverage
+        with ZERO live compiles (counter-proven: ``compile_count`` stays
+        0, cache ``hit`` events >= bucket count)."""
+        if self._compile_cache is None:
+            return
+        log = logging.getLogger("analytics_zoo_tpu.deploy")
+        t0 = time.perf_counter()
+        for mname, m in self.models.items():
+            if getattr(m, "_net", None) is None:
+                continue
+            n = m.warm()
+            if n:
+                log.info("serving: model %r warm-started %d program(s) "
+                         "from %s in %.2fs", mname, n,
+                         self.cfg.compile_cache_dir,
+                         time.perf_counter() - t0)
 
     def _start_pipeline(self) -> None:
-        replicas = self._build_replicas()
-        self._topn_on_device = bool(replicas[0].on_device_topn)
-        buckets = tuple(getattr(self.model, "batch_buckets", None)
-                        or (1, self.cfg.batch_size))
+        self._warm_models()
+        self._replica_plan = self._plan_replicas()
+        rep_map: Dict[str, List] = {}
+        bucket_map: Dict[str, tuple] = {}
+        fb_map: Dict[str, Callable] = {}
+        for mname, m in self.models.items():
+            reps = self._build_replicas(mname)
+            rep_map[mname] = reps
+            self._topn_by_model[mname] = bool(reps[0].on_device_topn)
+            bucket_map[mname] = tuple(
+                getattr(m, "batch_buckets", None)
+                or (1, self.cfg.batch_size))
+            fb_map[mname] = (lambda fused, _m=m: _m.predict(
+                fused[0] if len(fused) == 1 else fused))
+        self._topn_on_device = self._topn_by_model[self._default_model]
         self._hb = Heartbeat()
         self._executor = DeviceExecutor(
-            replicas, buckets=buckets, max_inflight=self.cfg.max_inflight,
+            rep_map, buckets=bucket_map,
+            max_inflight=self.cfg.max_inflight,
             breaker_threshold=self.cfg.breaker_threshold,
             breaker_cooldown_s=self.cfg.breaker_cooldown_s,
-            fallback=lambda fused: self.model.predict(
-                fused[0] if len(fused) == 1 else fused))
+            fallback=fb_map)
         self._executor._heartbeat = lambda: self._hb.beat("device")
         self._batcher = DynamicBatcher(
             max_batch=self.cfg.batch_size,
@@ -1488,15 +1798,17 @@ class ClusterServing:
         self._respond_q: "pyqueue.Queue" = pyqueue.Queue()
         self._poller = threading.Thread(target=self._poll_loop, daemon=True,
                                         name="srv-poll")
-        self._decode_workers = [
-            threading.Thread(target=self._decode_loop, daemon=True,
-                             name=f"srv-decode-{i}")
-            for i in range(self.cfg.decode_workers)]
+        with self._scale_lock:      # vs a concurrent resize_decode_pool
+            self._decode_workers = [
+                threading.Thread(target=self._decode_loop, daemon=True,
+                                 name=f"srv-decode-{i}")
+                for i in range(self._decode_target)]
+            decode_workers = list(self._decode_workers)
         self._respond_workers = [
             threading.Thread(target=self._respond_loop, daemon=True,
                              name=f"srv-respond-{i}")
             for i in range(max(1, self.cfg.decode_workers // 2))]
-        self._threads = ([self._poller] + self._decode_workers
+        self._threads = ([self._poller] + decode_workers
                          + self._respond_workers)
         for t in self._threads:
             t.start()
@@ -1514,13 +1826,21 @@ class ClusterServing:
         sup.add_check("heal_replicas", self._heal_replicas)
         sup.add_check("stages", self._check_stages)
         sup.add_check("gauges", self._publish_gauges)
-        # the flight recorder rides the supervisor cadence: e2e-p99 SLO
-        # (if configured) plus breaker trips always
+        # the flight recorder rides the supervisor cadence: e2e-p99
+        # SLOs (per model — e2e series carry a {model} label) plus
+        # breaker trips always
         slos = []
-        if self.cfg.slo_p99_ms > 0:
-            slos.append(SLO("serving_e2e_p99", "serving_stage_seconds",
-                            labels={"stage": "e2e"},
-                            p99_ms=self.cfg.slo_p99_ms, min_count=10))
+        slo_map = self.cfg.slo_models()
+        if not slo_map and not isinstance(self.cfg.slo_p99_ms, dict) \
+                and self.cfg.slo_p99_ms > 0:
+            # scalar config: one shared bound applied to every model
+            slo_map = {m: self.cfg.slo_p99_ms for m in self.models}
+        for mname, p99_ms in slo_map.items():
+            suffix = "" if mname == self._default_model else f"_{mname}"
+            slos.append(SLO(f"serving_e2e_p99{suffix}",
+                            "serving_stage_seconds",
+                            labels={"stage": "e2e", "model": mname},
+                            p99_ms=p99_ms, min_count=10))
         profile_dir = None
         if self.cfg.profile_on_breach and self.cfg.flight_dir:
             profile_dir = os.path.join(self.cfg.flight_dir, "profile")
@@ -1532,6 +1852,14 @@ class ClusterServing:
             profile_dir=profile_dir,
             cooldown_s=max(1.0, 2.0 * self.cfg.slo_window_s))
         sup.add_check("flight_recorder", self.flight_recorder.check)
+        if self.cfg.autoscale:
+            from analytics_zoo_tpu.deploy.autoscale import Autoscaler
+            self._autoscaler = Autoscaler(
+                self, policy=self.cfg.autoscale_policy)
+            every = max(1, int(round(
+                self.cfg.autoscale_interval_s
+                / self.cfg.supervisor_interval_s)))
+            sup.add_check("autoscale", self._autoscaler.check, every=every)
         self._supervisor = sup
         sup.start()
 
@@ -1546,13 +1874,21 @@ class ClusterServing:
         stale = ex.quarantined_slots(min_open_s=self.cfg.breaker_cooldown_s)
         if not stale:
             return
-        # one replica_forwards call rebuilds the full set; pick out the
-        # slots that need one (cheap for function-models, and for jitted
-        # forwards the compile cache makes the extra copies ~free)
-        fresh = self._build_replicas()
+        # one replica_forwards call per affected model rebuilds its full
+        # set; pick out the slots that need one (cheap for
+        # function-models, and for jitted forwards the compile cache
+        # makes the extra copies ~free)
+        by_model: Dict[str, List] = {}
         for slot in stale:
-            if slot.index < len(fresh):
-                ex.rebuild_slot(slot.index, fresh[slot.index])
+            by_model.setdefault(slot.model, []).append(slot)
+        for mname, slots in by_model.items():
+            if mname not in self.models:
+                continue
+            fresh = self._build_replicas(mname, n=ex.group_size(mname))
+            for slot in slots:
+                if slot.index < len(fresh):
+                    ex.rebuild_slot(slot.index, fresh[slot.index],
+                                    model=mname)
 
     def _check_stages(self) -> None:
         """Watchdog for wedged/dead stage threads.  A dead thread is
@@ -1575,16 +1911,28 @@ class ClusterServing:
                 target=self._poll_loop, daemon=True, name="srv-poll")
             self._threads.append(self._poller)
             self._poller.start()
-        for i, t in enumerate(self._decode_workers):
-            if not t.is_alive():
+        with self._scale_lock:
+            # prune dead workers, then top up only to the AUTOSCALER'S
+            # target — a shrink retires workers via sentinel, and those
+            # intentional deaths must not be resurrected here
+            alive = [t for t in self._decode_workers if t.is_alive()]
+            pruned = len(self._decode_workers) - len(alive)
+            self._decode_workers = alive
+            deficit = self._decode_target - len(alive)
+            for _ in range(max(0, deficit)):
                 obs.count("serving_stage_restarts_total", stage="decode",
                           flat="serving/stage_restarted")
-                log.warning("decode worker %d died; restarting", i)
-                nt = threading.Thread(target=self._decode_loop, daemon=True,
-                                      name=f"srv-decode-{i}")
-                self._decode_workers[i] = nt
+                log.warning("decode pool below target (%d/%d); restarting",
+                            len(self._decode_workers), self._decode_target)
+                nt = threading.Thread(
+                    target=self._decode_loop, daemon=True,
+                    name=f"srv-decode-{len(self._decode_workers)}")
+                self._decode_workers.append(nt)
                 self._threads.append(nt)
                 nt.start()
+            if pruned and deficit <= 0:
+                log.info("decode pool pruned %d retired worker(s) "
+                         "(target %d)", pruned, self._decode_target)
         for i, t in enumerate(self._respond_workers):
             if not t.is_alive():
                 obs.count("serving_stage_restarts_total", stage="respond",
@@ -1606,12 +1954,81 @@ class ClusterServing:
                     if age > self.cfg.stage_stall_s:
                         TIMERS.incr(f"serving/stage_stalled/{stage}")
 
+    # -- autoscaler actuators (deploy/autoscale.py drives these) -----------
+    def resize_decode_pool(self, n: int) -> int:
+        """Grow/shrink the decode pool to ``n`` threads.  Growth spawns
+        immediately; shrink retires workers with ``None`` sentinels (a
+        worker finishes its current record, then exits) and
+        ``_check_stages`` prunes the dead threads next tick."""
+        n = max(1, int(n))
+        with self._scale_lock:
+            cur = self._decode_target
+            self._decode_target = n
+            if n > cur:
+                for i in range(n - cur):
+                    nt = threading.Thread(
+                        target=self._decode_loop, daemon=True,
+                        name=f"srv-decode-{len(self._decode_workers) + i}")
+                    self._decode_workers.append(nt)
+                    self._threads.append(nt)
+                    nt.start()
+            else:
+                for _ in range(cur - n):
+                    self._decode_q.put(None)
+        return n
+
+    def _budget_allows(self, model: str, extra: int) -> bool:
+        """True if ``extra`` more replicas of ``model`` fit the shared
+        HBM budget (0/unset = unlimited)."""
+        budget = self.cfg.hbm_budget_bytes
+        if not budget or self._executor is None:
+            return True
+        used = 0
+        for mname, m in self.models.items():
+            nb = int(getattr(m, "weight_nbytes", lambda: 0)() or 0)
+            used += nb * self._executor.group_size(mname)
+        add = int(getattr(self.models[model], "weight_nbytes",
+                          lambda: 0)() or 0) * extra
+        return used + add <= budget
+
+    def resize_model_replicas(self, model: str, n: int) -> int:
+        """Rebuild one model's replica group at ``n`` copies (hot swap —
+        in-flight batches finish on the old set).  A grow that would
+        bust the HBM budget is refused (returns the current size)."""
+        n = max(1, int(n))
+        ex = self._executor
+        if ex is None or model not in self.models:
+            return 0
+        cur = ex.group_size(model)
+        if n == cur:
+            return cur
+        if n > cur and not self._budget_allows(model, n - cur):
+            logging.getLogger("analytics_zoo_tpu.deploy").warning(
+                "serving: replica grow %s -> %d refused (HBM budget)",
+                model, n)
+            return cur
+        reps = self._build_replicas(model, n=n)
+        ex.swap_replicas(reps, model=model)
+        self._replica_plan[model] = n
+        return n
+
+    def set_batch_deadline_ms(self, ms: float) -> float:
+        """Retune the DynamicBatcher's flush deadline in place."""
+        ms = max(0.1, float(ms))
+        if self._batcher is not None:
+            self._batcher.max_latency = ms / 1e3
+        return ms
+
     def _publish_gauges(self) -> None:
         ex = self._executor
         if ex is not None:
             obs.set_gauge("serving_replicas_healthy",
                           ex.healthy_replicas(),
                           flat="serving/replicas_healthy")
+            for mname in ex.models():
+                obs.set_gauge("serving_replicas_healthy",
+                              ex.healthy_replicas(mname), model=mname,
+                              flat=f"serving/replicas_healthy/{mname}")
             obs.set_gauge("serving_inflight", ex.inflight,
                           flat="serving/inflight")
         if self._hb is not None:
@@ -1646,9 +2063,11 @@ class ClusterServing:
             self._supervisor.stop(timeout=timeout)
         if self._threads:  # pipeline mode
             self._poller.join(timeout=timeout)
-            for _ in self._decode_workers:
+            with self._scale_lock:  # snapshot vs a late autoscaler tick
+                decode_workers = list(self._decode_workers)
+            for _ in decode_workers:
                 self._decode_q.put(None)
-            for t in self._decode_workers:
+            for t in decode_workers:
                 t.join(timeout=timeout)
             if self._batcher is not None:
                 self._batcher.close(flush=True)
@@ -1697,9 +2116,10 @@ class ClusterServing:
         record terminates in a result or a typed error payload, never
         silence.  The record's root span (started at claim, or here for
         the sync path) ends with the shed code as its terminal status."""
-        obs.count("serving_shed_total", code=code,
+        model = rec.get("model") or self._default_model
+        obs.count("serving_shed_total", code=code, model=model,
                   flat=f"serving/shed_{'expired' if code == 'expired' else 'early'}")
-        obs.count("serving_errors_total", code=code,
+        obs.count("serving_errors_total", code=code, model=model,
                   flat="serving/errors_returned")
         sp = rec.pop("_span", None)
         if sp is None:
@@ -1736,11 +2156,29 @@ class ClusterServing:
                     # repeat across runs); the rid rides as the uri attr
                     rec["_span"] = TRACER.start("serving/request",
                                                 uri=rec.get("uri") or rid)
+                    # multi-model routing + weighted admission: resolve
+                    # the target model, reject unknown names typed, and
+                    # shed a fraction of an over-SLO model's traffic
+                    # BEFORE it costs decode/dispatch
+                    model = rec.get("model") or self._default_model
+                    if model not in self.models:
+                        self._shed(rid, rec, "malformed",
+                                   f"unknown model {model!r}")
+                        continue
+                    rec["model"] = model
+                    if not self._admission.admit(model):
+                        self._shed(
+                            rid, rec, "overloaded",
+                            f"model {model!r} over its p99 SLO "
+                            f"({self._admission.p99(model):.0f}ms > "
+                            f"{self.cfg.slo_for(model):.0f}ms); "
+                            "weighted admission shed")
+                        continue
                     ts = rec.get("ts")
                     if isinstance(ts, (int, float)):
                         obs.observe("serving_stage_seconds",
                                     max(0.0, now - ts), stage="queue_wait",
-                                    flat="serving/queue_wait")
+                                    model=model, flat="serving/queue_wait")
                     remaining = self._record_ttl_s(rec)
                     if remaining is not None:
                         if remaining <= 0:
@@ -1779,15 +2217,17 @@ class ClusterServing:
             self._hb.beat("decode")
             rid, rec = item
             deadline = rec.get("_deadline_mono")
+            model = rec.get("model") or self._default_model
             root = rec.get("_span")
             dsp = None
             try:
                 faults.inject("serving.decode_error")
                 if root is not None:
                     dsp = TRACER.start("serving/decode", trace=root.trace,
-                                       parent=root.sid)
+                                       parent=root.sid, model=model)
                 with obs.time_stage("serving_stage_seconds",
-                                    stage="decode", flat="serving/decode"):
+                                    stage="decode", model=model,
+                                    flat="serving/decode"):
                     decoded = _decode_record(rec)
                     x = decoded.get("image")
                     if x is None:  # first non-image tensor
@@ -1817,13 +2257,14 @@ class ClusterServing:
                     [x[None]],
                     lambda out, err, _rid=rid, _rec=rec:
                         self._respond_q.put((_rid, _rec, out, err)),
-                    deadline=deadline, span=wsp)
+                    deadline=deadline, span=wsp,
+                    model=rec.get("model"))
             except Exception as e:
                 # a bad record answers with an error instead of poisoning
                 # the pipeline (clients see it in query(), not a hang)
                 if isinstance(e, DeadlineExpired):
                     obs.count("serving_shed_total", code="expired",
-                              flat="serving/shed_expired")
+                              model=model, flat="serving/shed_expired")
                 elif not isinstance(e, ServingError):
                     try:
                         e.code = getattr(e, "code", "decode_error")
@@ -1885,6 +2326,7 @@ class ClusterServing:
             if isinstance(val, dict) and "error" in val:
                 obs.count("serving_errors_total",
                           code=val.get("code") or "internal",
+                          model=rec.get("model") or self._default_model,
                           flat="serving/errors_returned")
             prepared.append((rid, rec, val, root, rsp))
 
@@ -1921,8 +2363,9 @@ class ClusterServing:
         per = (time.perf_counter() - t0) / len(prepared)
         now = time.time()
         for rid, rec, val, root, rsp in prepared:
+            model = rec.get("model") or self._default_model
             obs.observe("serving_stage_seconds", per, stage="respond",
-                        flat="serving/respond")
+                        model=model, flat="serving/respond")
             # terminal spans: the respond leg, then the root with the
             # typed outcome — the span chain is now reconstructable
             outcome_code = (val.get("code") or "internal") \
@@ -1931,13 +2374,16 @@ class ClusterServing:
                 rsp.end()
             if root is not None:
                 root.end(status=outcome_code)
-            obs.count("serving_records_total",
+            obs.count("serving_records_total", model=model,
                       outcome="ok" if outcome_code == "ok" else "error")
             ts = rec.get("ts")
             if isinstance(ts, (int, float)):
-                obs.observe("serving_stage_seconds",
-                            max(0.0, now - ts), stage="e2e",
-                            flat="serving/e2e")
+                e2e = max(0.0, now - ts)
+                obs.observe("serving_stage_seconds", e2e, stage="e2e",
+                            model=model, flat="serving/e2e")
+                # feed the per-model admission window (only models with
+                # an SLO keep one)
+                self._admission.note(model, e2e)
         with self._count_lock:
             self.records_served += len(prepared)
         self._maybe_tb_flush()
@@ -1951,7 +2397,9 @@ class ClusterServing:
             return error_payload(code, err, uri=rec.get("uri"))
         top_n = self.cfg.postprocess_top_n
         outs = out if isinstance(out, list) else [out]
-        if top_n and self._topn_on_device and len(outs) == 2:
+        topn_on_device = self._topn_by_model.get(
+            rec.get("model") or self._default_model, self._topn_on_device)
+        if top_n and topn_on_device and len(outs) == 2:
             # the jitted forward already ran lax.top_k: outs = (idx, val)
             idx, vals = np.asarray(outs[0])[0], np.asarray(outs[1])[0]
             return [[int(i), float(v)] for i, v in zip(idx, vals)]
@@ -2031,6 +2479,18 @@ class ClusterServing:
             h["replicas"] = len(self._executor.replicas)
             h["replicas_healthy"] = self._executor.healthy_replicas()
             h["replica_states"] = self._executor.replica_states()
+            h["models"] = {
+                m: {"replicas": self._executor.group_size(m),
+                    "replicas_healthy": self._executor.healthy_replicas(m),
+                    "slo_p99_ms": self.cfg.slo_for(m),
+                    "observed_p99_ms": self._admission.p99(m)}
+                for m in self._executor.models()}
+        if self._compile_cache is not None:
+            h["compile_cache"] = self._compile_cache.stats()
+        if self._autoscaler is not None:
+            h["autoscale"] = self._autoscaler.stats()
+        with self._scale_lock:
+            h["decode_target"] = self._decode_target
         if self._hb is not None:
             h["stage_heartbeat_age_s"] = self._hb.ages()
         if self._supervisor is not None:
@@ -2098,6 +2558,11 @@ class ClusterServing:
         logging.getLogger("analytics_zoo_tpu.deploy").info(
             "model at %s changed (mtime %.0f); hot-reloading", path, mtime)
         self.model = InferenceModel.load(path)
+        self.model.name = self._default_model
+        self.models[self._default_model] = self.model
+        if (self._compile_cache is not None
+                and getattr(self.model, "_net", None) is not None):
+            self.model.attach_compile_cache(self._compile_cache)
         self._reload_mtime = mtime
         self._reload_pending_mtime = None
         return True
@@ -2143,6 +2608,12 @@ class ClusterServing:
                 self._shed(rid, rec, "expired",
                            "client TTL expired before decode")
                 continue
+            model = rec.get("model") or self._default_model
+            if model not in self.models:
+                self._shed(rid, rec, "malformed",
+                           f"unknown model {model!r}")
+                continue
+            rec["model"] = model
             try:
                 decoded = _decode_record(rec)
                 x = decoded.get("image")
@@ -2158,7 +2629,7 @@ class ClusterServing:
                 # a bad record answers with an error instead of poisoning
                 # the batch (clients see it in query() rather than a hang)
                 code = getattr(e, "code", None) or "decode_error"
-                obs.count("serving_errors_total", code=code,
+                obs.count("serving_errors_total", code=code, model=model,
                           flat="serving/errors_returned")
                 sp = rec.pop("_span", None)
                 if sp is not None:
@@ -2166,19 +2637,19 @@ class ClusterServing:
                 self.queue.set_result(
                     rid, error_payload(code, e, uri=rec.get("uri")))
                 continue
-            groups.setdefault((x.shape, str(x.dtype)), []).append(
+            groups.setdefault((model, x.shape, str(x.dtype)), []).append(
                 (rid, x, rec.get("fmt") == "tensor", rec))
         served = 0
-        for entries in groups.values():
+        for (model, _shape, _dt), entries in groups.items():
             x = np.stack([e[1] for e in entries], axis=0)
             try:
-                out = self.model.predict(x)
+                out = self.models[model].predict(x)
             except Exception as e:
                 # records are already destructively popped from the queue —
                 # answer every one with the error rather than losing them
                 for rid, _, _, rec in entries:
                     obs.count("serving_errors_total", code="model_error",
-                              flat="serving/errors_returned")
+                              model=model, flat="serving/errors_returned")
                     sp = rec.pop("_span", None)
                     if sp is not None:
                         sp.end(status="model_error", error=str(e))
@@ -2192,7 +2663,8 @@ class ClusterServing:
                 sp = _rec.pop("_span", None)
                 if sp is not None:
                     sp.end()
-            obs.count("serving_records_total", len(entries), outcome="ok")
+            obs.count("serving_records_total", len(entries),
+                      model=model, outcome="ok")
             served += len(entries)
         dt = time.perf_counter() - t0
         # serve_once can run concurrently with a started pipeline's

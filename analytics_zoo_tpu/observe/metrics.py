@@ -49,35 +49,52 @@ _HIST_RING = 1024
 # for metric names; OBSERVABILITY.md pins this table and test_doc_drift
 # checks it.
 CATALOG: Dict[str, Tuple[str, str, Tuple[str, ...]]] = {
-    # serving pipeline
+    # serving pipeline (the ``model`` label names the serving model in a
+    # multi-model pipeline; single-model paths emit model="default")
     "serving_stage_seconds": (
         "histogram", "per-stage latency of the serving pipeline",
-        ("stage",)),
+        ("model", "stage")),
     "serving_records_total": (
         "counter", "records answered, by outcome (ok|error)",
-        ("outcome",)),
+        ("model", "outcome")),
     "serving_shed_total": (
         "counter", "records shed before the device, by typed code",
-        ("code",)),
+        ("code", "model")),
     "serving_errors_total": (
-        "counter", "typed error payloads returned, by code", ("code",)),
+        "counter", "typed error payloads returned, by code",
+        ("code", "model")),
     "serving_batches_total": (
         "counter", "batches dispatched to a device replica",
-        ("replica",)),
+        ("model", "replica")),
     "serving_batch_rows_total": (
-        "counter", "rows dispatched to a device replica", ("replica",)),
+        "counter", "rows dispatched to a device replica",
+        ("model", "replica")),
     "serving_batch_retries_total": (
-        "counter", "batches retried on a healthy peer replica", ()),
+        "counter", "batches retried on a healthy peer replica",
+        ("model",)),
     "serving_replica_events_total": (
         "counter", "replica lifecycle events "
-        "(quarantined|restored|rebuilt)", ("event", "replica")),
+        "(quarantined|restored|rebuilt)", ("event", "model", "replica")),
     "serving_stage_restarts_total": (
         "counter", "dead stage threads respawned by the supervisor",
         ("stage",)),
     "serving_inflight": (
         "gauge", "records currently inside the pipeline", ()),
     "serving_replicas_healthy": (
-        "gauge", "replicas currently accepting batches", ()),
+        "gauge", "replicas currently accepting batches", ("model",)),
+    "serving_compile_cache_events_total": (
+        "counter", "persistent AOT compile-cache outcomes "
+        "(hit|miss|corrupt|version_skew)", ("event", "model")),
+    "serving_autoscale_actions_total": (
+        "counter", "autoscaler decisions applied, by resource "
+        "(decode_workers|replicas|batch_deadline) and direction "
+        "(up|down)", ("direction", "model", "resource")),
+    "inference_novel_batch_shapes_total": (
+        "counter", "novel batch signatures dispatched (one per live XLA "
+        "compile)", ("model",)),
+    "inference_compile_count": (
+        "gauge", "distinct live-compiled program shapes "
+        "(cache-warmed shapes excluded)", ("model",)),
     "serving_heartbeat_age_seconds": (
         "gauge", "age of each stage's last heartbeat", ("stage",)),
     "serving_wire_bytes_total": (

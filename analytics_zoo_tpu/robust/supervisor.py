@@ -65,14 +65,20 @@ class Supervisor:
     def __init__(self, interval_s: float = 0.25, name: str = "supervisor"):
         self.interval_s = max(0.01, float(interval_s))
         self.name = name
-        self._checks: List[Tuple[str, Callable[[], object]]] = []
+        self._checks: List[Tuple[str, Callable[[], object], int]] = []
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._tick = 0
 
-    def add_check(self, name: str, fn: Callable[[], object]) -> "Supervisor":
+    def add_check(self, name: str, fn: Callable[[], object],
+                  every: int = 1) -> "Supervisor":
+        """Register a repair check.  ``every=k`` runs it on every k-th
+        tick only — slow controllers (the serving autoscaler) ride the
+        same supervisor thread at a coarser cadence than the hot repair
+        checks."""
         with self._lock:
-            self._checks.append((name, fn))
+            self._checks.append((name, fn, max(1, int(every))))
         return self
 
     def start(self) -> "Supervisor":
@@ -93,14 +99,19 @@ class Supervisor:
     def is_alive(self) -> bool:
         return self._thread is not None and self._thread.is_alive()
 
-    def run_checks_once(self) -> None:
-        """One synchronous pass over every check (tests drive this
-        directly for determinism instead of waiting out the interval)."""
+    def run_checks_once(self, tick: Optional[int] = None) -> None:
+        """One synchronous pass over the checks (tests drive this
+        directly for determinism instead of waiting out the interval).
+        ``tick=None`` runs EVERY check regardless of its ``every=``
+        cadence; the supervisor loop passes its tick counter so coarse
+        checks fire on their multiple only."""
         with self._lock:
             checks = list(self._checks)
-        for name, fn in checks:
+        for name, fn, every in checks:
             if self._stop.is_set():
                 return
+            if tick is not None and tick % every != 0:
+                continue
             try:
                 fn()
             except Exception:
@@ -111,4 +122,5 @@ class Supervisor:
 
     def _loop(self) -> None:
         while not self._stop.wait(timeout=self.interval_s):
-            self.run_checks_once()
+            self._tick += 1
+            self.run_checks_once(tick=self._tick)

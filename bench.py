@@ -2208,6 +2208,123 @@ def _preflight_with_retry(budget_frac: float = 0.8,
         time.sleep(min(retry_sleep_s, max(0, deadline - time.time())))
 
 
+def bench_restart_to_slo_child(cache_dir, buckets=(1, 8, 32),
+                               slo_ms=200.0, n_probe=12):
+    """One process leg of the restart-to-SLO bench — run in a fresh
+    subprocess so the in-process jit caches can't leak between the cold
+    and warm legs.  The on-disk state of ``cache_dir`` is the only
+    thing distinguishing them: empty = cold (every bucket pays a live
+    XLA compile), populated = warm restart (``warm()`` pre-installs the
+    persisted executables; docs/SERVING.md "Warm start & multi-model").
+
+    Two clocks, both from model-ready (pipeline/queue overhead
+    excluded — this times the replica forward path itself):
+
+    - ``coverage_s`` — until every ``batch_buckets`` program has served
+      a batch (full bucket coverage);
+    - ``slo_s`` — until a probe request's p99 (sliding window over the
+      last 10 probes, round-robin across buckets) first drops under
+      ``slo_ms``.  Compiles land inside early probes, so the cold leg
+      crosses the SLO line only after paying them.
+    """
+    import numpy as np
+
+    from analytics_zoo_tpu.deploy import CompileCache, InferenceModel
+    from analytics_zoo_tpu.nn import Sequential, reset_name_scope
+    from analytics_zoo_tpu.nn.layers.core import Activation, Dense
+    from analytics_zoo_tpu.train.optimizers import Adam
+
+    in_dim, out_dim = 12, 4
+    rs = np.random.RandomState(0)
+    reset_name_scope()
+    net = Sequential([Dense(64, input_shape=(in_dim,)), Activation("relu"),
+                      Dense(out_dim)])
+    net.compile(optimizer=Adam(1e-2), loss="mse")
+    x = rs.randn(max(buckets), in_dim).astype(np.float32)
+    net.fit(x, rs.randn(max(buckets), out_dim).astype(np.float32),
+            batch_size=16, nb_epoch=1, verbose=False)
+    m = InferenceModel.from_keras_net(net, net.estimator.params,
+                                      net.estimator.state,
+                                      batch_buckets=tuple(buckets))
+    cache = CompileCache(cache_dir)
+    m.attach_compile_cache(cache)
+
+    t_start = time.monotonic()
+    warmed = m.warm()
+    for b in buckets:
+        m.predict(x[:b])
+    coverage_s = time.monotonic() - t_start
+
+    lats = []
+    slo_s = None
+    for i in range(n_probe):
+        b = buckets[i % len(buckets)]
+        t0 = time.monotonic()
+        m.predict(x[:b])
+        lats.append((time.monotonic() - t0) * 1e3)
+        win = sorted(lats[-10:])
+        if slo_s is None and win[-1] <= slo_ms:
+            slo_s = time.monotonic() - t_start
+    return {"warmed": int(warmed),
+            "compile_count": int(m.compile_count),
+            "coverage_s": round(coverage_s, 3),
+            "slo_s": round(slo_s, 3) if slo_s is not None else None,
+            "probe_p99_ms": round(sorted(lats)[-1], 3),
+            "cache_events": dict(cache.stats()["events"])}
+
+
+def bench_serving_restart_to_slo(slo_ms=200.0):
+    """Warm-start restart bench (ISSUE 15 acceptance): a cold process
+    vs a restarted process over the same persistent compile-cache dir,
+    each leg a REAL fresh OS process (``bench_restart_to_slo_child``).
+    The honest claims: the warm leg performs ZERO live XLA compiles
+    (counter-proven by ``compile_count``) and reaches full bucket
+    coverage ≥ 5x faster than the cold leg.  Forced-CPU children, like
+    the dlrm leg: compile cost is what's being measured and the warm/
+    cold *ratio* is the claim, so the host CPU backend stands in; the
+    jax persistent compilation cache is NOT enabled in the children
+    (that would hide exactly the cost this bench measures).
+    """
+    import shutil
+    import subprocess
+    import sys
+    import tempfile
+
+    cache_dir = tempfile.mkdtemp(prefix="zoo_bench_xc_")
+    out = {"slo_ms": slo_ms, "buckets": [1, 8, 32]}
+    code = (
+        "import os;"
+        "os.environ['JAX_PLATFORMS']='cpu';"
+        "import sys, json; sys.path.insert(0, os.getcwd());"
+        "from bench import bench_restart_to_slo_child;"
+        f"print('XCJSON', json.dumps(bench_restart_to_slo_child("
+        f"{cache_dir!r}, slo_ms={slo_ms})))")
+    try:
+        for leg in ("cold", "warm"):
+            proc = subprocess.run(
+                [sys.executable, "-c", code], capture_output=True,
+                text=True, timeout=max(60, min(300, _remaining() - 20)),
+                cwd=os.path.dirname(os.path.abspath(__file__)))
+            for line in proc.stdout.splitlines():
+                if line.startswith("XCJSON "):
+                    out[leg] = json.loads(line[len("XCJSON "):])
+                    break
+            else:
+                out[f"{leg}_error"] = (f"child rc={proc.returncode}: "
+                                       f"{(proc.stderr or '')[-400:]}")
+                return out
+        out["warm_live_compiles"] = out["warm"]["compile_count"]
+        out["coverage_speedup_warm_vs_cold"] = _safe_ratio(
+            out["cold"]["coverage_s"], out["warm"]["coverage_s"])
+        out["slo_speedup_warm_vs_cold"] = _safe_ratio(
+            out["cold"]["slo_s"], out["warm"]["slo_s"])
+    except Exception as e:
+        out["error"] = f"{type(e).__name__}: {e}"
+    finally:
+        shutil.rmtree(cache_dir, ignore_errors=True)
+    return out
+
+
 def _run_metadata(device=None):
     """Provenance stamp for BENCH_*.json artifacts: which commit, which
     jax, which silicon produced the numbers.  ``device=None`` (the
@@ -2359,6 +2476,18 @@ def main():
     except Exception as e:
         extra["serving_wire_codecs_error"] = f"{type(e).__name__}: {e}"
     _mark("serving_wire_codecs", t0)
+
+    # restart-to-SLO: persistent compile cache, cold vs warm restart
+    # (fresh forced-CPU subprocess per leg — host-side, no accel)
+    t0 = time.time()
+    if _remaining() > 90:
+        try:
+            extra["serving_restart_to_slo"] = bench_serving_restart_to_slo()
+        except Exception as e:
+            extra["serving_restart_to_slo_error"] = f"{type(e).__name__}: {e}"
+    else:
+        extra["serving_restart_to_slo_skipped"] = "time budget"
+    _mark("serving_restart_to_slo", t0)
 
     # BASELINE config #4: WideAndDeep throughput
     t0 = time.time()

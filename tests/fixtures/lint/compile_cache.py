@@ -1,0 +1,41 @@
+"""zoolint fixture: the persistent compile-cache ledger idiom
+(deploy/compile_cache.py).  Every loader thread bumps the shared
+hit/miss event ledger, so an unlocked bump on the load path fires
+THR-GUARD; the shipped lock-held twin stays quiet — the cache stats
+the warm-start proof reads (docs/SERVING.md "Warm start & multi-model")
+are trustworthy by construction, not by suppression."""
+
+import threading
+
+
+class NaiveCompileCache:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries = {}
+        self._hits = 0
+
+    def store(self, digest, blob):
+        with self._lock:
+            self._entries[digest] = blob
+            self._hits += 1       # establishes: _hits guarded by _lock
+
+    def load(self, digest):
+        self._hits += 1           # THR-GUARD fires: unlocked ledger
+        return None               # bump from concurrent loader threads
+
+
+class LockedCompileCache:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries = {}
+        self._hits = 0
+
+    def store(self, digest, blob):
+        with self._lock:
+            self._entries[digest] = blob
+            self._hits += 1
+
+    def load(self, digest):
+        with self._lock:
+            self._hits += 1       # quiet: same lock as the writer
+            return self._entries.get(digest)

@@ -61,6 +61,17 @@ process boundaries:
                       snapshot, report the same hashes (must be
                       bit-identical whatever the process count).
 
+Serving scenarios (``serving_*``) exercise the persistent AOT compile
+cache (deploy/compile_cache.py) across REAL process boundaries:
+
+- ``serving_warm`` — build a deterministic model, attach a
+                     ``CompileCache`` rooted at ``--ckpt-dir``,
+                     ``warm()``, predict across every batch bucket,
+                     report compile/warm counts + cache events.  Run
+                     twice against the same cache dir by the driving
+                     test: the second process must hold
+                     ``compile_count == 0`` (the warm-start proof).
+
 Replaces (and automates) the reference's manual two-executor
 integration script (pyzoo/test/zoo/ray/integration/ray_on_yarn.py:23-33).
 """
@@ -97,7 +108,7 @@ def parse_args(argv=None) -> argparse.Namespace:
                             "die_save", "data_train", "data_resume",
                             "data_preempt", "data_die",
                             "data_die_mid_epoch", "table_save",
-                            "table_restore"])
+                            "table_restore", "serving_warm"])
     p.add_argument("--ckpt-dir", default="",
                    help="checkpoint directory (enables checkpointing)")
     p.add_argument("--die-step", type=int, default=4,
@@ -380,6 +391,63 @@ def _run_table(args, pid: int, nproc: int) -> None:
                    "table_hashes": table_hashes()}, f)
 
 
+def _run_serving_warm(args, pid: int, nproc: int) -> None:
+    """Persistent compile-cache warm start across a REAL process
+    boundary (``serving_warm``).
+
+    Deterministic weights (seeded context + seeded data) make the model
+    fingerprint identical in every process, so a second run against the
+    same ``--ckpt-dir`` cache root addresses the exact entries the first
+    run persisted.  Cold process: one live compile (and one ``miss`` +
+    ``store``) per bucket.  Warm process: ``warm()`` pre-installs every
+    executable, ``compile_count`` stays 0 through full bucket coverage,
+    and the cache ledger shows only ``hit`` events.
+    """
+    import numpy as np
+
+    from analytics_zoo_tpu.deploy import CompileCache, InferenceModel
+    from analytics_zoo_tpu.nn import Sequential, reset_name_scope
+    from analytics_zoo_tpu.nn.layers.core import Activation, Dense
+    from analytics_zoo_tpu.train.optimizers import Adam
+
+    buckets = (1, 4, 8)
+    in_dim, out_dim = 12, 4
+    rs = np.random.RandomState(0)
+    reset_name_scope()
+    net = Sequential([Dense(16, input_shape=(in_dim,)), Activation("relu"),
+                      Dense(out_dim)])
+    net.compile(optimizer=Adam(1e-2), loss="mse")
+    x = rs.randn(32, in_dim).astype(np.float32)
+    net.fit(x, rs.randn(32, out_dim).astype(np.float32), batch_size=16,
+            nb_epoch=1, verbose=False)
+    m = InferenceModel.from_keras_net(net, net.estimator.params,
+                                      net.estimator.state,
+                                      batch_buckets=buckets)
+    cache = CompileCache(args.ckpt_dir)
+    m.attach_compile_cache(cache)
+    t0 = time.monotonic()
+    warmed = m.warm()
+    warm_s = time.monotonic() - t0
+
+    t0 = time.monotonic()
+    preds = {}
+    for b in buckets:
+        preds[b] = float(np.asarray(m.predict(x[:b])).sum())
+    coverage_s = time.monotonic() - t0
+
+    with open(args.outfile, "w") as f:
+        json.dump({"process_id": pid, "scenario": "serving_warm",
+                   "buckets": list(buckets),
+                   "fingerprint": m.fingerprint(),
+                   "warm_count": int(m.warm_count),
+                   "warmed": int(warmed),
+                   "warm_s": warm_s,
+                   "compile_count": int(m.compile_count),
+                   "coverage_s": coverage_s,
+                   "pred_sums": preds,
+                   "cache": cache.stats()}, f)
+
+
 def main() -> None:
     args = parse_args()
     pid, nproc = args.process_id, args.num_processes
@@ -422,6 +490,10 @@ def main() -> None:
 
     if args.scenario.startswith("table_"):
         _run_table(args, pid, nproc)
+        return
+
+    if args.scenario.startswith("serving_"):
+        _run_serving_warm(args, pid, nproc)
         return
 
     # deterministic problem; every process generates the full dataset and

@@ -1,0 +1,258 @@
+"""Autoscaler control loop (deploy/autoscale.py) — deterministic unit
+suite.
+
+Every test drives ``Autoscaler.check(signals=...)`` with fabricated
+signals and a fake clock, so hysteresis (consecutive-tick agreement),
+cooldown (quiet period after an action) and each (resource, direction)
+decision is asserted without threads, sleeps or a live pipeline.  The
+chaos soak (test_serving_chaos.py) proves the same loop against the
+real ClusterServing under shifting load.
+"""
+
+import pytest
+
+from analytics_zoo_tpu.core.profiling import TIMERS
+from analytics_zoo_tpu.deploy.autoscale import (ALL_MODELS, PIPELINE,
+                                                AutoscalePolicy, Autoscaler)
+
+
+class _FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+class _FakeBatcher:
+    def __init__(self, max_latency_ms=8.0):
+        self.max_latency = max_latency_ms / 1e3
+
+
+class _FakeCfg:
+    autoscale_cooldown_s = 5.0
+    max_inflight = 2
+
+
+class _FakeServing:
+    """Just the actuator surface the Autoscaler calls."""
+
+    def __init__(self):
+        self.cfg = _FakeCfg()
+        self._batcher = _FakeBatcher()
+        self.decode_workers = 2
+        self.replicas = {"resnet": 2, "bert": 1}
+        self.refuse_grow = set()    # models whose grow the budget refuses
+        self.calls = []
+
+    def resize_decode_pool(self, n):
+        self.calls.append(("decode", n))
+        self.decode_workers = n
+        return n
+
+    def resize_model_replicas(self, model, n):
+        self.calls.append(("replicas", model, n))
+        if n > self.replicas[model] and model in self.refuse_grow:
+            return self.replicas[model]     # budget refusal: no change
+        self.replicas[model] = n
+        return n
+
+    def set_batch_deadline_ms(self, ms):
+        self.calls.append(("deadline", ms))
+        self._batcher.max_latency = max(0.1, ms) / 1e3
+        return self._batcher.max_latency * 1e3
+
+
+def _sig(queue=0, inflight=0, decode=2, models=None):
+    return {"queue_depth": queue, "inflight": inflight, "max_inflight": 2,
+            "decode_workers": decode,
+            "models": models if models is not None else {
+                "resnet": {"replicas": 2, "healthy": 2,
+                           "slo_ms": 50.0, "p99_ms": 10.0}}}
+
+
+def _scaler(policy=None, **pol_kw):
+    srv = _FakeServing()
+    clock = _FakeClock()
+    kw = dict(hysteresis=2, cooldown_s=5.0)
+    kw.update(pol_kw)
+    pol = policy or AutoscalePolicy(**kw)
+    return Autoscaler(srv, policy=pol, clock=clock), srv, clock
+
+
+class TestHysteresis:
+    def test_single_breach_tick_does_nothing(self):
+        sc, srv, _ = _scaler()
+        sc.check(signals=_sig(queue=1000, decode=srv.decode_workers))
+        assert srv.calls == []
+
+    def test_consecutive_breaches_fire_once(self):
+        sc, srv, _ = _scaler()
+        for _ in range(2):
+            sc.check(signals=_sig(queue=1000, decode=srv.decode_workers))
+        assert ("decode", 4) in srv.calls
+        assert srv.decode_workers == 4
+
+    def test_interrupted_streak_resets(self):
+        sc, srv, _ = _scaler()
+        sc.check(signals=_sig(queue=1000, decode=2))
+        sc.check(signals=_sig(queue=0, decode=2))       # calm tick
+        sc.check(signals=_sig(queue=1000, decode=2))
+        assert all(c[0] != "decode" for c in srv.calls), (
+            "a broken streak must not count toward hysteresis")
+
+
+class TestCooldown:
+    def test_quiet_period_after_action(self):
+        sc, srv, clock = _scaler()
+        for _ in range(2):
+            sc.check(signals=_sig(queue=1000, decode=srv.decode_workers))
+        assert srv.decode_workers == 4
+        # still breached, hysteresis satisfied again — but cooling down
+        for _ in range(4):
+            sc.check(signals=_sig(queue=1000, decode=srv.decode_workers))
+        assert srv.decode_workers == 4
+        clock.advance(6.0)          # past cooldown_s=5
+        sc.check(signals=_sig(queue=1000, decode=srv.decode_workers))
+        assert srv.decode_workers == 8
+
+    def test_cooldown_is_per_model_and_resource(self):
+        """resnet's replica action must not gate bert's."""
+        sc, srv, _ = _scaler()
+        models = {
+            "resnet": {"replicas": 2, "healthy": 2,
+                       "slo_ms": 50.0, "p99_ms": 80.0},
+            "bert": {"replicas": 1, "healthy": 1,
+                     "slo_ms": 100.0, "p99_ms": 150.0},
+        }
+        for _ in range(2):
+            sc.check(signals=_sig(models=dict(models)))
+        assert ("replicas", "resnet", 3) in srv.calls
+        assert ("replicas", "bert", 2) in srv.calls
+
+
+class TestDecisions:
+    def test_decode_pool_shrinks_when_drained(self):
+        sc, srv, _ = _scaler()
+        for _ in range(2):
+            sc.check(signals=_sig(queue=0, decode=srv.decode_workers))
+        assert srv.decode_workers == 1
+
+    def test_decode_respects_bounds(self):
+        sc, srv, _ = _scaler(max_decode_workers=4)
+        srv.decode_workers = 4
+        for _ in range(4):
+            sc.check(signals=_sig(queue=1000, decode=4))
+        assert all(c[0] != "decode" for c in srv.calls)
+
+    def test_replicas_grow_on_slo_pressure(self):
+        sc, srv, _ = _scaler()
+        m = {"resnet": {"replicas": 2, "healthy": 2,
+                        "slo_ms": 50.0, "p99_ms": 60.0}}
+        for _ in range(2):
+            sc.check(signals=_sig(models=dict(m)))
+        assert srv.replicas["resnet"] == 3
+
+    def test_replicas_shrink_far_under_slo(self):
+        sc, srv, _ = _scaler()
+        m = {"resnet": {"replicas": 2, "healthy": 2,
+                        "slo_ms": 50.0, "p99_ms": 5.0}}
+        for _ in range(2):
+            sc.check(signals=_sig(models=dict(m)))
+        assert srv.replicas["resnet"] == 1
+
+    def test_no_slo_model_scales_on_saturation(self):
+        sc, srv, _ = _scaler()
+        m = {"resnet": {"replicas": 2, "healthy": 2,
+                        "slo_ms": 0.0, "p99_ms": 0.0}}
+        for _ in range(2):
+            sc.check(signals=_sig(queue=1000, inflight=2, models=dict(m)))
+        assert srv.replicas["resnet"] == 3
+
+    def test_deadline_raises_under_queue_pressure_when_slos_met(self):
+        sc, srv, clock = _scaler()
+        m = {"resnet": {"replicas": 8, "healthy": 8,    # replicas capped
+                        "slo_ms": 50.0, "p99_ms": 10.0}}
+        for _ in range(2):
+            sc.check(signals=_sig(queue=1000, models=dict(m)))
+        assert srv._batcher.max_latency == pytest.approx(16.0 / 1e3)
+
+    def test_deadline_halves_when_over_slo(self):
+        sc, srv, _ = _scaler()
+        m = {"resnet": {"replicas": 8, "healthy": 8,
+                        "slo_ms": 50.0, "p99_ms": 90.0}}
+        for _ in range(2):
+            sc.check(signals=_sig(models=dict(m)))
+        assert srv._batcher.max_latency == pytest.approx(4.0 / 1e3)
+
+    def test_budget_refused_grow_is_still_counted(self):
+        """A grow the HBM budget refuses still lands in the audit list /
+        metric (the operator sees the loop TRYING) — and cooldown then
+        stops it from hammering the budget check every tick."""
+        sc, srv, _ = _scaler()
+        srv.refuse_grow.add("resnet")
+        m = {"resnet": {"replicas": 2, "healthy": 2,
+                        "slo_ms": 50.0, "p99_ms": 60.0}}
+        for _ in range(2):
+            sc.check(signals=_sig(models=dict(m)))
+        assert srv.replicas["resnet"] == 2
+        acts = [a for a in sc.actions if a["resource"] == "replicas"]
+        assert len(acts) == 1
+        assert acts[0]["value"] == 2            # the refusal is visible
+
+
+class TestAudit:
+    def test_every_action_is_counted_and_labeled(self):
+        before = TIMERS.count("serving/autoscale_decode_workers_up")
+        sc, srv, _ = _scaler()
+        for _ in range(2):
+            sc.check(signals=_sig(queue=1000, decode=srv.decode_workers))
+        assert TIMERS.count("serving/autoscale_decode_workers_up") \
+            == before + 1
+        from analytics_zoo_tpu.observe import metrics as obs
+
+        key = ("serving_autoscale_actions_total",
+               (("direction", "up"), ("model", PIPELINE),
+                ("resource", "decode_workers")))
+        assert obs.METRICS.snapshot().counters.get(key, 0) >= 1
+
+    def test_actions_audit_records_detail(self):
+        sc, srv, clock = _scaler()
+        clock.advance(1.0)
+        for _ in range(2):
+            sc.check(signals=_sig(queue=1000, decode=srv.decode_workers))
+        a = next(a for a in sc.actions
+                 if a["resource"] == "decode_workers")
+        assert a["model"] == PIPELINE
+        assert a["direction"] == "up"
+        assert "queue depth" in a["detail"]
+        assert sc.stats()["actions"] >= 1
+
+    def test_deadline_actions_use_all_models_label(self):
+        sc, srv, _ = _scaler()
+        m = {"resnet": {"replicas": 8, "healthy": 8,
+                        "slo_ms": 50.0, "p99_ms": 90.0}}
+        for _ in range(2):
+            sc.check(signals=_sig(models=dict(m)))
+        a = next(a for a in sc.actions
+                 if a["resource"] == "batch_deadline")
+        assert a["model"] == ALL_MODELS
+
+
+class TestPolicyBounds:
+    def test_policy_normalizes_degenerate_bounds(self):
+        p = AutoscalePolicy(min_decode_workers=0, max_decode_workers=-3,
+                            min_replicas=0, max_replicas=0, hysteresis=0)
+        assert p.min_decode_workers == 1
+        assert p.max_decode_workers >= p.min_decode_workers
+        assert p.min_replicas == 1
+        assert p.max_replicas >= p.min_replicas
+        assert p.hysteresis == 1
+
+    def test_hysteresis_one_fires_immediately(self):
+        sc, srv, _ = _scaler(hysteresis=1)
+        sc.check(signals=_sig(queue=1000, decode=srv.decode_workers))
+        assert srv.decode_workers == 4

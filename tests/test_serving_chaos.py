@@ -569,3 +569,310 @@ class TestSpanChains:
                and d["attrs"].get("uri") == uri][-1]
         chain = TRACER.verify_chain(tid)
         assert chain["complete"] and chain["terminal"] == "expired"
+
+
+# ---------------------------------------------------------------------------
+# multi-model executor + shared-HBM-budget serving (ISSUE tentpole b)
+# ---------------------------------------------------------------------------
+
+class TestMultiModelExecutor:
+    def _req(self, results, done, model=None):
+        class _Req:
+            def __init__(self):
+                self.xs = [np.full((1, 4), 3.0, np.float32)]
+                self.n = 1
+
+            def callback(self, out, err):
+                results.append((out, err))
+                done.set()
+
+        r = _Req()
+        if model is not None:
+            r.model = model
+        return r
+
+    def test_batches_route_to_their_model_group(self):
+        ex = DeviceExecutor(
+            {"x2": [_sync_replica(lambda xs: xs[0] * 2.0)],
+             "p1": [_sync_replica(lambda xs: xs[0] + 1.0)]},
+            buckets=(1, 8), name="mm_route")
+        try:
+            for model, want in (("x2", 6.0), ("p1", 4.0)):
+                results, done = [], threading.Event()
+                ex.submit("k", [np.full((1, 4), 3.0, np.float32)],
+                          [self._req(results, done, model=model)])
+                assert done.wait(5.0)
+                out, err = results[0]
+                assert err is None
+                np.testing.assert_allclose(np.asarray(out),
+                                           np.full((1, 4), want), rtol=1e-6)
+        finally:
+            ex.stop()
+        states = ex.replica_states()
+        assert {s["model"] for s in states} == {"x2", "p1"}
+
+    def test_unknown_model_fails_typed_not_silently(self):
+        ex = DeviceExecutor(
+            {"only": [_sync_replica(lambda xs: xs[0])]},
+            buckets=(1, 8), name="mm_unknown")
+        try:
+            results, done = [], threading.Event()
+            ex.submit("k", [np.zeros((1, 4), np.float32)],
+                      [self._req(results, done, model="ghost")])
+            assert done.wait(5.0)
+            out, err = results[0]
+            assert out is None and err is not None
+            assert getattr(err, "code", "") == "malformed"
+        finally:
+            ex.stop()
+
+    def test_requests_without_model_attr_use_default_group(self):
+        """Legacy request objects (no ``model`` attr) keep working: they
+        route to the first/default group."""
+        ex = DeviceExecutor(
+            {"first": [_sync_replica(lambda xs: xs[0] * 10.0)],
+             "second": [_sync_replica(lambda xs: xs[0])]},
+            buckets=(1, 8), name="mm_legacy")
+        try:
+            results, done = [], threading.Event()
+            ex.submit("k", [np.full((1, 4), 2.0, np.float32)],
+                      [self._req(results, done)])
+            assert done.wait(5.0)
+            out, err = results[0]
+            assert err is None
+            np.testing.assert_allclose(np.asarray(out),
+                                       np.full((1, 4), 20.0), rtol=1e-6)
+        finally:
+            ex.stop()
+
+    def test_per_model_swap_replicas(self):
+        ex = DeviceExecutor(
+            {"a": [_sync_replica(lambda xs: xs[0])],
+             "b": [_sync_replica(lambda xs: xs[0])]},
+            buckets=(1, 8), name="mm_swap")
+        try:
+            ex.swap_replicas([_sync_replica(lambda xs: xs[0] * 3.0)],
+                             model="b")
+            results, done = [], threading.Event()
+            ex.submit("k", [np.full((1, 4), 2.0, np.float32)],
+                      [self._req(results, done, model="b")])
+            assert done.wait(5.0)
+            out, err = results[0]
+            assert err is None
+            np.testing.assert_allclose(np.asarray(out),
+                                       np.full((1, 4), 6.0), rtol=1e-6)
+            assert ex.group_size("a") == 1 and ex.group_size("b") == 1
+        finally:
+            ex.stop()
+
+
+class TestMultiModelServing:
+    def test_records_route_by_model_field(self):
+        """Two named models behind one pipeline: records carry a
+        ``model`` field, results come from the right forward, an unknown
+        model name is shed with a typed ``malformed`` error, and every
+        serving metric carries the ``{model}`` label."""
+        from analytics_zoo_tpu.observe import metrics as obs
+
+        ma = InferenceModel(lambda xs: xs[0] * 2.0, batch_buckets=(1, 8))
+        mb = InferenceModel(lambda xs: xs[0] + 5.0, batch_buckets=(1, 8))
+        q = MemoryQueue()
+        inp, outp = InputQueue(q), OutputQueue(q)
+        mark = obs.METRICS.snapshot()
+        srv = ClusterServing({"alpha": ma, "beta": mb}, q, ServingConfig(
+            batch_size=8, poll_timeout_s=0.02, max_batch_delay_ms=3,
+            decode_workers=2, replicas=1)).start()
+        try:
+            for i in range(8):      # no model field -> default (alpha)
+                inp.enqueue(uri=f"a{i}", x=np.full((4,), i, np.float32))
+            for i in range(8):
+                inp.enqueue(uri=f"b{i}", model="beta",
+                            x=np.full((4,), i, np.float32))
+            inp.enqueue(uri="ghost", model="nope",
+                        x=np.zeros((4,), np.float32))
+            got = _drain(outp, 17)
+            assert len(got) == 17
+            for i in range(8):
+                np.testing.assert_allclose(
+                    np.asarray(got[f"a{i}"]), np.full((4,), 2.0 * i),
+                    rtol=1e-6)
+                np.testing.assert_allclose(
+                    np.asarray(got[f"b{i}"]), np.full((4,), i + 5.0),
+                    rtol=1e-6)
+            v = got["ghost"]
+            assert isinstance(v, dict) and v["code"] == "malformed"
+            h = srv.health()
+            assert set(h["models"]) == {"alpha", "beta"}
+            assert h["models"]["beta"]["replicas"] == 1
+        finally:
+            srv.stop()
+        snap = obs.METRICS.snapshot()
+
+        def moved(name, **labels):
+            key = (name, tuple(sorted(labels.items())))
+            return (snap.counters.get(key, 0)
+                    - mark.counters.get(key, 0))
+
+        assert moved("serving_records_total", model="alpha",
+                     outcome="ok") >= 8
+        assert moved("serving_records_total", model="beta",
+                     outcome="ok") >= 8
+        assert moved("serving_shed_total", model="nope",
+                     code="malformed") >= 1
+
+    def test_hbm_budget_sheds_heaviest_replicas_first(self):
+        """The shared HBM budget bounds weight COPIES: while the summed
+        per-replica weight bytes exceed the budget, the heaviest model
+        group gives up a replica (never below 1)."""
+
+        class _Weighted:
+            def __init__(self, name, nbytes):
+                self.name = name
+                self._n = nbytes
+
+            def weight_nbytes(self):
+                return self._n
+
+        srv = ClusterServing(
+            {"heavy": _Weighted("heavy", 100), "light": _Weighted(
+                "light", 60)},
+            MemoryQueue(),
+            ServingConfig(replicas=3, hbm_budget_bytes=300))
+        plan = srv._plan_replicas()
+        assert plan == {"heavy": 1, "light": 3}
+        assert 100 * plan["heavy"] + 60 * plan["light"] <= 300
+
+    def test_hbm_budget_never_evicts_a_model_entirely(self):
+        class _Weighted:
+            def __init__(self, name, nbytes):
+                self.name = name
+                self._n = nbytes
+
+            def weight_nbytes(self):
+                return self._n
+
+        srv = ClusterServing(
+            {"a": _Weighted("a", 1000), "b": _Weighted("b", 1000)},
+            MemoryQueue(),
+            ServingConfig(replicas=2, hbm_budget_bytes=100))
+        plan = srv._plan_replicas()
+        assert plan == {"a": 1, "b": 1}     # budget bounds copies, not
+        assert min(plan.values()) == 1      # presence
+
+
+# ---------------------------------------------------------------------------
+# multi-model + autoscaler chaos soak (ISSUE acceptance)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+class TestMultiModelAutoscaleSoak:
+    def test_shifting_load_zero_lost_selective_shed_labeled_actions(self):
+        """Two models multiplexed under a shared HBM budget with the
+        autoscaler active under shifting load.  The acceptance bar:
+
+        - ZERO lost requests: every enqueued record terminates in a
+          result or a typed error;
+        - per-model SLO admission sheds ONLY the over-SLO model's
+          traffic (``laggy``, whose forward can never meet its 15ms
+          SLO); the well-behaved neighbour is never shed;
+        - every autoscale decision lands in
+          ``serving_autoscale_actions_total{model,resource,direction}``.
+        """
+        from analytics_zoo_tpu.deploy import AutoscalePolicy
+        from analytics_zoo_tpu.observe import metrics as obs
+
+        def fast_fwd(xs):
+            return xs[0] * 2.0
+
+        def laggy_fwd(xs):
+            time.sleep(0.03)
+            return xs[0] * 2.0
+
+        echo = InferenceModel(fast_fwd, batch_buckets=(1, 8))
+        laggy = InferenceModel(laggy_fwd, batch_buckets=(1, 8))
+        q = MemoryQueue()
+        inp, outp = InputQueue(q), OutputQueue(q)
+        cfg = ServingConfig(
+            batch_size=8, poll_timeout_s=0.02, max_batch_delay_ms=3,
+            decode_workers=2, replicas=2, supervisor_interval_s=0.05,
+            slo_p99_ms={"echo": 10_000.0, "laggy": 15.0},
+            hbm_budget_bytes=1 << 30,
+            autoscale=True, autoscale_interval_s=0.05,
+            autoscale_cooldown_s=0.05,
+            autoscale_policy=AutoscalePolicy(
+                hysteresis=1, cooldown_s=0.05, queue_high=8,
+                max_decode_workers=4, max_replicas=4,
+                min_batch_delay_ms=1.0, max_batch_delay_ms=20.0))
+        mark = obs.METRICS.snapshot()
+        srv = ClusterServing({"echo": echo, "laggy": laggy}, q, cfg).start()
+        sent = []
+        try:
+            # phase 1: balanced load — primes the admission windows
+            # (>= MIN_SAMPLES e2e observations per model)
+            for i in range(40):
+                inp.enqueue(uri=f"e{i}", model="echo",
+                            x=np.full((4,), i, np.float32))
+                inp.enqueue(uri=f"l{i}", model="laggy",
+                            x=np.full((4,), i, np.float32))
+                sent += [f"e{i}", f"l{i}"]
+            got = _drain(outp, len(sent), timeout=60.0)
+            assert len(got) == len(sent)
+
+            # phase 2: load shifts onto the laggy model
+            sent2 = []
+            for i in range(120):
+                inp.enqueue(uri=f"L{i}", model="laggy",
+                            x=np.full((4,), i, np.float32))
+                sent2.append(f"L{i}")
+                if i % 4 == 0:
+                    inp.enqueue(uri=f"E{i}", model="echo",
+                                x=np.full((4,), i, np.float32))
+                    sent2.append(f"E{i}")
+            got2 = _drain(outp, len(sent2), timeout=120.0)
+
+            # zero lost across BOTH phases
+            assert len(got2) == len(sent2), (
+                f"lost {len(sent2) - len(got2)} records")
+
+            # selective shed: only the over-SLO model's traffic
+            shed = {u: v for u, v in {**got, **got2}.items()
+                    if isinstance(v, dict) and v.get("code") == "overloaded"}
+            assert shed, "laggy model never shed despite a 15ms SLO"
+            assert all(u[0] in ("l", "L") for u in shed), (
+                f"well-behaved model was shed: {sorted(shed)[:5]}")
+            # the neighbour's answers are correct, not just present
+            for u, v in got2.items():
+                if u[0] == "E" and not isinstance(v, dict):
+                    i = int(u[1:])
+                    np.testing.assert_allclose(
+                        np.asarray(v), np.full((4,), 2.0 * i), rtol=1e-6)
+
+            # the autoscaler acted, and every action is in the labeled
+            # metric
+            deadline = time.monotonic() + 10.0
+            while not srv._autoscaler.actions \
+                    and time.monotonic() < deadline:
+                time.sleep(0.05)
+            actions = list(srv._autoscaler.actions)
+            assert actions, "autoscaler recorded no decisions under load"
+            snap = obs.METRICS.snapshot()
+            from collections import Counter
+            by_label = Counter((a["model"], a["resource"], a["direction"])
+                               for a in actions)
+            for (model, resource, direction), n in by_label.items():
+                key = ("serving_autoscale_actions_total",
+                       (("direction", direction), ("model", model),
+                        ("resource", resource)))
+                assert (snap.counters.get(key, 0)
+                        - mark.counters.get(key, 0)) >= n, (
+                    f"action {model}/{resource}/{direction} missing from "
+                    "the labeled metric")
+
+            h = srv.health()
+            assert set(h["models"]) == {"echo", "laggy"}
+            assert h["models"]["laggy"]["slo_p99_ms"] == 15.0
+            assert h["models"]["laggy"]["observed_p99_ms"] > 15.0
+            assert h["autoscale"]["actions"] >= len(by_label)
+        finally:
+            srv.stop()
+        assert not srv.is_alive()

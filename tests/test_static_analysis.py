@@ -131,6 +131,20 @@ def test_shm_ring_fixture():
     assert len(fs) == 1
 
 
+def test_compile_cache_fixture():
+    """The compile-cache ledger idiom (deploy/compile_cache.py): an
+    unlocked cross-thread hit/miss bump on the load path fires
+    THR-GUARD; the shipped lock-held twin stays quiet — so the cache
+    stats the warm-start proof reads keep a clean lint bill by
+    construction, not by suppression."""
+    fs = fixture_findings("compile_cache.py")
+    assert scopes_of(fs, "THR-GUARD") == {"NaiveCompileCache.load"}
+    quiet = {"LockedCompileCache.store", "LockedCompileCache.load",
+             "NaiveCompileCache.store"}
+    assert not quiet & {f.scope for f in fs}
+    assert len(fs) == 1
+
+
 def test_stream_uploader_fixture():
     """The STREAM shard-uploader idiom (data/streaming.ShardUploader):
     unlocked cross-thread upload stats fire THR-SHARED-MUT, and a
