@@ -37,7 +37,7 @@ from typing import Any, Dict, List, Optional
 
 from analytics_zoo_tpu.observe import metrics as obs
 
-__all__ = ["AutoscalePolicy", "Autoscaler"]
+__all__ = ["AutoscalePolicy", "Autoscaler", "audit_actions"]
 
 logger = logging.getLogger("analytics_zoo_tpu.deploy")
 
@@ -244,3 +244,60 @@ class Autoscaler:
     def stats(self) -> Dict[str, Any]:
         return {"actions": len(self.actions),
                 "last": self.actions[-1] if self.actions else None}
+
+    # -- audited-action export (the loadgen convergence assertions) --------
+
+    def export_actions(self) -> List[Dict[str, Any]]:
+        """Deep-copied audit list, safe to hold across further ticks."""
+        return [dict(a) for a in list(self.actions)]
+
+    def audit(self, flap_window_s: Optional[float] = None) -> Dict[str, Any]:
+        """Convergence audit over the applied-action ledger — see
+        :func:`audit_actions`.  The flap window defaults to twice the
+        policy cooldown: a reversal inside it means the dampers lost."""
+        return audit_actions(self.export_actions(),
+                             cooldown_s=self.policy.cooldown_s,
+                             now=self._clock(),
+                             flap_window_s=flap_window_s)
+
+
+def audit_actions(actions: List[Dict[str, Any]], cooldown_s: float,
+                  now: Optional[float] = None,
+                  flap_window_s: Optional[float] = None) -> Dict[str, Any]:
+    """Hysteresis audit over an action ledger (pure — tests feed
+    fabricated ledgers).
+
+    A **flap** is a direction reversal on the same (model, resource)
+    within ``flap_window_s`` (default ``2 * cooldown_s``) of the
+    previous action: up→down→up churn the hysteresis + cooldown
+    dampers exist to prevent.  ``quiet_s`` is the time since the last
+    action (None with no ``now``); the soak's convergence assertion is
+    ``flaps == 0`` plus a long-enough quiet tail.
+    """
+    window = float(flap_window_s if flap_window_s is not None
+                   else 2.0 * cooldown_s)
+    flaps: List[Dict[str, Any]] = []
+    last_by_key: Dict[tuple, Dict[str, Any]] = {}
+    by_label: Dict[str, int] = {}
+    for a in actions:
+        key = (a["model"], a["resource"])
+        label = f"{a['model']}/{a['resource']}/{a['direction']}"
+        by_label[label] = by_label.get(label, 0) + 1
+        prev = last_by_key.get(key)
+        if prev is not None and prev["direction"] != a["direction"] \
+                and a["t"] - prev["t"] < window:
+            flaps.append({"model": a["model"], "resource": a["resource"],
+                          "from": prev["direction"], "to": a["direction"],
+                          "gap_s": a["t"] - prev["t"]})
+        last_by_key[key] = a
+    last_t = actions[-1]["t"] if actions else None
+    return {
+        "total": len(actions),
+        "by_label": by_label,
+        "flap_window_s": window,
+        "flaps": len(flaps),
+        "flap_events": flaps,
+        "last_t": last_t,
+        "quiet_s": (None if now is None or last_t is None
+                    else max(0.0, now - last_t)),
+    }

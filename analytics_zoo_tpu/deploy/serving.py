@@ -2019,6 +2019,33 @@ class ClusterServing:
             self._batcher.max_latency = ms / 1e3
         return ms
 
+    # -- scenario hooks (the loadgen harness rides these) ------------------
+    def add_scenario_check(self, name: str, fn, every: int = 1) -> bool:
+        """Register an extra periodic check on the serving supervisor —
+        the loadgen harness uses it to export status snapshots and to
+        script mid-run events at the supervisor cadence.  Returns False
+        when there is no supervisor to ride (``supervise=False`` or the
+        sync engine)."""
+        if self._supervisor is None:
+            return False
+        self._supervisor.add_check(name, fn, every=every)
+        return True
+
+    def autoscale_actions(self) -> List[Dict[str, Any]]:
+        """The autoscaler's applied-action audit ledger (deep copies;
+        empty when autoscaling is off) — the convergence assertions in
+        the loadgen soak read this, not internals."""
+        if self._autoscaler is None:
+            return []
+        return self._autoscaler.export_actions()
+
+    def autoscale_audit(self) -> Optional[Dict[str, Any]]:
+        """Hysteresis audit over the action ledger (flap detection —
+        :func:`deploy.autoscale.audit_actions`); None when off."""
+        if self._autoscaler is None:
+            return None
+        return self._autoscaler.audit()
+
     def _publish_gauges(self) -> None:
         ex = self._executor
         if ex is not None:
@@ -2489,6 +2516,10 @@ class ClusterServing:
             h["compile_cache"] = self._compile_cache.stats()
         if self._autoscaler is not None:
             h["autoscale"] = self._autoscaler.stats()
+            # convergence at a glance (full flap events via autoscale_audit)
+            audit = self._autoscaler.audit()
+            h["autoscale"]["flaps"] = audit["flaps"]
+            h["autoscale"]["quiet_s"] = audit["quiet_s"]
         with self._scale_lock:
             h["decode_target"] = self._decode_target
         if self._hb is not None:

@@ -1,0 +1,48 @@
+"""Artifact generator: run every load leg and pin ``SLO_r16.json``.
+
+::
+
+    JAX_PLATFORMS=cpu python -m analytics_zoo_tpu.loadgen \
+        --out SLO_r16.json [--workdir /tmp/loadgen] [--quick]
+
+The artifact's schema and the doc-pinned rows are described in
+docs/LOADGEN.md; ``tests/test_doc_drift.py`` machine-checks the pinned
+``SLO_TABLE`` blocks against the newest ``SLO_*.json`` in the repo
+root.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import tempfile
+import time
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--out", default="SLO_r16.json")
+    p.add_argument("--workdir", default=None,
+                   help="scratch dir for the kill leg's spool/cache "
+                        "(a fresh tempdir when omitted)")
+    p.add_argument("--quick", action="store_true",
+                   help="halved durations for smoke runs (never for "
+                        "the pinned artifact)")
+    args = p.parse_args(argv)
+
+    from analytics_zoo_tpu.loadgen.harness import default_report
+    from analytics_zoo_tpu.loadgen.slo import write_artifact
+
+    workdir = args.workdir or tempfile.mkdtemp(prefix="loadgen-")
+    t0 = time.monotonic()
+    report = default_report(workdir, quick=args.quick)
+    report["run_metadata"]["wall_s"] = round(time.monotonic() - t0, 2)
+    write_artifact(args.out, report)
+    print(f"wrote {os.path.abspath(args.out)} "
+          f"({report['run_metadata']['wall_s']}s)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

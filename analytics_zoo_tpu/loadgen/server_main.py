@@ -1,0 +1,155 @@
+"""Standalone serving process for cross-process load legs.
+
+Runs a full ``ClusterServing`` pipeline over a ``FileQueue`` spool so
+real OS-process clients (``loadgen/client_main.py`` or the in-process
+kill-leg client) can reach it from outside.  The model is built
+DETERMINISTICALLY — seeded weights, seeded data, reset name scope — so
+every process that runs this module produces the identical fingerprint
+and a successor process warm-starts from the predecessor's persistent
+compile cache with zero live compiles.
+
+The process periodically dumps a status JSON (atomic replace) carrying
+the warm-start proof (``compile_count``, ``warm_count``, cache event
+counts) plus serving health; the kill leg reads it instead of scraping
+logs.  SIGTERM stops cleanly (final status dump, exit 0); SIGKILL is
+the point — the kill leg sends it mid-storm.
+
+Usage::
+
+    python -m analytics_zoo_tpu.loadgen.server_main \
+        --queue-root /tmp/spool --cache-dir /tmp/cache \
+        --status-file /tmp/server.status.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import threading
+import time
+from typing import Any, Dict
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--queue-root", required=True)
+    p.add_argument("--queue-name", default="loadgen_stream")
+    p.add_argument("--cache-dir", required=True)
+    p.add_argument("--status-file", required=True)
+    p.add_argument("--slo-p99-ms", type=float, default=1000.0)
+    p.add_argument("--batch-size", type=int, default=8)
+    p.add_argument("--decode-workers", type=int, default=2)
+    p.add_argument("--max-batch-delay-ms", type=float, default=3.0)
+    p.add_argument("--status-interval", type=int, default=2,
+                   help="dump status every N supervisor ticks")
+    p.add_argument("--autoscale", action="store_true")
+    return p.parse_args(argv)
+
+
+def build_model():
+    """The deterministic two-layer Dense model shared by every loadgen
+    server process (same idiom as tests/multiprocess_worker.py's
+    ``serving_warm`` scenario: seeded context + seeded data => identical
+    fingerprint in every process)."""
+    import numpy as np
+
+    from analytics_zoo_tpu.deploy import InferenceModel
+    from analytics_zoo_tpu.nn import Sequential, reset_name_scope
+    from analytics_zoo_tpu.nn.layers.core import Activation, Dense
+    from analytics_zoo_tpu.train.optimizers import Adam
+
+    buckets = (1, 4, 8)
+    in_dim, out_dim = 12, 4
+    rs = np.random.RandomState(0)
+    reset_name_scope()
+    net = Sequential([Dense(16, input_shape=(in_dim,)),
+                      Activation("relu"), Dense(out_dim)])
+    net.compile(optimizer=Adam(1e-2), loss="mse")
+    x = rs.randn(32, in_dim).astype(np.float32)
+    net.fit(x, rs.randn(32, out_dim).astype(np.float32), batch_size=16,
+            nb_epoch=1, verbose=False)
+    return InferenceModel.from_keras_net(net, net.estimator.params,
+                                         net.estimator.state,
+                                         batch_buckets=buckets)
+
+
+def _dump_status(path: str, payload: Dict[str, Any]) -> None:
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(payload, f, indent=2, sort_keys=True)
+    os.replace(tmp, path)
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    from analytics_zoo_tpu.deploy import ClusterServing, ServingConfig
+    from analytics_zoo_tpu.deploy.serving import FileQueue
+
+    model = build_model()
+    q = FileQueue(args.queue_root, name=args.queue_name)
+    cfg = ServingConfig(
+        batch_size=args.batch_size, poll_timeout_s=0.05,
+        max_batch_delay_ms=args.max_batch_delay_ms,
+        decode_workers=args.decode_workers,
+        supervisor_interval_s=0.1,
+        compile_cache_dir=args.cache_dir,
+        slo_p99_ms={"default": args.slo_p99_ms},
+        autoscale=args.autoscale, autoscale_interval_s=0.2,
+        autoscale_cooldown_s=0.5)
+    srv = ClusterServing({"default": model}, q, cfg).start()
+
+    # Full bucket coverage through the REPLICA dispatch path before
+    # declaring ready: replica programs carry their target device in
+    # the cache signature, so predict()-side coverage would persist a
+    # different flavor than the one the pipeline executes.  The cold
+    # process stores every (bucket, device) executable; a successor
+    # warm-starts the whole set and serves the storm with zero live
+    # compiles.
+    import numpy as np
+    xcov = np.random.RandomState(1).randn(8, 12).astype(np.float32)
+    rep = model.replica_forwards(n=1)[0]
+    for b in model.batch_buckets:
+        rep.harvest(rep.dispatch([xcov[:b]]))
+
+    def status_payload() -> Dict[str, Any]:
+        h = srv.health()
+        audit = srv.autoscale_audit()
+        return {
+            "ready": True,
+            "pid": os.getpid(),
+            "t": time.time(),
+            "fingerprint": model.fingerprint(),
+            "compile_count": int(model.compile_count),
+            "warm_count": int(model.warm_count),
+            "cache": h.get("compile_cache"),
+            "records_served": h.get("records_served"),
+            "queue": h.get("queue"),
+            "models": h.get("models"),
+            "autoscale_flaps": (audit or {}).get("flaps"),
+        }
+
+    def dump() -> None:
+        try:
+            _dump_status(args.status_file, status_payload())
+        except Exception:           # status is best-effort telemetry
+            pass
+
+    dump()                          # the readiness barrier for callers
+    srv.add_scenario_check("loadgen_status_dump", dump,
+                           every=args.status_interval)
+
+    stop_evt = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop_evt.set())
+    signal.signal(signal.SIGINT, lambda *_: stop_evt.set())
+    while not stop_evt.is_set():
+        stop_evt.wait(0.2)
+    srv.stop()
+    dump()                          # final post-traffic truth
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -81,6 +81,23 @@ def _remaining() -> float:
     return _BUDGET_S - (time.time() - _T0)
 
 
+# Typed skip reasons: every leg the runner elides goes through _skip()
+# so the artifact's skip markers form a closed vocabulary that drift
+# checks and dashboards can rely on (no free-form strings).
+SKIP_TIME_BUDGET = "time budget"
+SKIP_SHM = "POSIX shared memory unavailable"
+_SKIP_REASONS = frozenset({SKIP_TIME_BUDGET, SKIP_SHM})
+
+
+def _skip(into, name, reason=SKIP_TIME_BUDGET):
+    """Record one skipped leg as ``{name}_skipped: reason`` (the key
+    shape r4 pinned) and reject unknown reasons loudly."""
+    if reason not in _SKIP_REASONS:
+        raise ValueError(f"unknown skip reason: {reason!r}")
+    into[f"{name}_skipped"] = reason
+    return into
+
+
 def _safe_ratio(num, den, nd=2):
     """Ratio of two measurements, or None when either side is missing,
     non-finite, or non-positive.  r5 shipped flash_vs_stock=Infinity
@@ -1760,6 +1777,7 @@ def bench_serving(n_requests=32, concurrency=8, n_saturated=256):
     from analytics_zoo_tpu.deploy import (
         ClusterServing, DynamicBatcher, InferenceModel, InputQueue,
         MemoryQueue, OutputQueue, ServingConfig)
+    from analytics_zoo_tpu.loadgen.payloads import saturated_images
     from analytics_zoo_tpu.models.image.imageclassification import mobilenet
     from analytics_zoo_tpu.nn import reset_name_scope
 
@@ -1877,8 +1895,7 @@ def bench_serving(n_requests=32, concurrency=8, n_saturated=256):
         # Timers reset first: the breakdown must attribute the steady
         # state, not warmup compiles.
         TIMERS.reset()
-        sat = [crs.randint(0, 256, (224, 224, 3)).astype(np.uint8)
-               for _ in range(n_saturated)]
+        sat = saturated_images(n_saturated, rs=crs)
         t0 = time.perf_counter()
         for i, im in enumerate(sat):
             inp.enqueue(uri=f"sat{i}", x=im)
@@ -1949,8 +1966,7 @@ def bench_serving(n_requests=32, concurrency=8, n_saturated=256):
             outp2.query("warm1", timeout=600.0)
             TIMERS.reset()
             crs = np.random.RandomState(11)
-            sat = [crs.randint(0, 256, (224, 224, 3)).astype(np.uint8)
-                   for _ in range(n_saturated)]
+            sat = saturated_images(n_saturated, rs=crs)
             t0 = time.perf_counter()
             for i, im in enumerate(sat):
                 inp2.enqueue(uri=f"shm{i}", x=im)
@@ -1991,7 +2007,7 @@ def bench_serving(n_requests=32, concurrency=8, n_saturated=256):
             srv2.stop()
             q2.stop()
     else:
-        out["serving_shm"] = {"skipped": "POSIX shared memory unavailable"}
+        out["serving_shm"] = {"skipped": SKIP_SHM}
     return out
 
 
@@ -2148,7 +2164,7 @@ def bench_serving_wire_codecs(n_codec=64, n_queue=256):
             finally:
                 qs.stop()
         else:
-            qp["shm_skipped"] = "POSIX shared memory unavailable"
+            _skip(qp, "shm", SKIP_SHM)
         out["queue_path"][dtype_name] = qp
     return out
 
@@ -2447,7 +2463,7 @@ def main():
         except Exception as e:
             extra["embedding_bag_error"] = f"{type(e).__name__}: {e}"
     else:
-        extra["embedding_bag_skipped"] = "time budget"
+        _skip(extra, "embedding_bag")
     _mark("embedding_bag", t0)
 
     t0 = time.time()
@@ -2457,7 +2473,7 @@ def main():
         except Exception as e:
             extra["dequant_matmul_error"] = f"{type(e).__name__}: {e}"
     else:
-        extra["dequant_matmul_skipped"] = "time budget"
+        _skip(extra, "dequant_matmul")
     _mark("dequant_matmul", t0)
 
     # BASELINE config #5: serving latency + batched throughput
@@ -2486,7 +2502,7 @@ def main():
         except Exception as e:
             extra["serving_restart_to_slo_error"] = f"{type(e).__name__}: {e}"
     else:
-        extra["serving_restart_to_slo_skipped"] = "time budget"
+        _skip(extra, "serving_restart_to_slo")
     _mark("serving_restart_to_slo", t0)
 
     # BASELINE config #4: WideAndDeep throughput
@@ -2555,7 +2571,7 @@ def main():
         except Exception as e:
             extra["data_paths_error"] = f"{type(e).__name__}: {e}"
     else:
-        extra["data_paths_skipped"] = "time budget"
+        _skip(extra, "data_paths")
     _mark("data_paths", t0)
 
     # streaming tier evidence (ISSUE 10): a dataset 4x the device budget
@@ -2570,7 +2586,7 @@ def main():
         except Exception as e:
             extra["featureset_streaming_error"] = f"{type(e).__name__}: {e}"
     else:
-        extra["featureset_streaming_skipped"] = "time budget"
+        _skip(extra, "featureset_streaming")
     _mark("featureset_streaming", t0)
 
     # sharded giant-embedding evidence (ISSUE 14): per-chip table HBM
@@ -2586,7 +2602,7 @@ def main():
             extra["dlrm_sharded_embedding_error"] = \
                 f"{type(e).__name__}: {e}"
     else:
-        extra["dlrm_sharded_embedding_skipped"] = "time budget"
+        _skip(extra, "dlrm_sharded_embedding")
     _mark("dlrm_sharded_embedding", t0)
 
     # durability layer cost (ISSUE 3): verified-checkpoint overhead on
@@ -2599,7 +2615,7 @@ def main():
         except Exception as e:
             extra["checkpoint_overhead_error"] = f"{type(e).__name__}: {e}"
     else:
-        extra["checkpoint_overhead_skipped"] = "time budget"
+        _skip(extra, "checkpoint_overhead")
     _mark("checkpoint_overhead", t0)
 
     # north-star evidence in ONE run: matched-accuracy convergence with
@@ -2621,7 +2637,7 @@ def main():
         except Exception as e:
             extra["ncf_convergence_error"] = f"{type(e).__name__}: {e}"
     else:
-        extra["ncf_convergence_skipped"] = "time budget"
+        _skip(extra, "ncf_convergence")
     _mark("ncf_convergence", t0)
 
     # BASELINE config #2: ResNet-50 imgs/sec — one sound launch-amortized
@@ -2647,7 +2663,7 @@ def main():
         except Exception as e:
             extra["resnet50_error"] = f"{type(e).__name__}: {e}"
     else:
-        extra["resnet50_skipped"] = "time budget"
+        _skip(extra, "resnet50")
     _mark("resnet50", t0)
 
     # config #2 accuracy leg: cats-vs-dogs-shaped convergence
@@ -2658,7 +2674,7 @@ def main():
         except Exception as e:
             extra["resnet_accuracy_error"] = f"{type(e).__name__}: {e}"
     else:
-        extra["resnet_accuracy_skipped"] = "time budget"
+        _skip(extra, "resnet_accuracy")
     _mark("resnet_accuracy", t0)
 
     # Pallas flash attention on silicon vs the STOCK pallas kernel
@@ -2676,12 +2692,12 @@ def main():
         specs.append((8192, dict(include_bwd=False,
                                  include_blockwise=False)))
     else:
-        extra["attention_l8192_skipped"] = "time budget"
+        _skip(extra, "attention_l8192")
     if _remaining() > 140:
         specs.append((1024, dict(include_bwd=False,
                                  include_blockwise=False)))
     else:
-        extra["attention_l1024_skipped"] = "time budget"
+        _skip(extra, "attention_l1024")
     try:
         bench_attention_suite(accel, specs, into=extra)
     except Exception as e:

@@ -1,10 +1,18 @@
 """Shared harness for the multi-process integration/chaos suites.
 
-Spawns N ``multiprocess_worker.py`` OS processes joined through a gloo
-coordination service on a free localhost port, with the topology and
-scenario fully CLI-driven.  Worker stdout/stderr is teed to
-``ZOO_MP_LOG_DIR`` (default: the test's tmp dir) so CI can upload the
-logs as an artifact when a chaos scenario goes sideways.
+Spawns real OS processes with stdout/stderr teed to ``ZOO_MP_LOG_DIR``
+(default: the test's tmp dir) so CI can upload the logs as an artifact
+when a chaos scenario goes sideways.
+
+Two layers:
+
+- ``start_processes`` / ``finish_processes`` / ``run_processes`` spawn
+  ARBITRARY argv lists (any entrypoint module — the loadgen client
+  fan-in uses this to launch ``analytics_zoo_tpu.loadgen.client_main``
+  processes against a shared FileQueue spool).
+- ``run_workers`` keeps the original ``multiprocess_worker.py`` API
+  byte-compatible: N workers joined through a gloo coordination
+  service on a free localhost port, topology and scenario CLI-driven.
 """
 
 import json
@@ -29,6 +37,78 @@ def _log_dir(tmp_path) -> str:
     return d
 
 
+def _spawn_env(env_extra: Optional[Dict[str, str]] = None) -> Dict[str, str]:
+    """Child env: the accelerator-topology vars the parent test runner
+    set for itself must NOT leak into children that build their own
+    (XLA_FLAGS device counts, JAX_PLATFORMS).  ``env_extra`` overlays
+    on top — loadgen children pass ``{"JAX_PLATFORMS": "cpu"}`` back in
+    deliberately."""
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    env.update(env_extra or {})
+    return env
+
+
+def start_processes(argvs: List[List[str]], *,
+                    env_extra: Optional[Dict[str, str]] = None
+                    ) -> List[subprocess.Popen]:
+    """Launch one OS process per argv (stdout+stderr captured for the
+    log tee).  Pair with ``finish_processes``; callers that need to
+    signal/kill mid-run hold the Popens in between."""
+    return [subprocess.Popen([str(a) for a in argv],
+                             env=_spawn_env(env_extra),
+                             stdout=subprocess.PIPE,
+                             stderr=subprocess.STDOUT, text=True)
+            for argv in argvs]
+
+
+def finish_processes(procs: List[subprocess.Popen], tmp_path, tag: str, *,
+                     timeout: float = 240,
+                     expect_rc: Optional[Dict[int, int]] = None,
+                     outfiles: Optional[List] = None
+                     ) -> List[Optional[dict]]:
+    """Wait for every process, tee its log to ``ZOO_MP_LOG_DIR`` as
+    ``{tag}_{i}.log``, assert exit codes (default 0; negative values
+    assert death-by-signal), and parse ``outfiles`` JSONs when given.
+
+    Returns the parsed outfile JSON per process (or None where a
+    process expected to die wrote none); with no ``outfiles``, a list
+    of Nones sized like ``procs``.
+    """
+    logs = _log_dir(tmp_path)
+    captured = [p.communicate(timeout=timeout)[0] for p in procs]
+    for i, (p, log) in enumerate(zip(procs, captured)):
+        with open(os.path.join(logs, f"{tag}_{i}.log"), "w") as f:
+            f.write(log or "")
+        want = (expect_rc or {}).get(i, 0)
+        assert p.returncode == want, (
+            f"process {i} exited {p.returncode}, expected {want}:\n"
+            f"{(log or '')[-3000:]}")
+    results: List[Optional[dict]] = []
+    for i, out in enumerate(outfiles or [None] * len(procs)):
+        if out is not None and os.path.exists(str(out)):
+            with open(str(out)) as f:
+                results.append(json.load(f))
+        else:
+            assert out is None or (expect_rc or {}).get(i, 0) != 0, (
+                f"process {i} exited cleanly but wrote no outfile")
+            results.append(None)
+    return results
+
+
+def run_processes(argvs: List[List[str]], tmp_path, tag: str, *,
+                  env_extra: Optional[Dict[str, str]] = None,
+                  timeout: float = 240,
+                  expect_rc: Optional[Dict[int, int]] = None,
+                  outfiles: Optional[List] = None
+                  ) -> List[Optional[dict]]:
+    """``start_processes`` + ``finish_processes`` in one shot, for legs
+    with no mid-run signalling."""
+    procs = start_processes(argvs, env_extra=env_extra)
+    return finish_processes(procs, tmp_path, tag, timeout=timeout,
+                            expect_rc=expect_rc, outfiles=outfiles)
+
+
 def run_workers(nproc: int, tmp_path, tag: str, *,
                 scenario: str = "train",
                 ckpt_dir: Optional[str] = None,
@@ -50,10 +130,7 @@ def run_workers(nproc: int, tmp_path, tag: str, *,
     expected exit code is non-zero).
     """
     port = _free_port()
-    env = {k: v for k, v in os.environ.items()
-           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
-    logs = _log_dir(tmp_path)
-    procs, outs = [], []
+    argvs, outs = [], []
     for pid in range(nproc):
         out = tmp_path / f"{tag}_{pid}.json"
         outs.append(out)
@@ -77,23 +154,6 @@ def run_workers(nproc: int, tmp_path, tag: str, *,
             cmd += ["--data-budget", str(data_budget)]
         if mesh is not None:
             cmd += ["--mesh", mesh]
-        procs.append(subprocess.Popen(
-            cmd, env=env, stdout=subprocess.PIPE,
-            stderr=subprocess.STDOUT, text=True))
-    captured = [p.communicate(timeout=timeout)[0] for p in procs]
-    for pid, (p, log) in enumerate(zip(procs, captured)):
-        with open(os.path.join(logs, f"{tag}_{pid}.log"), "w") as f:
-            f.write(log)
-        want = (expect_rc or {}).get(pid, 0)
-        assert p.returncode == want, (
-            f"worker {pid} exited {p.returncode}, expected {want}:\n"
-            f"{log[-3000:]}")
-    results: List[Optional[dict]] = []
-    for pid, out in enumerate(outs):
-        if out.exists():
-            results.append(json.loads(out.read_text()))
-        else:
-            assert (expect_rc or {}).get(pid, 0) != 0, (
-                f"worker {pid} exited cleanly but wrote no outfile")
-            results.append(None)
-    return results
+        argvs.append(cmd)
+    return run_processes(argvs, tmp_path, tag, timeout=timeout,
+                         expect_rc=expect_rc, outfiles=outs)

@@ -173,6 +173,81 @@ class TestObservabilityDocDrift:
                          + "\n  ".join(bad))
 
 
+LOADGEN_DOC = REPO / "docs" / "LOADGEN.md"
+
+_SLO_TABLE_RE = re.compile(
+    r"<!--\s*SLO_TABLE:BEGIN([^>]*)-->(.*?)<!--\s*SLO_TABLE:END\s*-->",
+    re.S)
+
+
+def _newest_slo_artifact():
+    arts = sorted(REPO.glob("SLO_*.json"))
+    if not arts:
+        pytest.skip("no SLO_*.json artifact in repo root")
+    return arts[-1]
+
+
+def _pinned_slo_tables():
+    """SLO_TABLE blocks in docs/LOADGEN.md — same marker/attr grammar
+    as BENCH_TABLE (``requires=`` gates a table on artifacts that have
+    the key; ``tolerance=`` sets the relative tolerance, 0 pins an
+    exact invariant like warm_compile_count)."""
+    tables = []
+    for m in _SLO_TABLE_RE.finditer(LOADGEN_DOC.read_text()):
+        attrs = dict(re.findall(r"(\w+)=(\S+)", m.group(1)))
+        claims = []
+        for line in m.group(2).splitlines():
+            cells = [c.strip() for c in line.strip().strip("|").split("|")]
+            if (len(cells) != 2 or cells[0] in ("key", "")
+                    or "---" in cells[0]):
+                continue
+            claims.append((cells[0], float(cells[1])))
+        assert claims, "a pinned SLO table is empty"
+        tables.append({"requires": attrs.get("requires"),
+                       "tolerance": float(attrs.get("tolerance", 0.02)),
+                       "claims": claims})
+    assert tables, "LOADGEN.md lost its SLO_TABLE markers"
+    return tables
+
+
+class TestLoadgenDocDrift:
+    """docs/LOADGEN.md's pinned SLO rows == the newest SLO_*.json."""
+
+    def test_pinned_slo_claims_match_newest_artifact(self):
+        art = _newest_slo_artifact()
+        doc = json.loads(art.read_text())
+        bad = []
+        for table in _pinned_slo_tables():
+            req = table["requires"]
+            if req and _resolve(doc, req, required=False) is None:
+                continue        # artifact predates this load leg
+            for key, claimed in table["claims"]:
+                actual = _resolve(doc, key)
+                assert isinstance(actual, (int, float)), \
+                    f"{key} resolves to non-numeric {actual!r}"
+                if actual != pytest.approx(claimed,
+                                           rel=table["tolerance"]):
+                    bad.append(f"{key}: doc={claimed} artifact={actual}")
+        assert not bad, (f"LOADGEN.md drifted from {art.name}:\n  "
+                         + "\n  ".join(bad))
+
+    def test_slo_tables_pin_the_hard_invariants(self):
+        """Grammar + coverage, artifact or not: the doc of record must
+        pin the three invariants the chaos soak proves — zero live
+        compiles after a warm restart, shed confined to the over-SLO
+        model, and the open-loop property."""
+        tables = _pinned_slo_tables()
+        keys = {k for t in tables for k, _ in t["claims"]}
+        for must in ("parsed.kill.warm_compile_count",
+                     "parsed.mix_shift.only_over_slo_shed",
+                     "parsed.open_loop.offered_rate_independent"):
+            assert must in keys, f"LOADGEN.md no longer pins {must}"
+        # exact invariants live in a zero-tolerance table
+        strict = [t for t in tables if t["tolerance"] == 0.0]
+        assert strict, "LOADGEN.md lost its zero-tolerance SLO table"
+        assert any(t["requires"] for t in tables)
+
+
 def _bench():
     spec = importlib.util.spec_from_file_location("bench", REPO / "bench.py")
     mod = importlib.util.module_from_spec(spec)
