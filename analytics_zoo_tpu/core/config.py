@@ -85,6 +85,17 @@ class ZooConfig:
     # (Pallas on TPU above its win threshold), "on" insists on the
     # kernel wherever shapes allow, "off" pins the XLA gather path.
     fused_embedding: str = "auto"
+    # Ring-attention routing (ops/ring_attention.py) for sequence-
+    # parallel long context: "auto" rings only on a mesh with a >1-way
+    # seq axis above RING_MIN_LEN tokens, "on" insists wherever a mesh
+    # allows, "off" pins the single-device blockwise path.
+    ring_attention: str = "auto"
+    # Sequence shards for the attention layers when no explicit
+    # sequence-parallel regime is active: >1 makes MultiHeadAttention
+    # build a seq mesh over that many devices and route self-attention
+    # through the ring (docs/PARALLELISM.md "Sequence parallelism").
+    # 0 = off (a compile(sharding="sp") regime still takes precedence).
+    seq_shards: int = 0
 
     # --- serving ---------------------------------------------------------
     # Pipelined serving engine (docs/SERVING.md).  The DynamicBatcher

@@ -7,9 +7,9 @@ Redis-stream streaming inference), plus the Python client
 """
 
 from analytics_zoo_tpu.deploy.inference import (  # noqa: F401
-    BatchRequest, DynamicBatcher, InferenceModel, ModelReplica,
-    dequantize_pytree, imagenet_preprocess, plan_buckets,
-    quantize_pytree, scatter_batch_results)
+    LONG_DOC_TOKENS, BatchRequest, DynamicBatcher, InferenceModel,
+    ModelReplica, bucket_class, dequantize_pytree, imagenet_preprocess,
+    plan_buckets, quantize_pytree, scatter_batch_results)
 from analytics_zoo_tpu.deploy.autoscale import (  # noqa: F401
     AutoscalePolicy, Autoscaler)
 from analytics_zoo_tpu.deploy.compile_cache import (  # noqa: F401

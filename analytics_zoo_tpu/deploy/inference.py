@@ -35,7 +35,8 @@ import numpy as np
 
 __all__ = ["InferenceModel", "DynamicBatcher", "BatchRequest",
            "ModelReplica", "scatter_batch_results", "quantize_pytree",
-           "dequantize_pytree", "plan_buckets", "DEFAULT_MODEL"]
+           "dequantize_pytree", "plan_buckets", "bucket_class",
+           "LONG_DOC_TOKENS", "DEFAULT_MODEL"]
 
 # the implicit model name for single-model serving paths; multi-model
 # callers (ClusterServing with a dict of models) use their own names
@@ -65,7 +66,24 @@ def _next_bucket(n: int, buckets: Sequence[int]) -> int:
     return buckets[-1]
 
 
-def plan_buckets(n: int, buckets: Sequence[int]) -> List[tuple]:
+# Requests at or past this many tokens belong to the "long_doc" bucket
+# class: attention compute is O(L²)-dominated, so fusing rows into wide
+# batch buckets only multiplies an already-saturating program.  Long-doc
+# batches plan at the SMALLEST row bucket and the executor routes them
+# to a mesh replica whose attention shards L ring-wise over the mesh
+# (ops/ring_attention.py) — per-chip memory O(L/ways).
+LONG_DOC_TOKENS = 32768
+
+
+def bucket_class(tokens: Optional[int]) -> str:
+    """Which bucket class a request of ``tokens`` sequence length falls
+    in: ``"long_doc"`` (>= LONG_DOC_TOKENS) or ``"short"``."""
+    return ("long_doc" if tokens is not None
+            and int(tokens) >= LONG_DOC_TOKENS else "short")
+
+
+def plan_buckets(n: int, buckets: Sequence[int],
+                 tokens: Optional[int] = None) -> List[tuple]:
     """Split ``n`` rows into ``[(rows, bucket), ...]`` chunks.
 
     Full ``buckets[-1]``-row chunks first, then one tail chunk padded up
@@ -73,7 +91,16 @@ def plan_buckets(n: int, buckets: Sequence[int]) -> List[tuple]:
     compile-shape ledger (`InferenceModel.predict`) and the executor's
     replica dispatch (`serving.DeviceExecutor._dispatch`) plan through
     it, so the set of program shapes they produce can never disagree.
+
+    ``tokens`` (the request's sequence length) selects the bucket class:
+    in the ``"long_doc"`` class (>= LONG_DOC_TOKENS) every chunk is the
+    SMALLEST row bucket — each sequence-saturated program owns the whole
+    mesh replica, and the compiled-shape set stays one program per class
+    instead of one per (rows × length) combination.
     """
+    if bucket_class(tokens) == "long_doc":
+        cap = buckets[0]
+        return [(min(n - s, cap), cap) for s in range(0, n, cap)]
     out: List[tuple] = []
     cap = buckets[-1]
     s = 0
@@ -615,6 +642,48 @@ class InferenceModel:
                                     on_device_topn=bool(top_n),
                                     pads_input=True))
         return out
+
+    def mesh_replica(self, mesh, top_n: Optional[int] = None
+                     ) -> "ModelReplica":
+        """One serving replica spanning a whole ``Mesh`` — the
+        long-document executor slot (docs/SERVING.md "Long-document
+        bucket class").  Weights are placed replicated over the mesh
+        once; each dispatch runs the forward with all mesh devices
+        cooperating, so a net whose attention shards the sequence axis
+        (``seq_shards`` → ops/ring_attention.py) holds only O(L/ways)
+        of K/V per chip instead of the full 32k–128k context.  The AOT
+        compile-cache signature carries a device descriptor, so the
+        mesh program warms independently of the single-chip buckets.
+        """
+        if self._net is None:
+            raise ValueError(
+                "mesh_replica needs a native net (from_keras_net/load); "
+                "foreign forwards have no mesh-placeable param tree")
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        rep = NamedSharding(mesh, PartitionSpec())
+        fwd = self._build_param_forward(top_n=top_n)
+        weights = self._qparams if self._int8 else self._params
+        p_i = jax.device_put(weights, rep)
+        s_i = jax.device_put(self._state, rep)
+        desc = "mesh:" + "x".join(
+            f"{k}={v}" for k, v in mesh.shape.items())
+
+        def dispatch(xs):
+            self._note_shapes(xs, tag=desc)
+            xd = [jax.device_put(jnp.asarray(x), rep) for x in xs]
+            if self._cache is not None:
+                prog = self._aot_program(p_i, s_i, xd, device=desc,
+                                         top_n=top_n)
+                return prog(p_i, s_i, *xd)
+            return fwd(p_i, s_i, *xd)
+
+        def harvest(h):
+            hs = h if isinstance(h, (list, tuple)) else [h]
+            return [np.asarray(o) for o in hs]
+
+        return ModelReplica(dispatch, harvest, device=desc,
+                            on_device_topn=bool(top_n), pads_input=True)
 
     @classmethod
     def load_onnx(cls, path: str, int8: bool = False,

@@ -70,7 +70,8 @@ import numpy as np
 from analytics_zoo_tpu.core.profiling import TIMERS
 from analytics_zoo_tpu.deploy import codec as wire_codec
 from analytics_zoo_tpu.deploy.inference import (
-    DEFAULT_MODEL, DynamicBatcher, plan_buckets, scatter_batch_results)
+    DEFAULT_MODEL, DynamicBatcher, bucket_class, plan_buckets,
+    scatter_batch_results)
 from analytics_zoo_tpu.observe import metrics as obs
 from analytics_zoo_tpu.observe.export import JsonlEventLog, to_prometheus
 from analytics_zoo_tpu.observe.recorder import SLO, FlightRecorder
@@ -909,13 +910,16 @@ class _ReplicaSlot:
     """One supervised replica position: the replica object, its circuit
     breaker, the owning model's name, and the rebuild bookkeeping."""
 
-    __slots__ = ("replica", "breaker", "index", "rebuilt", "model")
+    __slots__ = ("replica", "breaker", "index", "rebuilt", "model",
+                 "kind")
 
-    def __init__(self, replica, breaker, index, model=DEFAULT_MODEL):
+    def __init__(self, replica, breaker, index, model=DEFAULT_MODEL,
+                 kind="replica"):
         self.replica = replica
         self.breaker = breaker
         self.index = index
         self.model = model
+        self.kind = kind    # "replica" | "longdoc_replica"
         self.rebuilt = False    # set by rebuild_slot; cleared (and
         #                         counted as restored) on first success
 
@@ -952,16 +956,29 @@ class _ModelGroup:
     cursor, shape buckets and (optional) sync fallback.  The executor
     multiplexes every group over the same dispatch/harvest threads and
     inflight budget — the chips don't care which model a batch belongs
-    to, only the slots and ledgers are per-model."""
+    to, only the slots and ledgers are per-model.
 
-    __slots__ = ("name", "slots", "rr", "buckets", "fallback")
+    ``long_slots`` holds the long-document mesh-replica slots
+    (``InferenceModel.mesh_replica``): batches at or past
+    ``LONG_DOC_TOKENS`` sequence tokens route there with their own
+    round-robin cursor, so a 128k-token request never occupies (and
+    never OOMs) a single-chip slot."""
 
-    def __init__(self, name, slots, buckets, fallback=None):
+    __slots__ = ("name", "slots", "rr", "buckets", "fallback",
+                 "long_slots", "long_rr")
+
+    def __init__(self, name, slots, buckets, fallback=None,
+                 long_slots=None):
         self.name = name
         self.slots = slots
         self.rr = 0
         self.buckets = tuple(sorted(buckets))
         self.fallback = fallback
+        self.long_slots = list(long_slots or [])
+        self.long_rr = 0
+
+    def all_slots(self):
+        return list(self.slots) + list(self.long_slots)
 
 
 class DeviceExecutor:
@@ -1010,12 +1027,18 @@ class DeviceExecutor:
     def __init__(self, replicas, buckets=(1, 32),
                  max_inflight: int = 2, name: str = "serving",
                  breaker_threshold: int = 3, breaker_cooldown_s: float = 2.0,
-                 fallback=None, max_retries: int = 2):
+                 fallback=None, max_retries: int = 2,
+                 long_doc_replicas=None):
         rep_map = (dict(replicas) if isinstance(replicas, dict)
                    else {DEFAULT_MODEL: list(replicas or [])})
         if not rep_map or not all(rep_map.values()):
             raise ValueError("DeviceExecutor needs at least one replica "
                              "per model")
+        # long_doc_replicas: mesh replicas for the >= LONG_DOC_TOKENS
+        # bucket class — a list (default model) or dict keyed by model
+        long_map = (dict(long_doc_replicas)
+                    if isinstance(long_doc_replicas, dict)
+                    else {DEFAULT_MODEL: list(long_doc_replicas or [])})
         self.max_inflight = max(1, int(max_inflight))
         self.name = name
         self.breaker_threshold = max(1, int(breaker_threshold))
@@ -1037,7 +1060,10 @@ class DeviceExecutor:
                 bucket_map.get(mname, buckets if not isinstance(
                     buckets, dict) else (1, 32)),
                 fb_map.get(mname) if isinstance(fallback, dict)
-                else fallback)
+                else fallback,
+                long_slots=self._make_slots(
+                    long_map.get(mname) or [], mname, long_doc=True,
+                    start=len(reps)))
         self._default_model = next(iter(self._groups))
         self._inflight = 0
         self._last_harvest_t: Optional[float] = None
@@ -1054,15 +1080,20 @@ class DeviceExecutor:
         self._dispatch_thread.start()
         self._harvest_thread.start()
 
-    def _make_slots(self, replicas: List, model: str = DEFAULT_MODEL
+    def _make_slots(self, replicas: List, model: str = DEFAULT_MODEL,
+                    long_doc: bool = False, start: int = 0
                     ) -> List["_ReplicaSlot"]:
-        prefix = (f"{self.name}_replica" if model == DEFAULT_MODEL
-                  else f"{self.name}_{model}_replica")
+        # long-doc slot indices continue after the single-chip ones so
+        # rebuild_slot/metrics address every slot of a model uniquely
+        kind = "longdoc_replica" if long_doc else "replica"
+        prefix = (f"{self.name}_{kind}" if model == DEFAULT_MODEL
+                  else f"{self.name}_{model}_{kind}")
         return [_ReplicaSlot(
             rep, CircuitBreaker(failure_threshold=self.breaker_threshold,
                                 cooldown_s=self.breaker_cooldown_s,
-                                name=f"{prefix}{i}"), i, model=model)
-            for i, rep in enumerate(replicas)]
+                                name=f"{prefix}{i}"), i, model=model,
+            kind=kind)
+            for i, rep in enumerate(replicas, start)]
 
     # -- legacy single-model views (tests/callers from before multi-model
     # address the default group through these) -----------------------------
@@ -1145,8 +1176,9 @@ class DeviceExecutor:
         """Per-slot health for ``health()``: breaker state machine plus
         device identity and owning model."""
         with self._lock:
-            slots = [s for g in self._groups.values() for s in g.slots]
-        return [dict(slot=s.index, model=s.model,
+            slots = [s for g in self._groups.values()
+                     for s in g.all_slots()]
+        return [dict(slot=s.index, model=s.model, kind=s.kind,
                      device=str(getattr(s.replica, "device", "host")),
                      rebuilt_pending_probe=s.rebuilt,
                      **s.breaker.snapshot())
@@ -1156,9 +1188,10 @@ class DeviceExecutor:
         with self._lock:
             if model is not None:
                 g = self._groups.get(model)
-                slots = list(g.slots) if g is not None else []
+                slots = g.all_slots() if g is not None else []
             else:
-                slots = [s for g in self._groups.values() for s in g.slots]
+                slots = [s for g in self._groups.values()
+                         for s in g.all_slots()]
         return sum(1 for s in slots if s.breaker.health != "quarantined")
 
     def quarantined_slots(self, min_open_s: float = 0.0
@@ -1170,7 +1203,8 @@ class DeviceExecutor:
         persistently-bad replica cycles probes without ever *aging* in
         the open state."""
         with self._lock:
-            slots = [s for g in self._groups.values() for s in g.slots]
+            slots = [s for g in self._groups.values()
+                     for s in g.all_slots()]
         out = []
         for s in slots:
             snap = s.breaker.snapshot()
@@ -1189,7 +1223,7 @@ class DeviceExecutor:
             group = self._groups.get(model)
             if group is None:
                 return
-            for s in group.slots:
+            for s in group.all_slots():
                 if s.index == index:
                     s.replica = replica
                     s.breaker.reset()
@@ -1324,13 +1358,18 @@ class DeviceExecutor:
         except pyqueue.Empty:
             return None
 
-    def _pick_slot_locked(self, group: "_ModelGroup"
+    def _pick_slot_locked(self, group: "_ModelGroup", long_doc: bool = False
                           ) -> Optional["_ReplicaSlot"]:
-        n = len(group.slots)
+        slots = group.long_slots if long_doc else group.slots
+        rr = group.long_rr if long_doc else group.rr
+        n = len(slots)
         for k in range(n):
-            s = group.slots[(group.rr + k) % n]
+            s = slots[(rr + k) % n]
             if s.breaker.allow():
-                group.rr = (group.rr + k + 1) % n
+                if long_doc:
+                    group.long_rr = (rr + k + 1) % n
+                else:
+                    group.rr = (rr + k + 1) % n
                 return s
         return None
 
@@ -1366,8 +1405,22 @@ class DeviceExecutor:
                         g.rr = 0
                 self._swap = None
             group = self._groups.get(batch.model)
-            slot = (self._pick_slot_locked(group)
+            # bucket class: the token axis (dim 1) of the fused input
+            # decides whether this batch belongs on a long-document
+            # mesh replica (>= LONG_DOC_TOKENS) or a single-chip slot
+            x0 = batch.fused[0]
+            tokens = (int(x0.shape[1])
+                      if getattr(x0, "ndim", 0) >= 2 else None)
+            long_doc = bool(group is not None and group.long_slots
+                            and bucket_class(tokens) == "long_doc")
+            slot = (self._pick_slot_locked(group, long_doc=long_doc)
                     if group is not None else None)
+            if slot is None and long_doc:
+                # every long-doc slot quarantined: degrade onto the
+                # normal slots (latency over dropped requests) and let
+                # their breakers arbitrate from here
+                slot = self._pick_slot_locked(group)
+                long_doc = False
             if group is None:
                 pass
             elif slot is not None:
@@ -1407,7 +1460,7 @@ class DeviceExecutor:
             if plan is not None and plan.exc is not None:
                 raise plan.exc
             batch.handles = self._dispatch(slot.replica, batch.fused,
-                                           group.buckets)
+                                           group.buckets, tokens=tokens)
         except Exception as e:
             with self._lock:
                 self._inflight -= 1
@@ -1420,6 +1473,9 @@ class DeviceExecutor:
         obs.count("serving_batch_rows_total", batch.fused[0].shape[0],
                   replica=slot.index, model=batch.model,
                   flat=f"{self.name}/device_rows")
+        if long_doc:
+            obs.count("serving_long_doc_batches_total", model=batch.model,
+                      flat=f"{self.name}/long_doc_batches")
         self._pending.put(batch)
 
     def _no_healthy_replica(self, batch: "_Batch",
@@ -1463,18 +1519,21 @@ class DeviceExecutor:
         time.sleep(0.01)  # wait for a probe window / supervisor rebuild
         self._retryq.append(batch)
 
-    def _dispatch(self, rep, fused: List[np.ndarray], buckets):
+    def _dispatch(self, rep, fused: List[np.ndarray], buckets,
+                  tokens: Optional[int] = None):
         """Pad to the bucket set and dispatch; a batch larger than the
         biggest bucket splits into full-bucket programs (never compiles
         a one-off shape).  The split/pad plan comes from the SAME
         ``plan_buckets`` the predict path uses, so the executor and the
-        compile-shape ledger can never disagree.
+        compile-shape ledger can never disagree.  ``tokens`` carries the
+        batch's sequence length into the bucket-class decision: the
+        long-document class plans at the smallest row bucket.
         Returns [(handle, rows), ...]."""
         n = fused[0].shape[0]
         if not rep.pads_input:  # fallback replica: predict() pads itself
             return [(rep.dispatch(fused), n)]
         out, s = [], 0
-        for m, bucket in plan_buckets(n, buckets):
+        for m, bucket in plan_buckets(n, buckets, tokens=tokens):
             chunk = [x[s:s + m] for x in fused]
             if bucket > m:
                 chunk = [np.concatenate(

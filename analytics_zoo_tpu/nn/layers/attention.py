@@ -69,13 +69,18 @@ class MultiHeadAttention(StatelessLayer):
 
     def __init__(self, nhead: int, hidden_size: Optional[int] = None,
                  attn_drop: float = 0.0, output_drop: float = 0.0,
-                 causal: bool = False, init="glorot_uniform", **kw):
+                 causal: bool = False, init="glorot_uniform",
+                 seq_shards: Optional[int] = None, **kw):
         super().__init__(**kw)
         self.nhead = nhead
         self.hidden_size = hidden_size
         self.attn_drop = attn_drop
         self.output_drop = output_drop
         self.causal = causal
+        # sequence shards for ring attention outside an explicit sp
+        # regime: None defers to the ZooConfig.seq_shards knob at
+        # forward time; 0/1 disables (docs/PARALLELISM.md)
+        self.seq_shards = seq_shards
         self.initializer = initializers.get(init)
 
     def build_params(self, rng, q_shape, *rest):
@@ -147,9 +152,29 @@ class MultiHeadAttention(StatelessLayer):
             # which keeps the flash memory bound; inference uses the
             # fused kernels
             drop = self.attn_drop if (training and r1 is not None) else 0.0
-            out = dot_product_attention(q, k, v, mask=mask,
-                                        causal=self.causal,
-                                        dropout_rate=drop, dropout_rng=r1)
+            ring_mesh = None
+            if mask is None and kv_in is q_in and drop == 0.0:
+                # seq_shards knob: long-context self-attention shards L
+                # over a ring of devices even without an explicit sp
+                # regime (serving's long-document bucket rides this).
+                # The op's counted dispatch still applies its min-length
+                # and knob routing, so short sequences stay local.
+                from analytics_zoo_tpu.ops.dispatch import config_knob
+                ways = (self.seq_shards if self.seq_shards is not None
+                        else config_knob("seq_shards", 0) or 0)
+                if ways and ways > 1:
+                    from analytics_zoo_tpu.parallel.sharding import seq_mesh
+                    ring_mesh = seq_mesh(int(ways))
+            if ring_mesh is not None:
+                from analytics_zoo_tpu.ops.ring_attention import (
+                    ring_attention)
+                out = ring_attention(q, k, v, mesh=ring_mesh, axis="seq",
+                                     causal=self.causal)
+            else:
+                out = dot_product_attention(q, k, v, mask=mask,
+                                            causal=self.causal,
+                                            dropout_rate=drop,
+                                            dropout_rng=r1)
         b, h, l, hd = out.shape
         out = out.transpose(0, 2, 1, 3).reshape(b, l, h * hd)
         out = _dense(params["o"], out)
@@ -167,11 +192,12 @@ class TransformerBlock(StatelessLayer):
                  intermediate_size: Optional[int] = None,
                  hidden_drop: float = 0.1, attn_drop: float = 0.1,
                  causal: bool = False, activation="gelu",
-                 after_norm: bool = False, init="glorot_uniform", **kw):
+                 after_norm: bool = False, init="glorot_uniform",
+                 seq_shards: Optional[int] = None, **kw):
         super().__init__(**kw)
         self.attn = MultiHeadAttention(nhead, hidden_size,
                                        attn_drop=attn_drop, causal=causal,
-                                       init=init,
+                                       init=init, seq_shards=seq_shards,
                                        name=f"{self.name}_attn")
         self.hidden_size = hidden_size
         self.intermediate = intermediate_size or 4 * hidden_size
@@ -292,7 +318,8 @@ class TransformerLayer(StatelessLayer):
                  hidden_drop: float = 0.1, attn_drop: float = 0.1,
                  embedding_drop: float = 0.1, causal: bool = True,
                  after_norm: bool = False, init="glorot_uniform",
-                 stacked: bool = False, **kw):
+                 stacked: bool = False,
+                 seq_shards: Optional[int] = None, **kw):
         super().__init__(**kw)
         self.vocab, self.seq_len = vocab, seq_len
         self.hidden_size = hidden_size
@@ -305,6 +332,7 @@ class TransformerLayer(StatelessLayer):
                                           intermediate_size, hidden_drop,
                                           attn_drop, causal=causal,
                                           after_norm=after_norm, init=init,
+                                          seq_shards=seq_shards,
                                           name=f"{self.name}_block")
             self.blocks = []
         else:
@@ -312,6 +340,7 @@ class TransformerLayer(StatelessLayer):
                 TransformerBlock(nhead, hidden_size, intermediate_size,
                                  hidden_drop, attn_drop, causal=causal,
                                  after_norm=after_norm, init=init,
+                                 seq_shards=seq_shards,
                                  name=f"{self.name}_block{i}")
                 for i in range(n_block)]
         self.initializer = initializers.get(init)
@@ -369,7 +398,8 @@ class BERT(StatelessLayer):
                  intermediate_size: int = 3072, max_position_len: int = 512,
                  type_vocab_size: int = 2, hidden_drop: float = 0.1,
                  attn_drop: float = 0.1, init="glorot_uniform",
-                 stacked: bool = False, **kw):
+                 stacked: bool = False,
+                 seq_shards: Optional[int] = None, **kw):
         super().__init__(**kw)
         self.vocab = vocab
         self.hidden_size = hidden_size
@@ -385,7 +415,7 @@ class BERT(StatelessLayer):
         mk = lambda name: TransformerBlock(
             nhead, hidden_size, intermediate_size, hidden_drop, attn_drop,
             causal=False, activation="gelu", after_norm=False, init=init,
-            name=name)
+            seq_shards=seq_shards, name=name)
         if stacked:
             self.block = mk(f"{self.name}_enc")
             self.blocks = []

@@ -72,6 +72,9 @@ CATALOG: Dict[str, Tuple[str, str, Tuple[str, ...]]] = {
     "serving_batch_retries_total": (
         "counter", "batches retried on a healthy peer replica",
         ("model",)),
+    "serving_long_doc_batches_total": (
+        "counter", "batches routed to a long-document mesh replica "
+        "(sequence length >= LONG_DOC_TOKENS)", ("model",)),
     "serving_replica_events_total": (
         "counter", "replica lifecycle events "
         "(quarantined|restored|rebuilt)", ("event", "model", "replica")),

@@ -22,3 +22,9 @@ from analytics_zoo_tpu.ops.quantization import (  # noqa: F401
     quantize_program,
     quantize_tensor,
 )
+# last: ring_attention pulls in analytics_zoo_tpu.parallel, whose
+# modules import the ops submodules above — keep them initialized first
+from analytics_zoo_tpu.ops.ring_attention import (  # noqa: F401,E402
+    RING_MIN_LEN,
+    ring_attention,
+)

@@ -223,6 +223,33 @@ class SequenceParallel(ShardingStrategy):
             batch_axis=_infer_batch_axis(mesh, self.axis)))
 
 
+_SEQ_MESH_CACHE: dict = {}
+
+
+def seq_mesh(ways: int, axis: str = "seq"):
+    """A 1-D ``(ways,)`` mesh over the first ``ways`` devices with a
+    sequence axis — what the ``seq_shards`` config knob hands to
+    ``ops.ring_attention`` when no explicit sequence-parallel regime is
+    active (nn/layers/attention.py).  Cached per (ways, axis): layer
+    forwards run at trace time and must not rebuild meshes per call.
+    Returns None when fewer than ``ways`` devices exist (the caller
+    falls back to single-device attention).
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    key = (int(ways), axis, jax.default_backend())
+    got = _SEQ_MESH_CACHE.get(key)
+    if got is not None:
+        return got
+    devs = jax.devices()
+    if ways < 2 or len(devs) < ways:
+        return None
+    mesh = Mesh(np.asarray(devs[:ways]), (axis,))
+    _SEQ_MESH_CACHE[key] = mesh
+    return mesh
+
+
 class PipelineStrategy(ShardingStrategy):
     """GPipe pipeline parallelism as an Estimator regime.
 

@@ -1,0 +1,30 @@
+# zoolint: hot-path
+"""zoolint fixture: the ring-attention hop loop idiom
+(ops/ring_attention.py).  Draining the device after every ppermute hop
+with a per-step ``block_until_ready`` serializes the ring — the hop
+i+1 transfer can no longer overlap hop i's attention compute — and
+fires JG-TRANSFER-HOT; the shipped schedule enqueues every hop's
+ppermute + fold asynchronously (double-buffered) and syncs ONCE on the
+final merged output, which is the twin that must stay quiet."""
+
+
+def per_hop_sync(q, kv, hop_fn, rotate_fn, ways):
+    acc = None
+    for i in range(ways):
+        acc = hop_fn(q, kv, acc)
+        kv = rotate_fn(kv)
+        acc.block_until_ready()        # JG-TRANSFER-HOT fires: the
+        # ring stalls on every hop, killing the transfer/compute overlap
+    return acc
+
+
+def double_buffered_ok(q, kv, hop_fn, rotate_fn, ways):
+    acc = None
+    for i in range(ways):
+        nxt = rotate_fn(kv)            # quiet: hop i+1's ppermute is
+        # in flight while hop i folds
+        acc = hop_fn(q, kv, acc)
+        kv = nxt
+    if acc is not None:
+        acc.block_until_ready()        # quiet: ONE sync, after the ring
+    return acc

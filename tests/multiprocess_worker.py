@@ -72,6 +72,17 @@ cache (deploy/compile_cache.py) across REAL process boundaries:
                      test: the second process must hold
                      ``compile_count == 0`` (the warm-start proof).
 
+Ring scenarios (``ring_*``) exercise sequence-parallel ring attention
+(ops/ring_attention.py) across REAL process boundaries:
+
+- ``ring_parity`` — the GLOBAL device set becomes a 1-D ``seq`` mesh;
+                    K/V shards rotate around a ppermute ring whose hops
+                    are genuine inter-process collectives, forward and
+                    backward; the replicated result must match the
+                    single-device oracle every process computes locally
+                    from the same seeded inputs (the cross-process form
+                    of tests/test_ring_attention.py's parity matrix).
+
 Replaces (and automates) the reference's manual two-executor
 integration script (pyzoo/test/zoo/ray/integration/ray_on_yarn.py:23-33).
 """
@@ -108,7 +119,8 @@ def parse_args(argv=None) -> argparse.Namespace:
                             "die_save", "data_train", "data_resume",
                             "data_preempt", "data_die",
                             "data_die_mid_epoch", "table_save",
-                            "table_restore", "serving_warm"])
+                            "table_restore", "serving_warm",
+                            "ring_parity"])
     p.add_argument("--ckpt-dir", default="",
                    help="checkpoint directory (enables checkpointing)")
     p.add_argument("--die-step", type=int, default=4,
@@ -448,6 +460,67 @@ def _run_serving_warm(args, pid: int, nproc: int) -> None:
                    "cache": cache.stats()}, f)
 
 
+def _run_ring(args, pid: int, nproc: int) -> None:
+    """Sequence-parallel ring attention across REAL process boundaries
+    (``ring_parity``).
+
+    The GLOBAL device set becomes a 1-D ``seq`` mesh, so with 2
+    processes x 2 local devices the 4-way K/V ring's middle hops are
+    genuine inter-process ppermutes (gloo), not intra-host shuffles.
+    Every process builds the same seeded (B, H, L, D) inputs, shards
+    them over the mesh, runs the ring forward AND backward (the
+    custom_vjp re-streams K/V around the reverse ring), and compares
+    the replicated results against the single-device blockwise oracle
+    it computes locally — the cross-process form of
+    tests/test_ring_attention.py's parity matrix.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from analytics_zoo_tpu.ops.attention import blockwise_attention
+    from analytics_zoo_tpu.ops.ring_attention import ring_attention
+
+    b, h, l, d = 1, 2, 256, 16
+    rs = np.random.RandomState(args.seed)
+    q, k, v = (rs.randn(b, h, l, d).astype(np.float32) for _ in range(3))
+
+    devs = jax.devices()
+    ways = len(devs)
+    mesh = Mesh(np.asarray(devs), ("seq",))
+    seq_sh = NamedSharding(mesh, P(None, None, "seq", None))
+    rep_sh = NamedSharding(mesh, P())
+    gq, gk, gv = (jax.make_array_from_callback(
+        a.shape, seq_sh, lambda idx, _a=a: _a[idx]) for a in (q, k, v))
+
+    ring = lambda a, bb, c: ring_attention(a, bb, c, mesh=mesh,
+                                           causal=True, knob="on")
+    # replicated out_shardings: every process holds the full result, so
+    # the parity check needs no host-side gather choreography
+    fwd = jax.jit(ring, out_shardings=rep_sh)
+    bwd = jax.jit(jax.grad(lambda a, bb, c: jnp.sum(ring(a, bb, c) ** 2),
+                           argnums=0), out_shardings=rep_sh)
+    out = np.asarray(fwd(gq, gk, gv).addressable_data(0))
+    dq = np.asarray(bwd(gq, gk, gv).addressable_data(0))
+
+    oracle = lambda: blockwise_attention(jnp.asarray(q), jnp.asarray(k),
+                                         jnp.asarray(v), causal=True,
+                                         block_size=32)
+    ref = np.asarray(oracle())
+    ref_dq = np.asarray(jax.grad(
+        lambda a: jnp.sum(blockwise_attention(
+            a, jnp.asarray(k), jnp.asarray(v), causal=True,
+            block_size=32) ** 2))(jnp.asarray(q)))
+
+    with open(args.outfile, "w") as f:
+        json.dump({"process_id": pid, "scenario": args.scenario,
+                   "ways": int(ways),
+                   "out_shape": list(out.shape),
+                   "fwd_max_err": float(np.max(np.abs(out - ref))),
+                   "dq_max_err": float(np.max(np.abs(dq - ref_dq)))}, f)
+
+
 def main() -> None:
     args = parse_args()
     pid, nproc = args.process_id, args.num_processes
@@ -494,6 +567,10 @@ def main() -> None:
 
     if args.scenario.startswith("serving_"):
         _run_serving_warm(args, pid, nproc)
+        return
+
+    if args.scenario.startswith("ring_"):
+        _run_ring(args, pid, nproc)
         return
 
     # deterministic problem; every process generates the full dataset and

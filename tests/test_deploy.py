@@ -374,3 +374,35 @@ class TestClusterServing:
         serving.serve_once()
         # 8 queued, trimmed to 3 (b5..b7), then up to batch_size served
         assert serving.records_served == 3
+
+
+class TestMeshReplica:
+    """``InferenceModel.mesh_replica``: the long-document serving slot —
+    weights placed once, replicated over a mesh whose ``seq`` axis
+    drives sequence-parallel ring attention (docs/SERVING.md
+    "Long-document bucket class")."""
+
+    def test_mesh_replica_matches_predict(self):
+        import jax
+        from jax.sharding import Mesh
+
+        net, x = _trained_net()
+        m = InferenceModel.from_keras_net(net, net.estimator.params,
+                                          net.estimator.state,
+                                          batch_buckets=(1, 8))
+        mesh = Mesh(np.asarray(jax.devices()[:4]), ("seq",))
+        rep = m.mesh_replica(mesh)
+        assert rep.device == "mesh:seq=4"
+        assert rep.pads_input
+        out = rep.harvest(rep.dispatch([x[:8]]))[0]
+        np.testing.assert_allclose(np.asarray(out), m.predict(x[:8]),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_mesh_replica_needs_native_net(self):
+        m = InferenceModel(lambda xs: xs)
+        import jax
+        from jax.sharding import Mesh
+
+        mesh = Mesh(np.asarray(jax.devices()[:2]), ("seq",))
+        with pytest.raises(ValueError, match="native"):
+            m.mesh_replica(mesh)

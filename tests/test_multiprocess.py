@@ -83,3 +83,21 @@ def test_sharded_table_checkpoint_topology_change(tmp_path):
         for r in got:
             assert r["table_hashes"] == want, tag
             assert r["global_step"] == save[0]["global_step"]
+
+
+@pytest.mark.slow
+def test_two_process_ring_attention_parity(tmp_path):
+    """Sequence-parallel ring attention with the ring spanning a REAL
+    process boundary: 2 processes x 2 local devices assemble a 4-way
+    ``seq`` mesh, so half the K/V ppermute hops (and the backward's
+    reverse-ring re-streaming) cross gloo, not just XLA's intra-host
+    shuffle.  Both processes must report the replicated forward AND
+    dq results within 1e-5 of the single-device blockwise oracle —
+    the cross-process leg of tests/test_ring_attention.py's parity
+    matrix."""
+    got = run_workers(2, tmp_path, "ring", scenario="ring_parity")
+    for r in got:
+        assert r["ways"] == 4
+        assert r["out_shape"] == [1, 2, 256, 16]
+        assert r["fwd_max_err"] <= 1e-5, r
+        assert r["dq_max_err"] <= 1e-5, r

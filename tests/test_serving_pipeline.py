@@ -456,3 +456,97 @@ class TestServingConfigFromZoo:
         assert sc.replicas == 3
         assert sc.max_inflight == 4
         assert sc.postprocess_top_n == 5
+
+
+class TestLongDocBucketClass:
+    """The >= LONG_DOC_TOKENS bucket class (ISSUE 17): long-document
+    batches plan at the smallest row bucket and route to the
+    mesh-replica slot group, counted in
+    ``serving_long_doc_batches_total``; with every long-doc slot
+    quarantined the batch degrades onto the normal slots instead of
+    failing."""
+
+    def _rep(self, log, name):
+        from analytics_zoo_tpu.deploy import ModelReplica
+
+        def dispatch(chunk, _n=name):
+            log.append((_n, tuple(chunk[0].shape)))
+            return chunk[0]
+
+        return ModelReplica(dispatch, lambda h: [np.asarray(h)],
+                            device=name)
+
+    def _submit_and_wait(self, ex, fused, timeout=20):
+        done = threading.Event()
+        got = {}
+
+        class _R:
+            def __init__(self, xs):
+                self.xs, self.n = xs, xs[0].shape[0]
+                self.t_submit = time.monotonic()
+
+            def callback(self, out, err):
+                got["out"], got["err"] = out, err
+                done.set()
+
+        ex.submit(None, fused, [_R(fused)])
+        assert done.wait(timeout=timeout)
+        assert got["err"] is None, got["err"]
+        return got["out"]
+
+    def _count(self):
+        from analytics_zoo_tpu.observe.metrics import METRICS
+
+        key = ("serving_long_doc_batches_total", (("model", "default"),))
+        return METRICS.snapshot().counters.get(key, 0)
+
+    def test_bucket_class_and_plan(self):
+        from analytics_zoo_tpu.deploy import (LONG_DOC_TOKENS,
+                                              bucket_class, plan_buckets)
+
+        assert bucket_class(None) == "short"
+        assert bucket_class(LONG_DOC_TOKENS - 1) == "short"
+        assert bucket_class(LONG_DOC_TOKENS) == "long_doc"
+        # short: full-cap chunks then a padded tail; long_doc: every
+        # chunk is the SMALLEST bucket (the sequence is the work)
+        assert plan_buckets(3, (4, 8)) == [(3, 4)]
+        assert plan_buckets(3, (2, 8),
+                            tokens=LONG_DOC_TOKENS) == [(2, 2), (1, 2)]
+
+    def test_executor_routes_long_doc_and_counts(self):
+        from analytics_zoo_tpu.deploy import LONG_DOC_TOKENS
+
+        log = []
+        ex = DeviceExecutor([self._rep(log, "short")], buckets=(1, 4),
+                            long_doc_replicas=[self._rep(log, "long")])
+        try:
+            before = self._count()
+            self._submit_and_wait(ex, [np.zeros((2, 8), np.float32)])
+            self._submit_and_wait(
+                ex, [np.zeros((2, LONG_DOC_TOKENS), np.float32)])
+            assert log == [("short", (4, 8)),          # padded to bucket
+                           ("long", (1, LONG_DOC_TOKENS)),
+                           ("long", (1, LONG_DOC_TOKENS))]
+            assert self._count() == before + 1
+            # long-doc slots are full health citizens, kind-tagged
+            kinds = {s["kind"] for s in ex.replica_states()}
+            assert kinds == {"replica", "longdoc_replica"}
+        finally:
+            ex.stop()
+
+    def test_quarantined_long_slots_degrade_to_normal(self):
+        from analytics_zoo_tpu.deploy import LONG_DOC_TOKENS
+
+        log = []
+        ex = DeviceExecutor([self._rep(log, "short")], buckets=(1, 4),
+                            long_doc_replicas=[self._rep(log, "long")])
+        try:
+            before = self._count()
+            ex._groups["default"].long_slots[0].breaker.force_open()
+            self._submit_and_wait(
+                ex, [np.zeros((1, LONG_DOC_TOKENS), np.float32)])
+            # served by the normal slot (long-doc routing NOT counted)
+            assert log == [("short", (1, LONG_DOC_TOKENS))]
+            assert self._count() == before
+        finally:
+            ex.stop()

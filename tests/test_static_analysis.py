@@ -173,6 +173,20 @@ def test_fused_kernel_driver_fixture():
     assert len(fs) == 1
 
 
+def test_ring_step_fixture():
+    """The ring-attention hop-loop idiom (ops/ring_attention.py):
+    draining the device after every ppermute hop fires JG-TRANSFER-HOT
+    — a per-step sync forfeits exactly the transfer/compute overlap the
+    double-buffered schedule exists for; the shipped
+    issue-next-hop-then-fold twin with ONE sync after the ring stays
+    quiet, so the sequence-parallel path keeps a clean lint bill by
+    construction."""
+    fs = fixture_findings("ring_step.py")
+    assert scopes_of(fs, "JG-TRANSFER-HOT") == {"per_hop_sync"}
+    assert "double_buffered_ok" not in {f.scope for f in fs}
+    assert len(fs) == 1
+
+
 def test_mesh_data_cursor_fixture():
     """The per-host data-tier shard cursor (multi-controller
     _fit_stream): an uploader thread advancing the elastic-resume
