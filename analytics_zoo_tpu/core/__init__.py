@@ -1,5 +1,6 @@
 from analytics_zoo_tpu.core.config import ZooConfig  # noqa: F401
 from analytics_zoo_tpu.core.context import (  # noqa: F401
+    HostRoster,
     ZooContext,
     get_zoo_context,
     init_zoo_context,

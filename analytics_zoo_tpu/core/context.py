@@ -13,7 +13,7 @@ from __future__ import annotations
 import logging
 import os
 from dataclasses import dataclass
-from typing import Optional, Sequence, Tuple
+from typing import Any, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -234,6 +234,63 @@ def _initialize_distributed(config: ZooConfig, coordinator_address,
     return not adopted
 
 
+# Hooks fired (with the lost process ids) when Python-side detection —
+# a dispatch-barrier deadline, a harvest timeout — declares a pod
+# member dead.  The serving fabric points these at
+# ``ClusterServing.notify_host_lost`` so the FIRST detection
+# quarantines every model's mesh replica, not just the one whose
+# dispatch tripped the deadline.
+#
+# Detection is deliberately Python-side only.  The coordination
+# client's own heartbeat detector cannot be softened on this jaxlib:
+# its ``missed_heartbeat_callback`` default is ``LOG(QFATAL)``, and a
+# Python replacement is un-invocable (the error-poll thread cannot
+# convert the ``absl::Status`` argument, so invoking it terminates the
+# process just as fatally).  The fabric therefore keeps pod processes
+# off that path entirely — barrier deadlines fire within
+# ``dist_barrier_timeout_s`` (seconds), long before the ~100 s
+# heartbeat detector, and members never time out a live barrier
+# (a member that abandons a barrier seq poisons it for the peers that
+# arrive later).
+_PEER_LOSS_HOOKS: List[Any] = []
+
+
+def on_peer_loss(fn) -> None:
+    """Register ``fn(process_id)`` to run when a pod member is declared
+    dead by Python-side detection (see :func:`report_peer_loss`).  The
+    serving fabric points this at ``ClusterServing.notify_host_lost``
+    so one detection quarantines every affected mesh replica."""
+    _PEER_LOSS_HOOKS.append(fn)
+
+
+def remove_peer_loss_hook(fn) -> None:
+    try:
+        _PEER_LOSS_HOOKS.remove(fn)
+    except ValueError:
+        pass
+
+
+def report_peer_loss(process_ids: Sequence[int], reason: str = "") -> None:
+    """Declare pod members dead and fan the loss out to every
+    registered hook.  Called by the serving fabric's barrier-deadline
+    path (``PodCoordinator.host_lost``); counts
+    ``dist_peer_loss_total`` so survived peer losses are visible next
+    to the stock client's would-have-been-fatal behavior."""
+    from analytics_zoo_tpu.observe import metrics as obs
+
+    lost = sorted({int(p) for p in process_ids})
+    logger.warning(
+        "peer loss reported for process(es) %s%s (continuing — host "
+        "loss is survivable)", lost, f": {reason}" if reason else "")
+    obs.count("dist_peer_loss_total", flat="robust/dist_peer_loss")
+    for fn in list(_PEER_LOSS_HOOKS):
+        for pid in lost:
+            try:
+                fn(pid)
+            except Exception:
+                logger.exception("peer-loss hook %r failed", fn)
+
+
 def dist_barrier(name: str, timeout_s: Optional[float] = None,
                  phase: str = "other") -> float:
     """Deadline-bounded cross-process barrier over the jax.distributed
@@ -295,6 +352,97 @@ def dist_barrier(name: str, timeout_s: Optional[float] = None,
             f"and is presumed dead ({type(e).__name__}: {e})",
             barrier=name, timeout_s=timeout_s) from e
     return _time.perf_counter() - t0
+
+
+class HostRoster:
+    """Epoch-tagged membership view of a serving pod's processes.
+
+    The serving fabric's source of truth for which member hosts of a
+    mesh replica are believed alive.  Every membership change bumps the
+    ``epoch``; the quarantine broadcast and the supervisor's heal/shed
+    decisions key off epochs, so concurrent observers of the same host
+    death collapse into one atomic reaction (docs/SERVING.md
+    "Pod-scale serving").
+
+    All state transitions happen under one lock (marking a host lost
+    and bumping the epoch must be indivisible — an unlocked roster
+    write is exactly the THR-SHARED-MUT hazard the lint fixture pins).
+    The clock is injectable so fast tests fabricate loss ages instead
+    of sleeping; there is no ``jax`` dependency — OS-process pods feed
+    it from barrier timeouts, fast tests feed it by hand.
+    """
+
+    def __init__(self, process_ids: Sequence[int], *, clock=None):
+        import threading
+        import time as _time
+
+        self._lock = threading.Lock()
+        self._clock = clock or _time.monotonic
+        self._expected = tuple(int(p) for p in process_ids)
+        self._alive = set(self._expected)
+        self._epoch = 0
+        self._lost_t: Optional[float] = None
+
+    @property
+    def epoch(self) -> int:
+        with self._lock:
+            return self._epoch
+
+    @property
+    def expected(self) -> Tuple[int, ...]:
+        return self._expected
+
+    def alive(self) -> Tuple[int, ...]:
+        with self._lock:
+            return tuple(sorted(self._alive))
+
+    def mark_lost(self, process_id: int) -> int:
+        """Record a presumed-dead member; returns the NEW epoch.  A
+        repeat loss of an already-lost host does not bump the epoch
+        (the same death observed twice is one event)."""
+        process_id = int(process_id)
+        with self._lock:
+            if process_id in self._alive:
+                self._alive.discard(process_id)
+                self._epoch += 1
+                self._lost_t = self._clock()
+            return self._epoch
+
+    def mark_alive(self, process_id: int) -> int:
+        """Record a (re)joined member; returns the new epoch."""
+        process_id = int(process_id)
+        with self._lock:
+            if process_id in self._expected and \
+                    process_id not in self._alive:
+                self._alive.add(process_id)
+                self._epoch += 1
+                if self._alive == set(self._expected):
+                    self._lost_t = None
+            return self._epoch
+
+    def healed(self) -> bool:
+        """True when every expected member is believed alive."""
+        with self._lock:
+            return self._alive == set(self._expected)
+
+    def lost(self) -> Tuple[int, ...]:
+        with self._lock:
+            return tuple(sorted(set(self._expected) - self._alive))
+
+    def lost_age_s(self) -> float:
+        """Seconds the roster has been degraded (0.0 while whole)."""
+        with self._lock:
+            if self._lost_t is None:
+                return 0.0
+            return max(0.0, self._clock() - self._lost_t)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"epoch": self._epoch,
+                    "expected": list(self._expected),
+                    "alive": sorted(self._alive),
+                    "lost": sorted(set(self._expected) - self._alive),
+                    "healed": self._alive == set(self._expected)}
 
 
 def _distributed_client_live() -> bool:

@@ -116,6 +116,14 @@ class Autoscaler:
             sig["models"][m] = {
                 "replicas": ex.group_size(m) if ex is not None else 0,
                 "healthy": ex.healthy_replicas(m) if ex is not None else 0,
+                # pod-scale mesh replicas are capacity too: a shed mesh
+                # replica shows up here as lost headroom, and the freed
+                # per-chip budget lets a single-chip grow pass
+                # _budget_allows (docs/SERVING.md "Pod-scale serving")
+                "mesh_replicas": (ex.mesh_group_size(m)
+                                  if ex is not None else 0),
+                "mesh_healthy": (ex.healthy_mesh_replicas(m)
+                                 if ex is not None else 0),
                 "slo_ms": srv.cfg.slo_for(m),
                 "p99_ms": srv._admission.p99(m),
             }

@@ -423,10 +423,13 @@ class InferenceModel:
                 self._param_fwds[top_n] = fwd
         return fwd
 
-    def _aot_program(self, p, s, xs, device=None, top_n=None):
+    def _aot_program(self, p, s, xs, device=None, top_n=None, fwd=None):
         """The executable for one program signature: in-memory table →
         disk cache → live ``lower().compile()`` (which is then persisted
-        so the NEXT process start skips it)."""
+        so the NEXT process start skips it).  ``fwd`` overrides which
+        jitted forward lowers on a miss (the sharded mesh-replica path
+        traces with its table mode baked in; its ``device`` descriptor
+        keeps the cache entries distinct)."""
         sig = self._aot_sig(xs, device, top_n)
         import json
         key = json.dumps(sig, sort_keys=True)
@@ -436,7 +439,8 @@ class InferenceModel:
             return prog
         prog = self._cache.load(self.fingerprint(), sig, model=self.name)
         if prog is None:
-            fwd = self._param_forward_for(top_n)
+            if fwd is None:
+                fwd = self._param_forward_for(top_n)
             prog = fwd.lower(p, s, *xs).compile()
             self._cache.store(self.fingerprint(), sig, prog,
                               model=self.name)
@@ -554,29 +558,43 @@ class InferenceModel:
         return m
 
     # -- replicas ----------------------------------------------------------
-    def _build_param_forward(self, top_n: Optional[int] = None):
+    def _build_param_forward(self, top_n: Optional[int] = None,
+                             table_shard=None):
         """One jitted forward taking (params, state, *xs) explicitly, so
         the same traced program runs on whichever device its arguments
         live on — the building block for per-device serving replicas.
         ``top_n`` fuses top-k into the program (scores never leave the
-        chip: the readback is 2*top_n scalars per row, not the logits)."""
+        chip: the readback is 2*top_n scalars per row, not the logits).
+        ``table_shard`` (a ``parallel.mode.TableShardMode``) is entered
+        INSIDE the traced body, so the listed embedding tables lower to
+        the ``shard_map`` local-bag + psum exchange at trace time —
+        the mesh-replica forward for row-sharded giant tables."""
+        import contextlib
+
         net, pre, int8 = self._net, self._preprocess, self._int8
         dense_names = _dense_layer_names(net) if int8 else set()
+        if table_shard is not None:
+            from analytics_zoo_tpu.parallel.mode import table_mode
+        else:
+            table_mode = None
 
         @jax.jit
         def fwd(p, s, *xs):
-            if pre is not None:
-                xs = _as_tuple(pre(*xs))
-            if int8:
-                p = _dequant_for_forward(p, dense_names)
-            p2, s2 = _match_compute_dtype(p, s, xs)
-            out, _ = net.call(p2, s2, *xs, training=False)
-            out = _f32_out(out)
-            if top_n:
-                o = out[0] if isinstance(out, (list, tuple)) else out
-                v, i = jax.lax.top_k(o, top_n)
-                return i.astype(jnp.int32), v
-            return out
+            ctx = (table_mode(table_shard) if table_shard is not None
+                   else contextlib.nullcontext())
+            with ctx:
+                if pre is not None:
+                    xs = _as_tuple(pre(*xs))
+                if int8:
+                    p = _dequant_for_forward(p, dense_names)
+                p2, s2 = _match_compute_dtype(p, s, xs)
+                out, _ = net.call(p2, s2, *xs, training=False)
+                out = _f32_out(out)
+                if top_n:
+                    o = out[0] if isinstance(out, (list, tuple)) else out
+                    v, i = jax.lax.top_k(o, top_n)
+                    return i.astype(jnp.int32), v
+                return out
 
         return fwd
 
@@ -675,6 +693,101 @@ class InferenceModel:
             if self._cache is not None:
                 prog = self._aot_program(p_i, s_i, xd, device=desc,
                                          top_n=top_n)
+                return prog(p_i, s_i, *xd)
+            return fwd(p_i, s_i, *xd)
+
+        def harvest(h):
+            hs = h if isinstance(h, (list, tuple)) else [h]
+            return [np.asarray(o) for o in hs]
+
+        return ModelReplica(dispatch, harvest, device=desc,
+                            on_device_topn=bool(top_n), pads_input=True)
+
+    def sharded_tables(self) -> tuple:
+        """The net's row-shardable table manifest (layer names set by
+        ``table_placement="sharded"`` model builders), or () for nets
+        without one."""
+        return tuple(getattr(self._net, "_sharded_tables", None) or ())
+
+    def weight_nbytes_per_chip(self, mesh, axis: str = "model") -> int:
+        """PER-CHIP HBM weight footprint when this model serves as a
+        mesh replica over ``mesh``: listed tables charge
+        ``nbytes / ways`` (they row-shard over ``axis``), everything
+        else charges full bytes.  This is what the executor's HBM
+        budget planner charges a mesh-replica slot — the whole point of
+        the sharded serving path is that a table bigger than one chip's
+        budget still fits per-chip."""
+        tables = self.sharded_tables()
+        weights = self._qparams if getattr(self, "_int8", False) \
+            else getattr(self, "_params", None)
+        if weights is None:
+            return 0
+        if not tables or mesh is None:
+            return self.weight_nbytes()
+        from analytics_zoo_tpu.parallel.table_sharding import \
+            per_chip_weight_nbytes
+        total = per_chip_weight_nbytes(weights, tables, mesh, axis=axis)
+        total += per_chip_weight_nbytes(
+            getattr(self, "_state", None) or {}, tables, mesh, axis=axis)
+        return total
+
+    def shard_replica(self, mesh, top_n: Optional[int] = None,
+                      axis: str = "model") -> "ModelReplica":
+        """One serving replica spanning a whole ``Mesh`` with the net's
+        ``_sharded_tables`` row-sharded ``P(axis, None)`` over it — the
+        giant-embedding serving path (docs/SERVING.md "Pod-scale
+        serving").
+
+        Each listed table leaf is placed once with ``rows/ways`` rows
+        per chip; every other leaf replicates.  The forward traces with
+        the table-shard mode active, so ``ShardedEmbeddingTable``
+        lowers to ``parallel.table_sharding.sharded_bag`` — the local
+        fused lookup plus ONE ``(B, D)`` psum per table; the gathered
+        rows never leave their owning shard.  The AOT compile-cache
+        signature carries a ``shard_mesh:...`` device descriptor (and
+        the cache env already folds in the mesh), so a rebuilt mesh
+        replica warm-starts with zero live compiles.
+        """
+        if self._net is None:
+            raise ValueError(
+                "shard_replica needs a native net (from_keras_net/load); "
+                "foreign forwards have no mesh-placeable param tree")
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from analytics_zoo_tpu.parallel.mode import TableShardMode
+        from analytics_zoo_tpu.parallel.sharding import path_str
+        from analytics_zoo_tpu.parallel.table_sharding import (
+            resolve_table_ways, table_leaf_patterns)
+
+        tables = self.sharded_tables()
+        mode = TableShardMode(mesh, axis, tables)
+        rep = NamedSharding(mesh, PartitionSpec())
+        row_sh = NamedSharding(mesh, PartitionSpec(axis, None))
+        pats = table_leaf_patterns(tables)
+
+        def placement(path, leaf):
+            shape = getattr(leaf, "shape", ())
+            if (any(p.search(path_str(path)) for p in pats)
+                    and len(shape) == 2
+                    and resolve_table_ways(mesh, axis,
+                                           int(shape[0])) > 1):
+                return row_sh
+            return rep
+
+        fwd = self._build_param_forward(top_n=top_n, table_shard=mode)
+        weights = self._qparams if self._int8 else self._params
+        shardings = jax.tree_util.tree_map_with_path(placement, weights)
+        p_i = jax.device_put(weights, shardings)
+        s_i = jax.device_put(self._state, rep)
+        desc = ("shard_mesh:" + "x".join(
+            f"{k}={v}" for k, v in mesh.shape.items()) + f":{axis}")
+
+        def dispatch(xs):
+            self._note_shapes(xs, tag=desc)
+            xd = [jax.device_put(jnp.asarray(x), rep) for x in xs]
+            if self._cache is not None:
+                prog = self._aot_program(p_i, s_i, xd, device=desc,
+                                         top_n=top_n, fwd=fwd)
                 return prog(p_i, s_i, *xd)
             return fwd(p_i, s_i, *xd)
 

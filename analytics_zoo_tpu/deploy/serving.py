@@ -76,17 +76,20 @@ from analytics_zoo_tpu.observe import metrics as obs
 from analytics_zoo_tpu.observe.export import JsonlEventLog, to_prometheus
 from analytics_zoo_tpu.observe.recorder import SLO, FlightRecorder
 from analytics_zoo_tpu.observe.trace import TRACER
-from analytics_zoo_tpu.robust import (CircuitBreaker, Heartbeat, RetryPolicy,
+from analytics_zoo_tpu.robust import (CircuitBreaker, Heartbeat,
+                                      QuarantineBroadcast, RetryPolicy,
                                       Supervisor, faults)
-from analytics_zoo_tpu.robust.errors import (DeadlineExpired,
+from analytics_zoo_tpu.robust.errors import (DeadlineExpired, HostLostError,
                                              MalformedRecordError,
+                                             MeshReplicaLostError,
                                              ServingError, ServingOverloaded)
 
 __all__ = ["MemoryQueue", "FileQueue", "RedisQueue", "make_queue",
            "make_queue_from_zoo", "InputQueue", "OutputQueue",
            "ServingConfig", "ClusterServing", "DeviceExecutor",
-           "encode_tensor", "decode_tensor", "encode_image",
-           "decode_image", "error_payload", "MalformedRecordError"]
+           "PodCoordinator", "encode_tensor", "decode_tensor",
+           "encode_image", "decode_image", "error_payload",
+           "MalformedRecordError"]
 
 
 def error_payload(code: str, message: Any, uri: Optional[str] = None
@@ -790,7 +793,10 @@ class ServingConfig:
                  autoscale: bool = False,
                  autoscale_cooldown_s: float = 5.0,
                  autoscale_interval_s: float = 1.0,
-                 autoscale_policy=None):
+                 autoscale_policy=None,
+                 mesh_replicas: int = 0,
+                 mesh_axis: str = "model",
+                 mesh_shed_after_s: float = 30.0):
         self.model_path = model_path
         self.batch_size = batch_size
         self.backpressure_maxlen = backpressure_maxlen
@@ -834,6 +840,15 @@ class ServingConfig:
         self.autoscale_cooldown_s = float(autoscale_cooldown_s)
         self.autoscale_interval_s = float(autoscale_interval_s)
         self.autoscale_policy = autoscale_policy
+        # pod-scale serving (docs/SERVING.md "Pod-scale serving"): a
+        # mesh replica is one shard_replica forward over the context
+        # mesh — a first-class replica slot AND a first-class failure
+        # domain.  ``mesh_shed_after_s`` bounds how long a quarantined
+        # mesh replica waits for the host roster to heal before the
+        # supervisor sheds it and re-plans the HBM budget without it.
+        self.mesh_replicas = max(0, int(mesh_replicas))
+        self.mesh_axis = str(mesh_axis)
+        self.mesh_shed_after_s = float(mesh_shed_after_s)
 
     def slo_for(self, model: str) -> float:
         """The e2e-p99 SLO (ms) for one model: its dict entry, or the
@@ -962,13 +977,20 @@ class _ModelGroup:
     (``InferenceModel.mesh_replica``): batches at or past
     ``LONG_DOC_TOKENS`` sequence tokens route there with their own
     round-robin cursor, so a 128k-token request never occupies (and
-    never OOMs) a single-chip slot."""
+    never OOMs) a single-chip slot.
+
+    ``mesh_slots`` holds the pod-scale sharded mesh replicas
+    (``InferenceModel.shard_replica`` — docs/SERVING.md "Pod-scale
+    serving"): each one is a whole mesh slice serving as ONE replica.
+    They join the normal round-robin (first-class capacity) but stay a
+    separate list because they plan under per-chip shard bytes, heal
+    against the host roster, and quarantine atomically as a group."""
 
     __slots__ = ("name", "slots", "rr", "buckets", "fallback",
-                 "long_slots", "long_rr")
+                 "long_slots", "long_rr", "mesh_slots")
 
     def __init__(self, name, slots, buckets, fallback=None,
-                 long_slots=None):
+                 long_slots=None, mesh_slots=None):
         self.name = name
         self.slots = slots
         self.rr = 0
@@ -976,9 +998,11 @@ class _ModelGroup:
         self.fallback = fallback
         self.long_slots = list(long_slots or [])
         self.long_rr = 0
+        self.mesh_slots = list(mesh_slots or [])
 
     def all_slots(self):
-        return list(self.slots) + list(self.long_slots)
+        return (list(self.slots) + list(self.long_slots)
+                + list(self.mesh_slots))
 
 
 class DeviceExecutor:
@@ -1028,7 +1052,7 @@ class DeviceExecutor:
                  max_inflight: int = 2, name: str = "serving",
                  breaker_threshold: int = 3, breaker_cooldown_s: float = 2.0,
                  fallback=None, max_retries: int = 2,
-                 long_doc_replicas=None):
+                 long_doc_replicas=None, mesh_replicas=None):
         rep_map = (dict(replicas) if isinstance(replicas, dict)
                    else {DEFAULT_MODEL: list(replicas or [])})
         if not rep_map or not all(rep_map.values()):
@@ -1039,6 +1063,11 @@ class DeviceExecutor:
         long_map = (dict(long_doc_replicas)
                     if isinstance(long_doc_replicas, dict)
                     else {DEFAULT_MODEL: list(long_doc_replicas or [])})
+        # mesh_replicas: pod-scale sharded mesh replicas
+        # (InferenceModel.shard_replica) — first-class round-robin
+        # capacity, quarantined atomically as one failure domain
+        mesh_map = (dict(mesh_replicas) if isinstance(mesh_replicas, dict)
+                    else {DEFAULT_MODEL: list(mesh_replicas or [])})
         self.max_inflight = max(1, int(max_inflight))
         self.name = name
         self.breaker_threshold = max(1, int(breaker_threshold))
@@ -1055,6 +1084,7 @@ class DeviceExecutor:
         fb_map = fallback if isinstance(fallback, dict) else {}
         self._groups: Dict[str, _ModelGroup] = {}
         for mname, reps in rep_map.items():
+            longs = long_map.get(mname) or []
             self._groups[mname] = _ModelGroup(
                 mname, self._make_slots(reps, mname),
                 bucket_map.get(mname, buckets if not isinstance(
@@ -1062,9 +1092,16 @@ class DeviceExecutor:
                 fb_map.get(mname) if isinstance(fallback, dict)
                 else fallback,
                 long_slots=self._make_slots(
-                    long_map.get(mname) or [], mname, long_doc=True,
-                    start=len(reps)))
+                    longs, mname, kind="longdoc_replica",
+                    start=len(reps)),
+                mesh_slots=self._make_slots(
+                    mesh_map.get(mname) or [], mname,
+                    kind="mesh_replica", start=len(reps) + len(longs)))
         self._default_model = next(iter(self._groups))
+        # one epoch ledger per executor: a host-loss epoch quarantines
+        # every mesh slot of the affected model exactly once, however
+        # many threads observe the same loss
+        self.mesh_quarantine = QuarantineBroadcast(name=f"{name}_mesh")
         self._inflight = 0
         self._last_harvest_t: Optional[float] = None
         self._harvesting: Optional[_Batch] = None
@@ -1081,11 +1118,11 @@ class DeviceExecutor:
         self._harvest_thread.start()
 
     def _make_slots(self, replicas: List, model: str = DEFAULT_MODEL,
-                    long_doc: bool = False, start: int = 0
+                    kind: str = "replica", start: int = 0
                     ) -> List["_ReplicaSlot"]:
-        # long-doc slot indices continue after the single-chip ones so
-        # rebuild_slot/metrics address every slot of a model uniquely
-        kind = "longdoc_replica" if long_doc else "replica"
+        # long-doc / mesh slot indices continue after the single-chip
+        # ones so rebuild_slot/metrics address every slot of a model
+        # uniquely
         prefix = (f"{self.name}_{kind}" if model == DEFAULT_MODEL
                   else f"{self.name}_{model}_{kind}")
         return [_ReplicaSlot(
@@ -1219,6 +1256,7 @@ class DeviceExecutor:
         breaker resets to closed; the first successful harvest through
         the slot counts ``<name>/replica_restored``."""
         model = model or self._default_model
+        kind = "replica"
         with self._lock:
             group = self._groups.get(model)
             if group is None:
@@ -1228,14 +1266,94 @@ class DeviceExecutor:
                     s.replica = replica
                     s.breaker.reset()
                     s.rebuilt = True
+                    kind = s.kind
                     break
             else:
                 return
         obs.count("serving_replica_events_total", event="rebuilt",
                   replica=index, model=model,
                   flat=f"{self.name}/replica_rebuilt")
+        if kind == "mesh_replica":
+            obs.count("serving_mesh_replica_events_total", event="rebuilt",
+                      model=model, flat=f"{self.name}/mesh_replica_rebuilt")
         self._log.warning("%s: replica %d (%s) rebuilt and swapped in",
                           self.name, index, model)
+
+    # -- mesh replicas (docs/SERVING.md "Pod-scale serving") ---------------
+    def mesh_slots_of(self, model: Optional[str] = None
+                      ) -> List["_ReplicaSlot"]:
+        with self._lock:
+            g = self._groups.get(model or self._default_model)
+            return list(g.mesh_slots) if g is not None else []
+
+    def mesh_group_size(self, model: Optional[str] = None) -> int:
+        return len(self.mesh_slots_of(model))
+
+    def healthy_mesh_replicas(self, model: Optional[str] = None) -> int:
+        return sum(1 for s in self.mesh_slots_of(model)
+                   if s.breaker.health != "quarantined")
+
+    def quarantine_mesh_replica(self, epoch: int,
+                                model: Optional[str] = None) -> bool:
+        """Atomically quarantine EVERY mesh-replica slot of ``model``
+        for host-loss ``epoch``.  A mesh replica is one failure domain:
+        a dead member host (barrier timeout, harvest watchdog, peer
+        notification) invalidates the whole slice, so all its breakers
+        trip together — exactly once per epoch, however many threads
+        observe the same loss (docs/SERVING.md "Pod-scale serving").
+        Returns True when THIS call performed the trip."""
+        model = model or self._default_model
+        slots = self.mesh_slots_of(model)
+        if not slots:
+            return False
+        if not self.mesh_quarantine.trip(epoch,
+                                         [s.breaker for s in slots]):
+            return False
+        obs.count("serving_mesh_replica_events_total", event="quarantined",
+                  model=model, flat=f"{self.name}/mesh_replica_quarantined")
+        self._log.warning(
+            "%s: mesh replica(s) of %r quarantined atomically at host-loss "
+            "epoch %d (%d slot(s))", self.name, model, epoch, len(slots))
+        return True
+
+    def shed_mesh_replicas(self, model: Optional[str] = None) -> int:
+        """Drop every mesh-replica slot of ``model`` (the roster did not
+        heal in time — docs/SERVING.md "Pod-scale serving").  In-flight
+        batches on the shed slots still answer through the normal
+        requeue path; the freed per-chip budget lets the autoscaler
+        re-plan with one fewer replica.  Returns slots shed."""
+        model = model or self._default_model
+        with self._lock:
+            g = self._groups.get(model)
+            if g is None or not g.mesh_slots:
+                return 0
+            shed, g.mesh_slots = list(g.mesh_slots), []
+            g.rr = 0
+        obs.count("serving_mesh_replica_events_total", len(shed),
+                  event="shed", model=model,
+                  flat=f"{self.name}/mesh_replica_shed")
+        self._log.warning("%s: shed %d mesh replica slot(s) of %r",
+                          self.name, len(shed), model)
+        return len(shed)
+
+    def add_mesh_replicas(self, replicas: List,
+                          model: Optional[str] = None) -> int:
+        """Install fresh mesh-replica slots (supervisor rebuild after a
+        shed, or a late roster heal).  Indices continue after every
+        existing slot of the group."""
+        model = model or self._default_model
+        with self._lock:
+            g = self._groups.get(model)
+            if g is None or not replicas:
+                return 0
+            start = max((s.index for s in g.all_slots()), default=-1) + 1
+            g.mesh_slots.extend(self._make_slots(
+                list(replicas), model, kind="mesh_replica", start=start))
+            n = len(g.mesh_slots)
+        obs.count("serving_mesh_replica_events_total", len(replicas),
+                  event="rebuilt", model=model,
+                  flat=f"{self.name}/mesh_replica_rebuilt")
+        return n
 
     def ensure_threads(self) -> None:
         """Supervisor repair: respawn a dead executor thread (the loops
@@ -1297,6 +1415,12 @@ class DeviceExecutor:
             obs.count("serving_replica_events_total", event="quarantined",
                       replica=slot.index, model=slot.model,
                       flat=f"{self.name}/replica_quarantined")
+        if slot is not None and slot.kind == "mesh_replica":
+            # a wedged mesh readback is indistinguishable from a lost
+            # member host — quarantine the whole slice (synthesized
+            # epoch; the roster-driven path supplies real ones)
+            self.quarantine_mesh_replica(
+                self.mesh_quarantine.last_epoch + 1, model=slot.model)
         self._requeue_or_fail(
             batch, ServingError("device harvest exceeded "
                                 f"{deadline_s:.1f}s deadline",
@@ -1337,7 +1461,14 @@ class DeviceExecutor:
 
     def _replica_failed(self, slot: "_ReplicaSlot", batch: "_Batch",
                         exc: BaseException) -> None:
-        if slot.breaker.record_failure():
+        if (slot.kind == "mesh_replica"
+                and isinstance(exc, MeshReplicaLostError)):
+            # a lost member host invalidates the WHOLE mesh slice: trip
+            # every mesh slot of the group at the loss epoch (idempotent
+            # — concurrent observers collapse into one quarantine), then
+            # let the requeue retry on the surviving single-chip slots
+            self.quarantine_mesh_replica(exc.epoch, model=slot.model)
+        elif slot.breaker.record_failure():
             obs.count("serving_replica_events_total", event="quarantined",
                       replica=slot.index, model=slot.model,
                       flat=f"{self.name}/replica_quarantined")
@@ -1360,7 +1491,10 @@ class DeviceExecutor:
 
     def _pick_slot_locked(self, group: "_ModelGroup", long_doc: bool = False
                           ) -> Optional["_ReplicaSlot"]:
-        slots = group.long_slots if long_doc else group.slots
+        # mesh slots are first-class capacity: they share the normal
+        # round-robin cursor with the single-chip slots
+        slots = (group.long_slots if long_doc
+                 else list(group.slots) + list(group.mesh_slots))
         rr = group.long_rr if long_doc else group.rr
         n = len(slots)
         for k in range(n):
@@ -1612,6 +1746,128 @@ class DeviceExecutor:
                       flat=f"{self.name}/replica_restored")
 
 
+class _PodReplica:
+    """A mesh replica whose dispatch is gated by the pod's deadline
+    barrier (:meth:`PodCoordinator.dispatch_barrier`): every member
+    host enters the barrier before compute, so a dead member surfaces
+    as :class:`MeshReplicaLostError` on all survivors within the
+    barrier timeout instead of a silent hang."""
+
+    def __init__(self, inner, coord: "PodCoordinator"):
+        self._inner = inner
+        self._coord = coord
+        self.device = (f"pod{coord.replica_id}:"
+                       f"{getattr(inner, 'device', 'mesh')}")
+        self.on_device_topn = bool(getattr(inner, "on_device_topn", False))
+        self.pads_input = bool(getattr(inner, "pads_input", True))
+
+    def dispatch(self, xs):
+        self._coord.dispatch_barrier()
+        return self._inner.dispatch(xs)
+
+    def harvest(self, handle):
+        return self._inner.harvest(handle)
+
+
+class PodCoordinator:
+    """Cross-host coordination for one mesh replica (docs/SERVING.md
+    "Pod-scale serving").
+
+    Every serving process of a pod holds one coordinator over the
+    shared :class:`~analytics_zoo_tpu.core.context.HostRoster`.  The
+    dispatch path synchronizes the members with a deadline barrier
+    (``zoo_pod_dispatch_{name}_{seq}`` — the serving mirror of the data
+    loader's ``zoo_data_shard_*`` barriers): a member that dies or
+    wedges times the barrier out on EVERY survivor within
+    ``dist_barrier_timeout_s``, and each survivor converts the timeout
+    into the same epoch-tagged :class:`MeshReplicaLostError` — so the
+    executor's :class:`~analytics_zoo_tpu.robust.QuarantineBroadcast`
+    trips the whole replica exactly once per loss epoch, atomically, on
+    every surviving host.
+
+    ``faults.inject("serving.host_lost")`` sits on the barrier path so
+    chaos tests drive the full loss→quarantine→heal cycle without a
+    real multi-host pod (docs/ROBUSTNESS.md fault-site table).
+    """
+
+    def __init__(self, roster, process_id: int, *, replica_id: int = 0,
+                 name: str = "pod",
+                 barrier_timeout_s: Optional[float] = None):
+        self.roster = roster
+        self.process_id = int(process_id)
+        self.replica_id = int(replica_id)
+        self.name = name
+        self.barrier_timeout_s = barrier_timeout_s
+        self._seq = 0
+        self._seq_lock = threading.Lock()
+
+    def wrap_replica(self, replica) -> "_PodReplica":
+        """Gate one ``shard_replica`` forward behind the pod barrier."""
+        return _PodReplica(replica, self)
+
+    def dispatch_barrier(self) -> None:
+        """One barrier round before a mesh dispatch.  Raises
+        :class:`MeshReplicaLostError` (epoch-tagged, roster already
+        marked) when any member is gone."""
+        from analytics_zoo_tpu.core.context import dist_barrier
+
+        with self._seq_lock:
+            self._seq += 1
+            seq = self._seq
+        try:
+            faults.inject("serving.host_lost")
+            dist_barrier(f"zoo_pod_dispatch_{self.name}_{seq}",
+                         timeout_s=self.barrier_timeout_s,
+                         phase="dispatch")
+        except MeshReplicaLostError:
+            raise
+        except HostLostError as e:
+            raise self.host_lost(
+                barrier=getattr(e, "barrier", "") or "",
+                timeout_s=getattr(e, "timeout_s", None)) from e
+
+    def host_lost(self, lost_process_id: int = -1, barrier: str = "",
+                  timeout_s: Optional[float] = None
+                  ) -> MeshReplicaLostError:
+        """Mark the loss on the roster and build the typed error.  A
+        barrier timeout cannot name the dead member, so without an
+        explicit ``lost_process_id`` every peer is marked lost — the
+        replica is unusable either way, and a healed peer re-registers
+        through :meth:`heal`."""
+        peers = [p for p in self.roster.expected
+                 if p != self.process_id]
+        lost = ([int(lost_process_id)] if lost_process_id >= 0
+                else list(peers))
+        epoch = self.roster.epoch
+        for pid in lost:
+            epoch = self.roster.mark_lost(pid)
+        obs.count("serving_mesh_replica_events_total", event="host_lost",
+                  model=self.name, flat="serving/pod_host_lost")
+        # fan the loss out to every registered peer-loss hook so ONE
+        # barrier deadline quarantines every model's mesh replicas, not
+        # just the model whose dispatch tripped it
+        from analytics_zoo_tpu.core.context import report_peer_loss
+        report_peer_loss(
+            lost, reason=(f"pod {self.name!r} replica {self.replica_id} "
+                          f"barrier deadline"))
+        msg = (f"pod {self.name!r} replica {self.replica_id}: member "
+               f"host(s) {lost} lost at roster epoch {epoch}")
+        if barrier:
+            msg += (f" (barrier {barrier!r} timed out"
+                    + (f" after {timeout_s:.1f}s" if timeout_s else "")
+                    + ")")
+        return MeshReplicaLostError(
+            msg, replica_id=self.replica_id,
+            lost_process_id=lost[0] if lost else -1, epoch=epoch,
+            barrier=barrier, timeout_s=timeout_s)
+
+    def heal(self, process_id: int) -> int:
+        """A member came back: re-register it on the roster.  Returns
+        the new roster epoch (the supervisor rebuilds the replica once
+        ``roster.healed()``)."""
+        return self.roster.mark_alive(int(process_id))
+
+
 class _SloAdmission:
     """Weighted per-model admission (docs/SERVING.md "Warm start &
     multi-model").  Each model with a nonzero SLO gets a sliding window
@@ -1690,11 +1946,15 @@ class ClusterServing:
     """
 
     def __init__(self, model, queue, config: Optional[ServingConfig] = None,
-                 preprocess: Optional[Callable] = None):
+                 preprocess: Optional[Callable] = None, mesh=None,
+                 roster=None, pod: Optional[PodCoordinator] = None):
         # ``model`` is one InferenceModel (legacy) or a dict of named
         # models multiplexed by one executor under a shared HBM budget
         # (docs/SERVING.md "Warm start & multi-model").  ``self.model``
         # stays the single/default model for existing callers.
+        # ``mesh`` (+ ``cfg.mesh_replicas``) turns on pod-scale mesh
+        # replicas; ``roster``/``pod`` wire the cross-host failure
+        # domain (docs/SERVING.md "Pod-scale serving").
         if isinstance(model, dict):
             if not model:
                 raise ValueError("ClusterServing needs at least one model")
@@ -1740,6 +2000,12 @@ class ClusterServing:
         self._scale_lock = threading.Lock()
         self._decode_target = self.cfg.decode_workers
         self._replica_plan: Dict[str, int] = {}
+        # pod-scale mesh replicas (docs/SERVING.md "Pod-scale serving")
+        self._mesh = mesh
+        self.roster = roster
+        self.pod = pod
+        self._mesh_plan: Dict[str, int] = {}
+        self._peer_loss_hook = None
         self._tb = None
         self._tb_last_t = time.monotonic()
         self._tb_last_n = 0
@@ -1769,6 +2035,13 @@ class ClusterServing:
             self._thread = threading.Thread(target=self.run_forever,
                                             daemon=True, name="srv-sync")
             self._thread.start()
+        if self.pod is not None:
+            # the coordination service's heartbeat detector observes a
+            # member death whether or not a dispatch barrier is in
+            # flight — route it into the same quarantine entry point
+            from analytics_zoo_tpu.core import context as _ctx
+            _ctx.on_peer_loss(self.notify_host_lost)
+            self._peer_loss_hook = self.notify_host_lost
         return self
 
     def _build_replicas(self, model: Optional[str] = None,
@@ -1804,6 +2077,70 @@ class ClusterServing:
                 cost(plan), budget)
         return plan
 
+    def _mesh_eligible(self, mname: str) -> bool:
+        m = self.models[mname]
+        return (getattr(m, "_net", None) is not None
+                and hasattr(m, "shard_replica"))
+
+    def _mesh_chip_nbytes(self, mname: str) -> int:
+        """Per-chip bytes of ONE mesh replica of ``mname``: sharded
+        table leaves charge ``nbytes / ways``, everything else full —
+        the reason an over-per-chip-budget sharded-table model still
+        fits a mesh replica (docs/SERVING.md "Pod-scale serving")."""
+        m = self.models[mname]
+        try:
+            return max(1, int(m.weight_nbytes_per_chip(
+                self._mesh, axis=self.cfg.mesh_axis)))
+        except Exception:
+            return max(1, int(getattr(m, "weight_nbytes",
+                                      lambda: 0)() or 1))
+
+    def _plan_mesh_replicas(self) -> Dict[str, int]:
+        """Mesh-replica counts under what the single-chip plan left of
+        the shared HBM budget.  A mesh replica is charged its PER-CHIP
+        shard bytes (the budget is per chip; the slice spreads the
+        table rows); over budget the heaviest model sheds mesh replicas
+        first — all the way to 0, mesh capacity is optional."""
+        if self._mesh is None or not self.cfg.mesh_replicas:
+            return {m: 0 for m in self.models}
+        plan = {m: (self.cfg.mesh_replicas if self._mesh_eligible(m)
+                    else 0) for m in self.models}
+        budget = self.cfg.hbm_budget_bytes
+        if not budget:
+            return plan
+        sizes = {m: max(1, int(getattr(mdl, "weight_nbytes",
+                                       lambda: 0)() or 1))
+                 for m, mdl in self.models.items()}
+        chip = {m: self._mesh_chip_nbytes(m) for m in self.models}
+        used = sum(sizes[m] * self._replica_plan.get(m, self.cfg.replicas)
+                   for m in self.models)
+        def cost(p):
+            return used + sum(chip[m] * p[m] for m in p)
+        while cost(plan) > budget and any(v > 0 for v in plan.values()):
+            heavy = max((m for m in plan if plan[m] > 0),
+                        key=lambda m: chip[m] * plan[m])
+            plan[heavy] -= 1
+        return plan
+
+    def _build_mesh_replicas(self, model: Optional[str] = None,
+                             n: Optional[int] = None) -> List:
+        """``n`` fresh sharded mesh forwards (each one whole-mesh-as-
+        one-replica), pod-barrier-gated when a :class:`PodCoordinator`
+        is attached.  Warm-start note: the PR 15 compile-cache digest
+        already folds in the mesh, so a rebuilt mesh replica re-loads
+        its programs instead of compiling (``warm_compile_count == 0``
+        in the chaos soak)."""
+        mname = model or self._default_model
+        if n is None:
+            n = self._mesh_plan.get(mname, self.cfg.mesh_replicas)
+        reps = [self.models[mname].shard_replica(
+                    self._mesh, top_n=self.cfg.postprocess_top_n,
+                    axis=self.cfg.mesh_axis)
+                for _ in range(max(0, int(n)))]
+        if self.pod is not None:
+            reps = [self.pod.wrap_replica(r) for r in reps]
+        return reps
+
     def _warm_models(self) -> None:
         """Pre-install every cached executable before replica build, so
         a restarted worker's first request hits full bucket coverage
@@ -1826,9 +2163,11 @@ class ClusterServing:
     def _start_pipeline(self) -> None:
         self._warm_models()
         self._replica_plan = self._plan_replicas()
+        self._mesh_plan = self._plan_mesh_replicas()
         rep_map: Dict[str, List] = {}
         bucket_map: Dict[str, tuple] = {}
         fb_map: Dict[str, Callable] = {}
+        mesh_map: Dict[str, List] = {}
         for mname, m in self.models.items():
             reps = self._build_replicas(mname)
             rep_map[mname] = reps
@@ -1838,6 +2177,8 @@ class ClusterServing:
                 or (1, self.cfg.batch_size))
             fb_map[mname] = (lambda fused, _m=m: _m.predict(
                 fused[0] if len(fused) == 1 else fused))
+            if self._mesh_plan.get(mname):
+                mesh_map[mname] = self._build_mesh_replicas(mname)
         self._topn_on_device = self._topn_by_model[self._default_model]
         self._hb = Heartbeat()
         self._executor = DeviceExecutor(
@@ -1845,7 +2186,7 @@ class ClusterServing:
             max_inflight=self.cfg.max_inflight,
             breaker_threshold=self.cfg.breaker_threshold,
             breaker_cooldown_s=self.cfg.breaker_cooldown_s,
-            fallback=fb_map)
+            fallback=fb_map, mesh_replicas=mesh_map or None)
         self._executor._heartbeat = lambda: self._hb.beat("device")
         self._batcher = DynamicBatcher(
             max_batch=self.cfg.batch_size,
@@ -1883,6 +2224,13 @@ class ClusterServing:
         sup.add_check("harvest_watchdog", lambda: self._executor
                       .check_harvest(self.cfg.harvest_deadline_s))
         sup.add_check("heal_replicas", self._heal_replicas)
+        sup.add_check("heal_mesh_replicas", self._heal_mesh_replicas)
+        reclaim = getattr(self.queue, "reclaim_dead_result_leases", None)
+        if callable(reclaim):
+            # shm result slots leased to a client that was SIGKILL-ed
+            # would otherwise stay READY forever (nobody left to call
+            # get_result) — harvest them every tick
+            sup.add_check("shm_lease_reclaim", reclaim)
         sup.add_check("stages", self._check_stages)
         sup.add_check("gauges", self._publish_gauges)
         # the flight recorder rides the supervisor cadence: e2e-p99
@@ -1948,6 +2296,64 @@ class ClusterServing:
                 if slot.index < len(fresh):
                     ex.rebuild_slot(slot.index, fresh[slot.index],
                                     model=mname)
+
+    def notify_host_lost(self, process_id: int = -1) -> int:
+        """Cross-host quarantine entry point (docs/SERVING.md
+        "Pod-scale serving"): a host death was observed — by THIS
+        process's barrier timeout, by a peer's notification, or by the
+        pod supervisor.  Marks the loss on the roster (bumping its
+        epoch) and trips every model's mesh replicas at that epoch.
+        Idempotent per epoch: every survivor can call this for the same
+        loss and the breakers trip exactly once."""
+        ex = self._executor
+        if self.roster is not None and process_id >= 0:
+            epoch = self.roster.mark_lost(process_id)
+        elif self.roster is not None:
+            epoch = max(1, self.roster.epoch)
+        else:
+            epoch = (ex.mesh_quarantine.last_epoch + 1
+                     if ex is not None else 1)
+        if ex is not None:
+            for mname in ex.models():
+                ex.quarantine_mesh_replica(epoch, model=mname)
+        return epoch
+
+    def _heal_mesh_replicas(self) -> None:
+        """Mesh-replica lifecycle (docs/SERVING.md "Pod-scale serving"):
+        a quarantined mesh replica waits for the host roster to heal,
+        then rebuilds through the compile cache (zero live compiles —
+        the cache digest covers the mesh); a roster broken past
+        ``mesh_shed_after_s`` sheds the replica instead, freeing its
+        per-chip budget so the autoscaler re-plans with one fewer
+        replica.  Without a roster (single-host pods, tests) the
+        breaker cooldown paces the rebuild like ``_heal_replicas``."""
+        ex = self._executor
+        if ex is None or self._mesh is None:
+            return
+        roster = self.roster
+        for mname in list(ex.models()):
+            slots = ex.mesh_slots_of(mname)
+            if not slots:
+                continue
+            quar = [s for s in slots
+                    if s.breaker.snapshot()["state"] == "open"]
+            if not quar:
+                continue
+            if roster is not None and not roster.healed():
+                if roster.lost_age_s() > self.cfg.mesh_shed_after_s:
+                    ex.shed_mesh_replicas(mname)
+                    self._mesh_plan[mname] = 0
+                continue  # roster still broken: wait for heal or shed
+            if roster is None:
+                cd = self.cfg.breaker_cooldown_s
+                quar = [s for s in quar
+                        if s.breaker.open_age_s() >= cd
+                        or s.breaker.snapshot()["opens"] >= 2]
+                if not quar:
+                    continue
+            fresh = self._build_mesh_replicas(mname, n=len(quar))
+            for slot, rep in zip(quar, fresh):
+                ex.rebuild_slot(slot.index, rep, model=mname)
 
     def _check_stages(self) -> None:
         """Watchdog for wedged/dead stage threads.  A dead thread is
@@ -2046,6 +2452,11 @@ class ClusterServing:
         for mname, m in self.models.items():
             nb = int(getattr(m, "weight_nbytes", lambda: 0)() or 0)
             used += nb * self._executor.group_size(mname)
+            # live mesh replicas charge per-chip shard bytes; a shed
+            # mesh replica frees exactly this much for re-planning
+            mesh_n = self._executor.mesh_group_size(mname)
+            if mesh_n and self._mesh is not None:
+                used += self._mesh_chip_nbytes(mname) * mesh_n
         add = int(getattr(self.models[model], "weight_nbytes",
                           lambda: 0)() or 0) * extra
         return used + add <= budget
@@ -2142,6 +2553,10 @@ class ClusterServing:
             return
         self._stopped = True
         self._stop.set()
+        if self._peer_loss_hook is not None:
+            from analytics_zoo_tpu.core import context as _ctx
+            _ctx.remove_peer_loss_hook(self._peer_loss_hook)
+            self._peer_loss_hook = None
         log = logging.getLogger("analytics_zoo_tpu.deploy")
         if self._supervisor is not None:
             # the healer goes down FIRST so it can't resurrect stages
@@ -2568,9 +2983,21 @@ class ClusterServing:
             h["models"] = {
                 m: {"replicas": self._executor.group_size(m),
                     "replicas_healthy": self._executor.healthy_replicas(m),
+                    "mesh_replicas": self._executor.mesh_group_size(m),
+                    "mesh_replicas_healthy":
+                        self._executor.healthy_mesh_replicas(m),
                     "slo_p99_ms": self.cfg.slo_for(m),
                     "observed_p99_ms": self._admission.p99(m)}
                 for m in self._executor.models()}
+            if any(self._executor.mesh_group_size(m)
+                   for m in self._executor.models()) or self._mesh_plan:
+                mesh: Dict[str, Any] = {
+                    "plan": dict(self._mesh_plan),
+                    "quarantine_epoch":
+                        self._executor.mesh_quarantine.last_epoch}
+                if self.roster is not None:
+                    mesh["roster"] = self.roster.snapshot()
+                h["mesh"] = mesh
         if self._compile_cache is not None:
             h["compile_cache"] = self._compile_cache.stats()
         if self._autoscaler is not None:

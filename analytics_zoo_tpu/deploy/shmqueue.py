@@ -13,7 +13,8 @@ Segment layout (one ``SharedMemory``, sized at construction)::
 
     geometry header | gseq u64 | slot state[u8 * slots]
     | slot seq[u64 * slots] | slot len[u32 * slots] | slot rid[96 * slots]
-    | result state[u8] / len[u32] / rid[96] arrays
+    | slot owner-pid[u32 * slots]
+    | result state[u8] / len[u32] / rid[96] / owner-pid[u32] arrays
     | 4096-aligned request arena  (slots x slot_bytes)
     | 4096-aligned result arena   (result_slots x result_slot_bytes)
 
@@ -40,6 +41,15 @@ timeout.  ``serving/shm_backpressure_waits`` counts pushers that found
 the arena full (slot exhaustion == backpressure, bounded by
 ``push_timeout_s``).
 
+Cross-process leases: every ``push`` stamps the caller's pid into a
+shared per-slot owner array; ``pop_batch`` carries it to the result
+slot when the worker publishes.  A READY result slot whose owner pid no
+longer exists will never be consumed (the waiter died between push and
+``get_result``) — ``reclaim_dead_result_leases`` frees those slots and
+counts ``serving_shm_lease_reclaims_total``; the serving supervisor
+runs it every tick so a SIGKILL-ed client cannot strand result
+capacity.
+
 Lifecycle: the segment is ``unlink``-ed the moment ``stop()`` runs
 (POSIX keeps live mappings valid after unlink, so in-flight leases
 finish safely), outstanding leases defer only the ``close()``, and an
@@ -59,6 +69,7 @@ from __future__ import annotations
 import atexit
 import ctypes
 import logging
+import os
 import threading
 import time
 import uuid
@@ -70,6 +81,7 @@ import numpy as np
 
 from analytics_zoo_tpu.core.profiling import TIMERS
 from analytics_zoo_tpu.deploy import codec
+from analytics_zoo_tpu.observe import metrics as obs
 from analytics_zoo_tpu.robust.errors import (MalformedRecordError,
                                              ServingOverloaded)
 
@@ -120,6 +132,17 @@ def _align(n: int, a: int) -> int:
     return (n + a - 1) & ~(a - 1)
 
 
+def _pid_alive(pid: int) -> bool:
+    """Signal-0 probe; EPERM means the pid exists under another uid."""
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except OSError:        # PermissionError included: process exists
+        return True
+    return True
+
+
 class ShmQueue:
     """Shared-memory ring-buffer stream + result store (see module
     docstring for the slot protocol and lifecycle contract)."""
@@ -153,6 +176,9 @@ class ShmQueue:
         off += 4 * self.slots
         self._rid_off = off
         off += (2 + _RID_CAP) * self.slots
+        off = _align(off, 4)
+        self._pid_off = off
+        off += 4 * self.slots
         self._rstate_off = off
         off += self.result_slots
         off = _align(off, 4)
@@ -160,6 +186,9 @@ class ShmQueue:
         off += 4 * self.result_slots
         self._rrid_off = off
         off += (2 + _RID_CAP) * self.result_slots
+        off = _align(off, 4)
+        self._rpid_off = off
+        off += 4 * self.result_slots
         self._arena_off = _align(off, _ARENA_ALIGN)
         self._rarena_off = _align(
             self._arena_off + self.slots * self.slot_bytes, _ARENA_ALIGN)
@@ -175,6 +204,8 @@ class ShmQueue:
         self._rid = np.frombuffer(buf, np.uint8,
                                   (2 + _RID_CAP) * self.slots,
                                   self._rid_off).reshape(self.slots, -1)
+        self._pid = np.frombuffer(buf, np.uint32, self.slots,
+                                  self._pid_off)
         self._rst = np.frombuffer(buf, np.uint8, self.result_slots,
                                   self._rstate_off)
         self._rln = np.frombuffer(buf, np.uint32, self.result_slots,
@@ -182,15 +213,24 @@ class ShmQueue:
         self._rrid = np.frombuffer(
             buf, np.uint8, (2 + _RID_CAP) * self.result_slots,
             self._rrid_off).reshape(self.result_slots, -1)
+        self._rpid = np.frombuffer(buf, np.uint32, self.result_slots,
+                                   self._rpid_off)
         self._gseq[0] = 0
         self._st[:] = FREE
         self._rst[:] = FREE
+        self._pid[:] = 0
+        self._rpid[:] = 0
 
         self._cond = threading.Condition()    # request-slot claims
         self._rcond = threading.Condition()   # result-slot claims
         # slots whose last lease died; appended lock-free by finalizers,
         # drained under _cond (see module docstring: GC-reentrancy)
         self._freed: "deque[int]" = deque()
+        # rid -> pusher pid, carried from the request slot at pop_batch
+        # so set_result_many can stamp the result-slot owner.  Worker-
+        # process local (only the popping side consults it).
+        self._owner: Dict[str, int] = {}
+        self.lease_reclaims = 0
         self._closed = False
         _LIVE[self.segment] = self
 
@@ -264,6 +304,7 @@ class ShmQueue:
         self._ln[idx] = n
         self._seq[idx] = seq
         self._put_rid(self._rid, idx, rid)
+        self._pid[idx] = os.getpid()
         self._st[idx] = READY       # publish: single byte store
         with self._cond:
             self._cond.notify_all()
@@ -297,7 +338,9 @@ class ShmQueue:
                 self._shm.buf, self._slot_off(idx))
             weakref.finalize(lease, self._freed.append, idx)
             rec = codec.unpack_record(lease, codec="shm")
-            out.append((self._get_rid(self._rid, idx), rec))
+            rid = self._get_rid(self._rid, idx)
+            self._owner[rid] = int(self._pid[idx])
+            out.append((rid, rec))
             del lease  # the record's tensor views now own the slot
         return out
 
@@ -361,6 +404,7 @@ class ShmQueue:
                 self._shm.buf[off:off + len(data)] = data
                 self._rln[idx] = len(data)
                 self._put_rid(self._rrid, idx, rid)
+                self._rpid[idx] = self._owner.pop(rid, 0)
                 self._rst[idx] = READY
             self._rcond.notify_all()
 
@@ -385,6 +429,45 @@ class ShmQueue:
 
                     raise TimeoutError(_timeout_msg(self, rid, timeout))
                 self._rcond.wait(min(left, 0.05))
+
+    def reclaim_dead_result_leases(self) -> int:
+        """Free READY result slots whose owner process is gone.
+
+        A result slot stays READY until the pusher that owns the rid
+        calls :meth:`get_result`; if that process was SIGKILL-ed the
+        slot would otherwise leak until the segment dies.  The serving
+        supervisor runs this every tick — each reclaim counts
+        ``serving_shm_lease_reclaims_total``.  Slots with no stamped
+        owner (pid 0: results published for rids this worker never
+        popped, e.g. decode-stage error payloads) are left alone.
+        """
+        if self._closed:
+            return 0
+        freed = 0
+        with self._rcond:
+            for idx in np.flatnonzero(self._rst == READY):
+                idx = int(idx)
+                pid = int(self._rpid[idx])
+                if pid > 0 and not _pid_alive(pid):
+                    self._rst[idx] = FREE
+                    self._rpid[idx] = 0
+                    freed += 1
+            if freed:
+                self._rcond.notify_all()
+        # prune owner stamps whose waiter died before the result was
+        # ever published (the respond pool would stamp a dead pid and
+        # the next tick frees it; dropping the map entry here keeps the
+        # worker-local map bounded)
+        for rid, pid in list(self._owner.items()):
+            if pid > 0 and not _pid_alive(pid):
+                self._owner.pop(rid, None)
+        if freed:
+            self.lease_reclaims += freed
+            obs.count("serving_shm_lease_reclaims_total", freed,
+                      flat="serving/shm_lease_reclaims")
+            _log.warning("ShmQueue[%s]: reclaimed %d result lease(s) "
+                         "whose owner process died", self.name, freed)
+        return freed
 
     def pending_results(self) -> List[str]:
         if self._closed:
@@ -448,6 +531,7 @@ class ShmQueue:
         # close() can release the mapping
         self._gseq = self._st = self._seq = self._ln = self._rid = None
         self._rst = self._rln = self._rrid = None
+        self._pid = self._rpid = None
         try:
             self._shm.close()
         except BufferError:

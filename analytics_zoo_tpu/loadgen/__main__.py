@@ -1,9 +1,9 @@
-"""Artifact generator: run every load leg and pin ``SLO_r16.json``.
+"""Artifact generator: run every load leg and pin ``SLO_r18.json``.
 
 ::
 
     JAX_PLATFORMS=cpu python -m analytics_zoo_tpu.loadgen \
-        --out SLO_r16.json [--workdir /tmp/loadgen] [--quick]
+        --out SLO_r18.json [--workdir /tmp/loadgen] [--quick]
 
 The artifact's schema and the doc-pinned rows are described in
 docs/LOADGEN.md; ``tests/test_doc_drift.py`` machine-checks the pinned
@@ -22,7 +22,7 @@ import time
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
-    p.add_argument("--out", default="SLO_r16.json")
+    p.add_argument("--out", default="SLO_r18.json")
     p.add_argument("--workdir", default=None,
                    help="scratch dir for the kill leg's spool/cache "
                         "(a fresh tempdir when omitted)")

@@ -18,17 +18,27 @@ its neighbours:
   any plausible service: the poller sheds them as typed ``expired``
   (or ``overloaded`` via the time-to-answer estimate) without paying
   decode or device time for them.
+- :func:`host_kill` — SIGKILLs one *serving process* of a pod at a
+  scheduled offset into the storm (a real OS kill, not an injected
+  exception).  The survivors must quarantine the whole mesh replica
+  within the barrier timeout and every accepted request must still
+  terminate as a result or a typed error — the chaos leg behind the
+  ``kill`` pod rows in docs/LOADGEN.md.
 """
 
 from __future__ import annotations
 
+import os
+import signal
+import threading
 import time
 import uuid
 from typing import Any, Dict, List, Optional
 
 import numpy as np
 
-__all__ = ["SlowClient", "malformed_flood", "expired_ttl_flood"]
+__all__ = ["SlowClient", "malformed_flood", "expired_ttl_flood",
+           "host_kill"]
 
 
 class SlowClient:
@@ -106,3 +116,32 @@ def expired_ttl_flood(input_queue, model: Optional[str] = None,
                             x=x)
         uris.append(uri)
     return uris
+
+
+def host_kill(proc, at_s: float = 0.0) -> threading.Thread:
+    """SIGKILL a serving process ``at_s`` seconds from now.
+
+    ``proc`` is a ``subprocess.Popen`` / ``multiprocessing.Process``
+    (anything with a ``pid``) or a raw pid.  The kill is delivered on a
+    daemon timer thread so the caller can start the storm first and let
+    the host die mid-flight; join the returned thread to sequence
+    assertions after the kill.  SIGKILL is deliberate — no atexit, no
+    finally blocks, no graceful drain — because the recovery contract
+    being tested is the *survivors'* barrier timeout, not the victim's
+    shutdown path.  Already-dead victims are ignored (idempotent under
+    races with natural exit).
+    """
+    pid = int(getattr(proc, "pid", proc))
+
+    def _kill() -> None:
+        if at_s > 0:
+            time.sleep(at_s)
+        try:
+            os.kill(pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+
+    t = threading.Thread(target=_kill, name=f"host_kill_{pid}",
+                         daemon=True)
+    t.start()
+    return t

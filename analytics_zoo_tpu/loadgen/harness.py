@@ -5,7 +5,7 @@ Each ``run_*_leg`` function is self-contained — it builds its queue,
 server, schedule and client(s), runs to completion, and returns the
 JSON-ready section the artifact writer
 (``python -m analytics_zoo_tpu.loadgen``) assembles into
-``SLO_r16.json``.  The slow soak tests drive the same functions and
+``SLO_r18.json``.  The slow soak tests drive the same functions and
 assert over the sections, so the pinned artifact and the CI proof are
 the same code path.
 
@@ -40,13 +40,17 @@ from analytics_zoo_tpu.loadgen.payloads import PayloadClass, PayloadMix
 
 __all__ = ["two_model_pair", "make_queue", "run_steady_leg",
            "run_burst_leg", "run_mix_shift_leg", "run_adversarial_leg",
-           "run_open_loop_check", "run_kill_leg", "SERVER_IN_DIM",
-           "SERVER_QUEUE_NAME"]
+           "run_open_loop_check", "run_kill_leg", "run_pod_kill_leg",
+           "SERVER_IN_DIM", "SERVER_QUEUE_NAME", "POD_IN_DIM",
+           "POD_VOCAB"]
 
 # the deterministic cross-process server contract (server_main.py /
 # client_main.py / the kill leg all agree on these)
 SERVER_IN_DIM = 12
 SERVER_QUEUE_NAME = "loadgen_stream"
+# the pod-mode bag model's contract (server_main.build_bag_model)
+POD_IN_DIM = 4
+POD_VOCAB = 64
 
 
 def two_model_pair(laggy_sleep_s: float = 0.03, dim: int = 4):
@@ -488,6 +492,187 @@ def run_kill_leg(workdir: str, qps: float = 50.0, duration_s: float = 22.0,
     return sec
 
 
+# -- the pod kill leg: a pod MEMBER HOST dies mid-storm ---------------------
+
+def _free_port() -> int:
+    import socket
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _read_status(path: str) -> Dict[str, Any]:
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {}
+
+
+def start_pod(workdir: str, spool: str, cache: str, tag: str,
+              port: int, slo_ms: float, barrier_timeout_s: float,
+              pod_name: str) -> Tuple[subprocess.Popen, subprocess.Popen,
+                                      str, str]:
+    """Launch one 2-process pod (lead + member host) of ``server_main``
+    over the shared FileQueue spool.  Returns (lead, follower,
+    lead_status_path, follower_status_path)."""
+    procs, statuses = [], []
+    for pid in (0, 1):
+        status = os.path.join(workdir, f"{tag}_{pid}.status.json")
+        procs.append(start_server_process(
+            spool, cache, status,
+            os.path.join(workdir, f"{tag}_{pid}.log"), slo_ms=slo_ms,
+            extra_args=["--model", "bag", "--pod-processes", "2",
+                        "--pod-id", str(pid), "--pod-port", str(port),
+                        "--pod-name", pod_name, "--local-devices", "2",
+                        "--barrier-timeout", str(barrier_timeout_s),
+                        "--mesh-replicas", "1"]))
+        statuses.append(status)
+    return procs[0], procs[1], statuses[0], statuses[1]
+
+
+def run_pod_kill_leg(workdir: str, qps: float = 40.0,
+                     duration_s: float = 16.0, kill_at_s: float = 6.0,
+                     tail_duration_s: float = 8.0,
+                     barrier_timeout_s: float = 2.0,
+                     slo_ms: float = 4000.0, seed: int = 31,
+                     window_s: float = 1.0) -> Dict[str, Any]:
+    """SIGKILL a pod MEMBER HOST mid-storm (``adversarial.host_kill``);
+    prove the surviving lead quarantines the whole mesh replica within
+    the barrier deadline and keeps serving degraded with ZERO lost
+    requests, then that a successor pod against the same compile cache
+    reaches SLO on a tail storm with ZERO live compiles.
+
+    Two storms, overlapping pods: storm 1 runs on pod A; its member is
+    SIGKILLed at ``kill_at_s`` and the lead serves the rest on its
+    single-chip slot.  Pod B launches as soon as the quarantine is
+    observed — warm-starting while A still serves, so the spool never
+    loses its claimer — and storm 2 (the tail) runs after A retires
+    idle (SIGTERM, exit 0 — its final status is the quarantine proof).
+    The FileQueue hands each record to exactly one claimer, so the
+    overlap is race-free.
+    """
+    from analytics_zoo_tpu.deploy.serving import (FileQueue, InputQueue,
+                                                  OutputQueue)
+    from analytics_zoo_tpu.loadgen.adversarial import host_kill
+
+    os.makedirs(workdir, exist_ok=True)
+    spool = os.path.join(workdir, "spool")
+    cache = os.path.join(workdir, "cache")
+    os.makedirs(spool, exist_ok=True)
+    os.makedirs(cache, exist_ok=True)
+
+    lead_a, fol_a, st_a0, _ = start_pod(
+        workdir, spool, cache, "podA", _free_port(), slo_ms,
+        barrier_timeout_s, "podA")
+    sta = wait_for_status(st_a0, require="ready")
+    q = FileQueue(spool, name=SERVER_QUEUE_NAME)
+    mix = PayloadMix([PayloadClass("default", shape=(POD_IN_DIM,),
+                                   dtype="int32", field="ids",
+                                   low=0, high=POD_VOCAB)])
+    schedule = arrival_times(Steady(qps), duration_s, seed)
+    client = OpenLoopClient(InputQueue(q), OutputQueue(q), schedule, mix,
+                            leg="pod_kill", seed=seed,
+                            query_timeout_s=5.0).start()
+    t0 = time.monotonic()
+    killer = host_kill(fol_a, at_s=kill_at_s)
+    killer.join(timeout=kill_at_s + 30)
+    t_kill = time.monotonic() - t0
+
+    # the lead's next mesh dispatch must time its deadline barrier out
+    # and quarantine the whole mesh replica — watch the status file
+    detect_deadline = time.monotonic() + barrier_timeout_s + 8.0
+    quarantine_detect_s = None
+    while time.monotonic() < detect_deadline:
+        mesh_h = _read_status(st_a0).get("mesh") or {}
+        if (mesh_h.get("quarantine_epoch") or 0) >= 1:
+            quarantine_detect_s = time.monotonic() - t0 - t_kill
+            break
+        time.sleep(0.1)
+
+    # successor pod on a FRESH coordination port, same spool + cache:
+    # it must warm-start the full executable set (mesh flavor included)
+    # while pod A still serves the storm
+    lead_b, fol_b, st_b0, _ = start_pod(
+        workdir, spool, cache, "podB", _free_port(), slo_ms,
+        barrier_timeout_s, "podB")
+    rc_a = rc_b = None
+    records2: List[Any] = []
+    try:
+        records = client.finish(drain_timeout_s=90.0)
+        wait_for_status(st_b0, require="ready")
+        # pod A retires idle; B owns the spool from here — no gap
+        lead_a.send_signal(signal.SIGTERM)
+        rc_a = lead_a.wait(timeout=30)
+        schedule2 = arrival_times(Steady(qps), tail_duration_s, seed + 1)
+        client2 = OpenLoopClient(InputQueue(q), OutputQueue(q),
+                                 schedule2, mix, leg="pod_kill_tail",
+                                 seed=seed + 1, query_timeout_s=5.0)
+        client2.start()
+        records2 = client2.finish(drain_timeout_s=60.0)
+    finally:
+        if rc_a is None:
+            lead_a.kill()
+            rc_a = lead_a.wait(timeout=10)
+        lead_b.send_signal(signal.SIGTERM)
+        try:
+            rc_b = lead_b.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            lead_b.kill()
+            rc_b = lead_b.wait(timeout=10)
+    fol_a.wait(timeout=10)
+    try:
+        # exits on its own once lead B's coordination service is gone
+        rc_fol_b = fol_b.wait(timeout=30)
+    except subprocess.TimeoutExpired:
+        fol_b.kill()
+        rc_fol_b = fol_b.wait(timeout=10)
+
+    fin_a = _read_status(st_a0)          # post-SIGTERM quarantine proof
+    fin_b = _read_status(st_b0)
+    mesh_a = fin_a.get("mesh") or {}
+    windows = slo_mod.fold_windows(records, window_s, duration_s)
+    windows2 = slo_mod.fold_windows(records2, window_s, tail_duration_s)
+    outcomes = slo_mod.outcome_counts(records)
+    outcomes2 = slo_mod.outcome_counts(records2)
+    lost = (outcomes.get("lost", 0) + outcomes.get("dropped", 0)
+            + outcomes2.get("lost", 0) + outcomes2.get("dropped", 0))
+    within = (quarantine_detect_s is not None
+              and quarantine_detect_s <= barrier_timeout_s + 8.0)
+    sec: Dict[str, Any] = {
+        "qps_target": qps, "duration_s": duration_s,
+        "tail_duration_s": tail_duration_s,
+        "kill_at_s": round(t_kill, 3),
+        "barrier_timeout_s": barrier_timeout_s, "slo_p99_ms": slo_ms,
+        "offered": len(records) + len(records2),
+        "answered_ok": (outcomes.get("ok", 0) + outcomes2.get("ok", 0)),
+        "lost": lost,
+        "outcomes": outcomes,
+        "tail_outcomes": outcomes2,
+        "quarantine_detect_s": (None if quarantine_detect_s is None
+                                else round(quarantine_detect_s, 3)),
+        "quarantine_within_deadline": float(within),
+        "quarantine_epoch": mesh_a.get("quarantine_epoch"),
+        "roster_lost": (mesh_a.get("roster") or {}).get("lost"),
+        "recovery_after_kill_s": slo_mod.recovery_time_to_slo(
+            windows, t_kill, {"default": slo_ms}),
+        "tail_sustained_qps_at_slo": slo_mod.sustained_qps_at_slo(
+            windows2, {"default": slo_ms}),
+        "cold_compile_count": sta.get("compile_count"),
+        "warm_compile_count": fin_b.get("compile_count"),
+        "warm_cache_hits": ((fin_b.get("cache") or {}).get("events")
+                            or {}).get("hit"),
+        "leadA_exit_rc": rc_a,
+        "leadB_exit_rc": rc_b,
+        "follower_exit_rc": fol_a.returncode,     # -9: SIGKILLed
+        "followerB_exit_rc": rc_fol_b,
+    }
+    sec.update(_lat_stats(list(records) + list(records2)))
+    return sec
+
+
 def default_report(workdir: str, quick: bool = False) -> Dict[str, Any]:
     """The full artifact: every leg, assembled.  ``quick`` shrinks
     durations for smoke runs (NOT for the pinned artifact)."""
@@ -513,4 +698,7 @@ def default_report(workdir: str, quick: bool = False) -> Dict[str, Any]:
     report["kill"] = run_kill_leg(os.path.join(workdir, "kill"),
                                   duration_s=22.0 * scale,
                                   kill_at_s=7.0 * scale)
+    report["pod_kill"] = run_pod_kill_leg(
+        os.path.join(workdir, "pod_kill"), duration_s=16.0 * scale,
+        kill_at_s=6.0 * scale, tail_duration_s=8.0 * scale)
     return report

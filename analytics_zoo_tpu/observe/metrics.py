@@ -78,6 +78,12 @@ CATALOG: Dict[str, Tuple[str, str, Tuple[str, ...]]] = {
     "serving_replica_events_total": (
         "counter", "replica lifecycle events "
         "(quarantined|restored|rebuilt)", ("event", "model", "replica")),
+    "serving_mesh_replica_events_total": (
+        "counter", "mesh-replica (pod failure domain) lifecycle events "
+        "(quarantined|shed|rebuilt|host_lost)", ("event", "model")),
+    "serving_shm_lease_reclaims_total": (
+        "counter", "shm result-slot leases harvested because the owner "
+        "process died before get_result", ()),
     "serving_stage_restarts_total": (
         "counter", "dead stage threads respawned by the supervisor",
         ("stage",)),
@@ -194,6 +200,10 @@ CATALOG: Dict[str, Tuple[str, str, Tuple[str, ...]]] = {
     "dist_init_retries_total": (
         "counter", "jax.distributed.initialize attempts retried",
         ()),
+    "dist_peer_loss_total": (
+        "counter", "pod peer losses detected Python-side (barrier "
+        "deadlines) and survived — the stock coordination client's "
+        "heartbeat detector would have terminated the process", ()),
     # the observability layer itself
     "observe_flight_records_total": (
         "counter", "flight-recorder snapshots captured, by reason",

@@ -315,6 +315,33 @@ def ensure_table_sharding(strategy: ShardingStrategy,
     return TableShardedStrategy(base=strategy, tables=tables, axis=axis)
 
 
+def per_chip_weight_nbytes(params, tables: Sequence[str], mesh,
+                           axis: str = "model") -> int:
+    """The PER-CHIP byte footprint of ``params`` when the listed
+    tables row-shard over ``mesh``'s ``axis``: sharded 2-D table leaves
+    charge ``nbytes / ways``, everything else (replicated) charges its
+    full bytes.  This is the number the serving executor's HBM-budget
+    planner must use for a mesh-replica slot — charging a sharded
+    table's FULL bytes per chip is exactly the over-estimate that makes
+    the over-budget giant-table model look unservable."""
+    pats = table_leaf_patterns(tables)
+    total = 0
+
+    def one(path, leaf):
+        nonlocal total
+        shape = getattr(leaf, "shape", ())
+        nbytes = int(getattr(leaf, "nbytes", 0) or 0)
+        ways = 1
+        if (any(p.search(path_str(path)) for p in pats)
+                and len(shape) == 2):
+            ways = resolve_table_ways(mesh, axis, int(shape[0]))
+        total += nbytes // max(1, ways)
+        return leaf
+
+    jax.tree_util.tree_map_with_path(one, params)
+    return int(total)
+
+
 # ---------------------------------------------------------------------------
 # STREAM-cold-rows initialization: shards land on-device, no host mirror
 # ---------------------------------------------------------------------------

@@ -24,9 +24,12 @@ equivalent machinery lives here, as three reusable pieces:
 See docs/ROBUSTNESS.md for the end-to-end guarantees.
 """
 
-from analytics_zoo_tpu.robust.breaker import CircuitBreaker
-from analytics_zoo_tpu.robust.errors import (DeadlineExpired, HostLostError,
+from analytics_zoo_tpu.robust.breaker import (CircuitBreaker,
+                                              QuarantineBroadcast)
+from analytics_zoo_tpu.robust.errors import (SERVING_ERROR_CODES,
+                                             DeadlineExpired, HostLostError,
                                              MalformedRecordError,
+                                             MeshReplicaLostError,
                                              ServingError, ServingOverloaded,
                                              TrainingPreempted)
 from analytics_zoo_tpu.robust.faults import FaultInjector, fire, inject
@@ -37,8 +40,8 @@ from analytics_zoo_tpu.robust.supervisor import Heartbeat, Supervisor
 __all__ = [
     "RetryPolicy", "RetryState", "RetryDeadlineExceeded",
     "FaultInjector", "fire", "inject", "TrainingPreempted",
-    "HostLostError",
-    "CircuitBreaker", "Supervisor", "Heartbeat",
+    "HostLostError", "MeshReplicaLostError", "SERVING_ERROR_CODES",
+    "CircuitBreaker", "QuarantineBroadcast", "Supervisor", "Heartbeat",
     "ServingError", "DeadlineExpired", "ServingOverloaded",
     "MalformedRecordError",
 ]

@@ -183,3 +183,54 @@ class CircuitBreaker:
             self._state = CLOSED
             self._consecutive = 0
             self._probe_inflight = False
+
+
+class QuarantineBroadcast:
+    """Epoch-tagged atomic group quarantine for mesh replicas.
+
+    A mesh replica is ONE failure domain spread over many breakers (one
+    per mesh-replica slot, and — across hosts — one per surviving
+    process).  When a member host dies, every survivor observes the
+    loss independently (its own dispatch barrier times out), so the
+    naive reaction would trip the same breakers repeatedly and at
+    slightly different times.  The broadcast makes the reaction atomic
+    and idempotent: a loss event is tagged with the host-roster *epoch*
+    it was observed at, and ``trip(epoch, breakers)`` force-opens the
+    whole set exactly once per epoch — later observers of the same
+    epoch are no-ops, so concurrent harvest threads, supervisor ticks
+    and barrier-timeout handlers collapse into one quarantine.
+
+    Thread-safe; the epoch ledger is guarded by its own lock while the
+    breakers use theirs (``force_open``), so there is no nested-lock
+    order to get wrong.
+    """
+
+    def __init__(self, name: str = "mesh"):
+        self.name = name
+        self._lock = threading.Lock()
+        self._seen: set = set()
+        self._last_epoch = 0
+
+    @property
+    def last_epoch(self) -> int:
+        with self._lock:
+            return self._last_epoch
+
+    def tripped(self, epoch: int) -> bool:
+        with self._lock:
+            return int(epoch) in self._seen
+
+    def trip(self, epoch: int, breakers) -> bool:
+        """Force-open every breaker in ``breakers`` for loss ``epoch``.
+        Returns True when THIS call performed the trip, False when the
+        epoch was already quarantined (idempotent re-observation)."""
+        epoch = int(epoch)
+        with self._lock:
+            if epoch in self._seen:
+                return False
+            self._seen.add(epoch)
+            self._last_epoch = max(self._last_epoch, epoch)
+        for b in breakers:
+            b.force_open()
+        TIMERS.incr(f"robust/quarantine_broadcast/{self.name}")
+        return True

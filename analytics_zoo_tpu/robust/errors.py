@@ -1,6 +1,27 @@
-"""Exceptions of the resilience layer."""
+"""Exceptions of the resilience layer.
+
+``SERVING_ERROR_CODES`` is the registry of every stable ``code`` a
+typed serving error payload may carry; docs/SERVING.md "Failure
+semantics" pins the same table and ``tests/test_doc_drift.py``
+machine-checks the two against each other.
+"""
 
 from __future__ import annotations
+
+# code -> one-line meaning.  The single source of truth for the typed
+# error payload contract (``deploy.serving.error_payload`` refuses no
+# code, but every code the pipeline emits is declared here).
+SERVING_ERROR_CODES = {
+    "expired": "client TTL elapsed before the pipeline could serve it",
+    "overloaded": "shed at admission: projected wait exceeds the TTL",
+    "malformed": "record cannot be decoded/encoded for serving",
+    "decode_error": "decode stage raised while materializing tensors",
+    "model_error": "model forward failed (or no healthy replica)",
+    "host_lost": "a peer process missed a coordination barrier deadline",
+    "mesh_replica_lost": "the mesh replica lost a host; the whole "
+                         "slice quarantined atomically",
+    "internal": "unclassified server-side failure",
+}
 
 
 class ServingError(Exception):
@@ -59,11 +80,39 @@ class HostLostError(RuntimeError):
     restore reshards, see docs/ROBUSTNESS.md).
     """
 
+    code = "host_lost"
+
     def __init__(self, message: str, barrier: str = "",
                  timeout_s: float = None):
         super().__init__(message)
         self.barrier = barrier
         self.timeout_s = timeout_s
+
+
+class MeshReplicaLostError(HostLostError):
+    """A mesh replica (one mesh slice serving as a single logical
+    replica — docs/SERVING.md "Pod-scale serving") lost a member host
+    or missed a dispatch barrier deadline.
+
+    Carries the failure-domain coordinates every surviving host agrees
+    on: ``replica_id`` (which mesh-replica slot), ``lost_process_id``
+    (the presumed-dead peer, -1 when only the barrier timed out), and
+    ``epoch`` (the roster epoch the loss was observed at — the
+    quarantine broadcast trips each breaker at most once per epoch, so
+    concurrent observers of the same death collapse into ONE atomic
+    quarantine).  In-flight batches on the lost replica requeue onto
+    healthy replicas or terminate as typed payloads with this code.
+    """
+
+    code = "mesh_replica_lost"
+
+    def __init__(self, message: str, replica_id: int = -1,
+                 lost_process_id: int = -1, epoch: int = 0,
+                 barrier: str = "", timeout_s: float = None):
+        super().__init__(message, barrier=barrier, timeout_s=timeout_s)
+        self.replica_id = int(replica_id)
+        self.lost_process_id = int(lost_process_id)
+        self.epoch = int(epoch)
 
 
 class TrainingPreempted(Exception):

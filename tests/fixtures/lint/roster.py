@@ -1,0 +1,49 @@
+"""zoolint fixture: the pod host-roster idiom (core/context.HostRoster
+behind deploy/serving.PodCoordinator).  The naive port lets the
+supervisor thread mark a host lost by writing the membership set with
+no lock (THR-SHARED-MUT — the dispatch thread reads it to decide
+whether the mesh replica is healthy, so a torn read can dispatch onto
+a half-dead slice).  The shipped idiom — every membership mutation and
+read under one lock, with an epoch bump so healers can tell a fresh
+loss from the one they already quarantined — stays quiet, so the
+failure-domain bookkeeping keeps a clean lint bill by construction."""
+
+import threading
+
+
+class NaiveRoster:
+    """Unlocked cross-thread membership write."""
+
+    def __init__(self, expected):
+        self._lost = ()
+        self._expected = tuple(expected)
+        self._thread = threading.Thread(target=self._run)
+
+    def _run(self):
+        self._lost = self._lost + (1,)   # THR-SHARED-MUT fires:
+        # supervisor-thread write, read by healed() on the dispatcher
+
+    def healed(self):
+        return not self._lost
+
+
+class EpochRoster:
+    """The shipped protocol: membership and the epoch tag mutate and
+    read under one lock, so the dispatcher never sees a torn roster and
+    the healer can key its quarantine off a coherent epoch."""
+
+    def __init__(self, expected):
+        self._lock = threading.Lock()
+        self._lost = ()
+        self._epoch = 0
+        self._expected = tuple(expected)
+        self._thread = threading.Thread(target=self._run)
+
+    def _run(self):
+        with self._lock:
+            self._lost = self._lost + (1,)   # quiet: locked
+            self._epoch = self._epoch + 1
+
+    def healed(self):
+        with self._lock:
+            return not self._lost, self._epoch

@@ -72,6 +72,24 @@ cache (deploy/compile_cache.py) across REAL process boundaries:
                      test: the second process must hold
                      ``compile_count == 0`` (the warm-start proof).
 
+Pod-serving scenarios (``serve_pod*``) exercise the pod-scale serving
+fabric (docs/SERVING.md "Pod-scale serving") across REAL process
+boundaries — lead process 0 runs a ``ClusterServing`` whose mesh
+replica is gated behind the ``zoo_pod_dispatch_*`` barrier, member
+processes loop the matching barriers:
+
+- ``serve_pod``     — healthy pod: every record answered through the
+                      barrier-gated mesh dispatch, zero quarantines,
+                      clean done-file retirement (member exits 0).
+- ``serve_pod_die`` — the member hard-exits at its ``--die-step``-th
+                      barrier: the lead quarantines the whole mesh
+                      replica within the barrier deadline, requeues
+                      the in-flight batch, keeps answering on its
+                      single-chip replica (zero lost / zero errors).
+                      With ``--ckpt-dir``, a second run against the
+                      same compile-cache root must keep
+                      ``compile_count == 0``.
+
 Ring scenarios (``ring_*``) exercise sequence-parallel ring attention
 (ops/ring_attention.py) across REAL process boundaries:
 
@@ -120,6 +138,7 @@ def parse_args(argv=None) -> argparse.Namespace:
                             "data_preempt", "data_die",
                             "data_die_mid_epoch", "table_save",
                             "table_restore", "serving_warm",
+                            "serve_pod", "serve_pod_die",
                             "ring_parity"])
     p.add_argument("--ckpt-dir", default="",
                    help="checkpoint directory (enables checkpointing)")
@@ -460,6 +479,181 @@ def _run_serving_warm(args, pid: int, nproc: int) -> None:
                    "cache": cache.stats()}, f)
 
 
+def _run_serve_pod(args, pid: int, nproc: int) -> None:
+    """Pod-scale serving fabric across REAL process boundaries
+    (``serve_pod`` / ``serve_pod_die`` — docs/SERVING.md "Pod-scale
+    serving").
+
+    The lead (process 0) serves a sharded-bag model through a
+    :class:`ClusterServing` whose mesh replica spans its local devices
+    and is gated behind the pod dispatch barrier
+    (``zoo_pod_dispatch_*``); every member process loops the matching
+    barriers.  ``serve_pod`` proves barrier-gated mesh dispatch end to
+    end: every record answered, zero quarantines, and a clean
+    done-file + goodbye-barrier retirement so the member exits 0 while
+    the coordination service is still alive (a member must NEVER time
+    out a live barrier — an abandoned seq poisons it for the peers
+    that arrive later).  ``serve_pod_die`` hard-kills the member at
+    its ``--die-step``-th barrier: the lead's next mesh dispatch trips
+    the barrier deadline, the whole mesh replica quarantines
+    epoch-atomically, in-flight batches requeue onto the single-chip
+    replica, and every record is still answered (zero lost, zero
+    errors).  With ``--ckpt-dir`` the lead attaches the persistent
+    compile cache; a second run against the same cache root must keep
+    ``compile_count == 0`` (warm rebuild through the mesh-covering
+    cache digest).
+    """
+    import numpy as np
+
+    pod_name = "mpod"
+    done_file = os.path.join(os.path.dirname(args.outfile), "pod_done")
+
+    if pid != 0:
+        from analytics_zoo_tpu.core.context import dist_barrier
+        die_at = (args.die_step if args.scenario == "serve_pod_die"
+                  else -1)
+        seq = 0
+        while True:
+            seq += 1
+            if die_at >= 0 and seq > die_at:
+                _exit_hard(19)
+            try:
+                # very long deadline on purpose: the member exits via
+                # the done-file protocol (healthy) or its planned kill
+                # (chaos), never by abandoning a barrier the lead will
+                # still arrive at
+                dist_barrier(f"zoo_pod_dispatch_{pod_name}_{seq}",
+                             timeout_s=600.0, phase="dispatch")
+            except BaseException:
+                break  # coordination service gone: the lead retired
+            if os.path.exists(done_file):
+                break
+        with open(args.outfile, "w") as f:
+            json.dump({"process_id": pid, "scenario": args.scenario,
+                       "barriers": seq}, f)
+        _exit_hard(0)
+
+    import jax
+    import jax.numpy as jnp
+
+    from analytics_zoo_tpu.core.context import HostRoster
+    from analytics_zoo_tpu.deploy import CompileCache, InferenceModel
+    from analytics_zoo_tpu.deploy.serving import (ClusterServing, InputQueue,
+                                                  MemoryQueue, OutputQueue,
+                                                  PodCoordinator,
+                                                  ServingConfig)
+    from analytics_zoo_tpu.nn import Input, Model, reset_name_scope
+    from analytics_zoo_tpu.nn.layers.core import Dense
+    from analytics_zoo_tpu.nn.layers.sharded_embedding import \
+        ShardedEmbeddingTable
+
+    buckets = (1, 4)
+    reset_name_scope()
+    ids = Input(shape=(4,), dtype=jnp.int32, name="ids")
+    bag = ShardedEmbeddingTable(64, 8, combiner="mean", name="embed")(ids)
+    net = Model([ids], Dense(4, name="head")(bag), name="bagnet")
+    net._sharded_tables = ("embed",)
+    net.compile(optimizer="adam", loss="mse")
+    # a plain local jit runs the seeded initializers entirely
+    # in-process; building through the estimator would device_put onto
+    # the GLOBAL mesh — a cross-process collective the member never
+    # joins (it is looping serving barriers, not training collectives)
+    est = net.estimator
+    params, state = jax.jit(
+        lambda r: est.model.init(r, (2, 4)))(jax.random.PRNGKey(0))
+    m = InferenceModel.from_keras_net(net, params, state,
+                                      batch_buckets=buckets)
+    mesh = jax.sharding.Mesh(
+        np.asarray(jax.local_devices()[:2]).reshape(1, 2),
+        ("data", "model"))
+    cache = None
+    if args.ckpt_dir:
+        cache = CompileCache(args.ckpt_dir)
+        m.attach_compile_cache(cache)
+        m.warm()
+    # deterministic compile coverage of BOTH forward flavors before
+    # serving starts (raw replicas — no pod barrier, so the member's
+    # barrier seq stays aligned with the serving dispatches)
+    rs = np.random.RandomState(0)
+    x = rs.randint(0, 64, (32, 4)).astype(np.int32)
+    rep = m.replica_forwards(n=1)[0]
+    for b in buckets:
+        rep.harvest(rep.dispatch([x[:b]]))
+    srep = m.shard_replica(mesh)
+    for b in buckets:
+        srep.harvest(srep.dispatch([x[:b]]))
+    cold_compiles = int(m.compile_count)
+
+    roster = HostRoster(list(range(nproc)))
+    pod = PodCoordinator(roster, pid, name=pod_name,
+                         barrier_timeout_s=args.barrier_timeout)
+    q = MemoryQueue()
+    cfg = ServingConfig(batch_size=4, replicas=1, mesh_replicas=1,
+                        supervisor_interval_s=0.05,
+                        breaker_cooldown_s=0.2, mesh_shed_after_s=600.0)
+    srv = ClusterServing(m, q, cfg, mesh=mesh, roster=roster,
+                         pod=pod).start()
+    inq, outq = InputQueue(q), OutputQueue(q)
+    served = [0]
+
+    def serve(n):
+        rids = [inq.enqueue(ids=x[(served[0] + i) % 32]) for i in range(n)]
+        outs = [outq.query(r, timeout=120) for r in rids]
+        errs = [o for o in outs if isinstance(o, dict) and "error" in o]
+        served[0] += n
+        return outs, errs
+
+    outs, errs = serve(12)
+    assert len(outs) == 12 and not errs, errs[:2]
+
+    detect_s = -1.0
+    if args.scenario == "serve_pod_die":
+        # the member dies at its die_step-th barrier; keep serving
+        # until a mesh dispatch trips the deadline and the replica
+        # quarantines — every record must still come back answered
+        t0 = time.monotonic()
+        deadline = t0 + args.barrier_timeout + 60.0
+        while time.monotonic() < deadline:
+            o, e = serve(2)
+            assert not e, e[:2]
+            h = srv.health().get("mesh") or {}
+            if int(h.get("quarantine_epoch", 0)) >= 1:
+                detect_s = time.monotonic() - t0
+                break
+        assert detect_s >= 0.0, "mesh replica never quarantined"
+        # degrade path: the single-chip replica answers everything
+        o, e = serve(8)
+        assert len(o) == 8 and not e, e[:2]
+
+    h = srv.health()
+    mesh_h = h.get("mesh") or {}
+    qepoch = int(mesh_h.get("quarantine_epoch", 0))
+    if args.scenario == "serve_pod":
+        assert qepoch == 0, mesh_h
+        srv.stop()
+        # retire the member cleanly: done file first, then one goodbye
+        # barrier round it is already waiting at
+        with open(done_file, "w") as f:
+            f.write("done")
+        pod.dispatch_barrier()
+    else:
+        assert qepoch >= 1, mesh_h
+        srv.stop()
+
+    with open(args.outfile, "w") as f:
+        json.dump({"process_id": pid, "scenario": args.scenario,
+                   "served": served[0], "errors": 0,
+                   "quarantine_epoch": qepoch,
+                   "detect_s": detect_s,
+                   "barrier_timeout_s": args.barrier_timeout,
+                   "roster_lost": list(roster.lost()),
+                   "cold_compiles": cold_compiles,
+                   "compile_count": int(m.compile_count),
+                   "warm_count": int(m.warm_count),
+                   "cache": cache.stats() if cache else None}, f)
+    _exit_hard(0)
+
+
 def _run_ring(args, pid: int, nproc: int) -> None:
     """Sequence-parallel ring attention across REAL process boundaries
     (``ring_parity``).
@@ -567,6 +761,10 @@ def main() -> None:
 
     if args.scenario.startswith("serving_"):
         _run_serving_warm(args, pid, nproc)
+        return
+
+    if args.scenario.startswith("serve_pod"):
+        _run_serve_pod(args, pid, nproc)
         return
 
     if args.scenario.startswith("ring_"):

@@ -173,6 +173,55 @@ class TestObservabilityDocDrift:
                          + "\n  ".join(bad))
 
 
+SERVING_DOC = REPO / "docs" / "SERVING.md"
+
+_ERROR_CODE_TABLE_RE = re.compile(
+    r"<!--\s*ERROR_CODE_TABLE:BEGIN\s*-->(.*?)<!--\s*ERROR_CODE_TABLE:END\s*-->",
+    re.S)
+
+
+def _pinned_error_codes():
+    m = _ERROR_CODE_TABLE_RE.search(SERVING_DOC.read_text())
+    assert m, "SERVING.md lost its ERROR_CODE_TABLE markers"
+    codes = {}
+    for line in m.group(1).splitlines():
+        cells = [c.strip() for c in line.strip().strip("|").split("|")]
+        if len(cells) != 3 or cells[0] in ("code", "") or "---" in cells[0]:
+            continue
+        codes[cells[0].strip("`")] = cells[2]
+    assert codes, "pinned error-code table is empty"
+    return codes
+
+
+class TestServingErrorCodeDocDrift:
+    """docs/SERVING.md "Failure semantics" code table ==
+    robust.errors.SERVING_ERROR_CODES: every stable code a typed
+    serving error payload may carry is pinned in the doc of record,
+    and the doc pins nothing the registry doesn't declare."""
+
+    def test_pinned_codes_match_registry(self):
+        from analytics_zoo_tpu.robust.errors import SERVING_ERROR_CODES
+        pinned = _pinned_error_codes()
+        missing = sorted(set(SERVING_ERROR_CODES) - set(pinned))
+        stale = sorted(set(pinned) - set(SERVING_ERROR_CODES))
+        assert not missing, \
+            f"registry codes missing from SERVING.md: {missing}"
+        assert not stale, \
+            f"SERVING.md pins codes not in SERVING_ERROR_CODES: {stale}"
+
+    def test_every_registry_code_is_a_declared_class_attr(self):
+        """The registry is live, not aspirational: each code is the
+        ``code`` of a typed exception (or the base class default)."""
+        from analytics_zoo_tpu.robust import errors as E
+        declared = {getattr(cls, "code")
+                    for cls in vars(E).values()
+                    if isinstance(cls, type) and hasattr(cls, "code")}
+        # decode_error / model_error are emitted via
+        # ServingError(code=...) at their stages, not dedicated classes
+        assert (set(E.SERVING_ERROR_CODES) - declared
+                == {"decode_error", "model_error"})
+
+
 LOADGEN_DOC = REPO / "docs" / "LOADGEN.md"
 
 _SLO_TABLE_RE = re.compile(

@@ -1,0 +1,86 @@
+"""Multi-process pod-serving chaos: mesh-replica failure domains over
+REAL OS processes (docs/SERVING.md "Pod-scale serving").
+
+Two-process pods — lead (process 0) serves a sharded-bag model whose
+mesh replica is gated behind the ``zoo_pod_dispatch_*`` barrier; the
+member process loops the matching barriers (tests/multiprocess_worker.py
+``serve_pod`` / ``serve_pod_die``).  Asserts the PR's acceptance
+criteria without the loadgen storm:
+
+- healthy pod: every record answered through barrier-gated mesh
+  dispatch, zero quarantines, member retires cleanly (exit 0) via the
+  done-file protocol — a member must never time out a live barrier;
+- member host death (hard ``os._exit(19)`` at a planned barrier): the
+  lead quarantines the WHOLE mesh replica within the barrier deadline,
+  the in-flight batch requeues onto the single-chip replica, and every
+  record is still answered — zero lost, zero errors;
+- warm rebuild: a second chaos pod against the same persistent
+  compile-cache root serves with ``compile_count == 0`` (the cache
+  digest covers the mesh, so mesh-flavor executables warm-start too).
+
+The full SIGKILL-mid-storm soak (recovery-to-SLO pinned in the SLO
+artifact) lives in the loadgen harness (``run_pod_kill_leg``); these
+are the CI-shaped versions with deterministic record counts.
+"""
+
+import pytest
+
+from tests.mp_harness import run_workers
+
+BARRIER_TIMEOUT = 3.0
+
+
+@pytest.mark.slow
+def test_pod_serving_healthy(tmp_path):
+    """2-process pod, no faults: barrier-gated mesh dispatch answers
+    everything, nothing quarantines, both processes exit 0."""
+    res = run_workers(2, tmp_path, "pod_ok", scenario="serve_pod",
+                      barrier_timeout=BARRIER_TIMEOUT)
+    lead, member = res
+    assert lead["served"] == 12
+    assert lead["errors"] == 0
+    assert lead["quarantine_epoch"] == 0
+    assert lead["roster_lost"] == []
+    # the member passed at least one serving dispatch barrier plus the
+    # goodbye round
+    assert member["barriers"] >= 2
+
+
+@pytest.mark.slow
+def test_pod_member_death_quarantines_and_degrades(tmp_path):
+    """Member dies at its 2nd barrier → the lead's next mesh dispatch
+    trips the deadline, the whole replica quarantines (epoch 1+), and
+    every record is still answered on the single-chip replica."""
+    res = run_workers(2, tmp_path, "pod_die", scenario="serve_pod_die",
+                      die_step=2, barrier_timeout=BARRIER_TIMEOUT,
+                      expect_rc={1: 19})
+    lead = res[0]
+    assert res[1] is None  # died before writing an outfile — by design
+    assert lead["errors"] == 0
+    assert lead["quarantine_epoch"] >= 1
+    assert lead["roster_lost"] == [1]
+    # detection is bounded by the dispatch-barrier deadline (plus the
+    # serving cadence around it), never the ~100 s heartbeat detector
+    assert 0.0 <= lead["detect_s"] <= BARRIER_TIMEOUT + 30.0
+    assert lead["served"] >= 22  # 12 pre-kill + detection + 8 degrade
+
+
+@pytest.mark.slow
+def test_pod_rebuild_warm_starts_from_compile_cache(tmp_path):
+    """Chaos pod twice against one compile-cache root: the second pod
+    is a rebuilt-replica stand-in and must serve with ZERO live
+    compiles — the cache digest covers the mesh, so both forward
+    flavors (single-chip and mesh-sharded) warm-start."""
+    cache = tmp_path / "aot_cache"
+    cold = run_workers(2, tmp_path, "pod_cold", scenario="serve_pod_die",
+                       die_step=2, barrier_timeout=BARRIER_TIMEOUT,
+                       ckpt_dir=cache, expect_rc={1: 19})[0]
+    assert cold["quarantine_epoch"] >= 1
+    assert cold["compile_count"] == cold["cold_compiles"] > 0
+
+    warm = run_workers(2, tmp_path, "pod_warm", scenario="serve_pod_die",
+                       die_step=2, barrier_timeout=BARRIER_TIMEOUT,
+                       ckpt_dir=cache, expect_rc={1: 19})[0]
+    assert warm["quarantine_epoch"] >= 1
+    assert warm["errors"] == 0
+    assert warm["compile_count"] == 0, warm

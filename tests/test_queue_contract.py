@@ -275,6 +275,42 @@ class TestShmSpecific:
         finally:
             q.stop()
 
+    def test_dead_lease_holder_result_slot_reclaimed(self):
+        """Kill-the-lease-holder regression (docs/SERVING.md "Pod-scale
+        serving"): a result slot leased to a process that died before
+        calling ``get_result`` would stay READY forever — the
+        supervisor-tick harvest (``reclaim_dead_result_leases``)
+        returns it to the arena, counted, without touching leases whose
+        owners are alive."""
+        q = self._q(slots=4)
+        try:
+            pid = os.fork()
+            if pid == 0:
+                # child: push one record, then die hard without ever
+                # reading its result — the lost client
+                q.push({"uri": "dead1", "x": np.ones((2, 2), np.float32)})
+                os._exit(0)
+            os.waitpid(pid, 0)
+            got = q.pop_batch(4, timeout=1.0)
+            assert [rid for rid, _ in got] == ["dead1"]
+            q.set_result_many([("dead1", {"ok": True})])
+            # a live-owner result next to it must NOT be reclaimed
+            q.push({"uri": "live1", "x": np.zeros((1,), np.float32)})
+            [(rid, rec)] = q.pop_batch(4, timeout=1.0)
+            del rec
+            gc.collect()
+            q.set_result("live1", {"ok": True})
+            assert sorted(q.pending_results()) == ["dead1", "live1"]
+
+            assert q.reclaim_dead_result_leases() == 1
+            assert q.lease_reclaims == 1
+            assert q.pending_results() == ["live1"]
+            # second tick: idempotent
+            assert q.reclaim_dead_result_leases() == 0
+            assert q.get_result("live1", timeout=2.0)["ok"] is True
+        finally:
+            q.stop()
+
     def test_unlink_on_stop_leaves_no_segment(self):
         from analytics_zoo_tpu.deploy.shmqueue import live_segments
 

@@ -1,0 +1,363 @@
+"""Pod-scale serving fabric, fast and in one process (docs/SERVING.md
+"Pod-scale serving").
+
+Mesh-replica failure domains without real hosts: fabricated rosters
+(injectable clocks), fault-injected barrier timeouts, and an in-process
+``ClusterServing`` whose mesh replica spans a 2-device model-axis
+slice of the virtual CPU topology.  Covers
+
+- ``HostRoster`` semantics: epoch-tagged membership, idempotent repeat
+  loss, heal detection, loss age under a fake clock;
+- ``PodCoordinator``: the ``serving.host_lost`` fault site converts a
+  barrier deadline into an epoch-tagged ``MeshReplicaLostError`` and
+  fans the loss out to the registered peer-loss hooks;
+- the serving lifecycle: per-chip-byte budget planning (an
+  over-per-chip-budget sharded-table model still serves through its
+  mesh replica), transfer-guarded parity of the mesh-sharded forward
+  against the replicated single-device forward, atomic epoch-keyed
+  quarantine (idempotent re-observation), the all-quarantined degrade
+  path (zero lost), warm rebuild on roster heal, and the
+  ``mesh_shed_after_s`` shed that re-plans the freed budget.
+
+The same contracts over REAL processes live in
+tests/test_multiprocess_pod.py; the SIGKILL-mid-storm soak with pinned
+recovery-to-SLO lives in the loadgen harness (``run_pod_kill_leg``).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.core.context import (HostRoster, on_peer_loss,
+                                            remove_peer_loss_hook)
+from analytics_zoo_tpu.deploy import InferenceModel
+from analytics_zoo_tpu.deploy.serving import (ClusterServing, InputQueue,
+                                              MemoryQueue, OutputQueue,
+                                              PodCoordinator, ServingConfig)
+from analytics_zoo_tpu.robust import FaultInjector
+from analytics_zoo_tpu.robust.errors import (HostLostError,
+                                             MeshReplicaLostError)
+
+
+@pytest.fixture(autouse=True)
+def fresh_names():
+    from analytics_zoo_tpu.nn import reset_name_scope
+
+    reset_name_scope()
+
+
+@pytest.fixture
+def tp_ctx():
+    """4×2 data×model mesh (the full virtual topology); the sharded
+    table splits over the 2-way model axis.  Restores the default
+    context afterwards."""
+    from analytics_zoo_tpu import init_zoo_context
+
+    ctx = init_zoo_context(mesh_shape=(4, 2),
+                           axis_names=("data", "model"))
+    yield ctx
+    init_zoo_context()
+
+
+VOCAB, DIM, IN = 64, 8, 4
+
+
+def _bag_model(buckets=(1, 4)):
+    """Sharded-bag model: the embedding table splits over the model
+    axis, so one mesh slice serves as one logical replica."""
+    import jax
+    import jax.numpy as jnp
+
+    from analytics_zoo_tpu.nn import Input, Model
+    from analytics_zoo_tpu.nn.layers.core import Dense
+    from analytics_zoo_tpu.nn.layers.sharded_embedding import \
+        ShardedEmbeddingTable
+
+    ids = Input(shape=(IN,), dtype=jnp.int32, name="ids")
+    bag = ShardedEmbeddingTable(VOCAB, DIM, combiner="mean",
+                                name="embed")(ids)
+    net = Model([ids], Dense(4, name="head")(bag), name="bagnet")
+    net._sharded_tables = ("embed",)
+    net.compile(optimizer="adam", loss="mse")
+    est = net.estimator
+    params, state = jax.jit(
+        lambda r: est.model.init(r, (2, IN)))(jax.random.PRNGKey(0))
+    return InferenceModel.from_keras_net(net, params, state,
+                                         batch_buckets=buckets)
+
+
+def _ids(n, seed=0):
+    return np.random.RandomState(seed).randint(
+        0, VOCAB, (n, IN)).astype(np.int32)
+
+
+def _serve(inq, outq, x, timeout=60.0):
+    rids = [inq.enqueue(ids=x[i]) for i in range(len(x))]
+    outs = [outq.query(r, timeout=timeout) for r in rids]
+    errs = [o for o in outs if isinstance(o, dict) and "error" in o]
+    return outs, errs
+
+
+# ---------------------------------------------------------------------------
+# HostRoster
+# ---------------------------------------------------------------------------
+
+
+class TestHostRoster:
+    def test_epoch_tagged_membership(self):
+        r = HostRoster([0, 1, 2])
+        assert r.epoch == 0 and r.healed()
+        assert r.mark_lost(1) == 1
+        assert r.lost() == (1,) and not r.healed()
+        # the same death observed twice is ONE event: no epoch churn
+        assert r.mark_lost(1) == 1
+        assert r.mark_lost(2) == 2
+        assert r.lost() == (1, 2)
+        assert r.mark_alive(1) == 3
+        assert not r.healed()
+        assert r.mark_alive(2) == 4
+        assert r.healed() and r.lost() == ()
+
+    def test_unknown_member_never_joins(self):
+        r = HostRoster([0, 1])
+        assert r.mark_alive(7) == 0     # not in expected: no-op
+        assert r.alive() == (0, 1)
+
+    def test_loss_age_under_fake_clock(self):
+        now = [100.0]
+        r = HostRoster([0, 1], clock=lambda: now[0])
+        assert r.lost_age_s() == 0.0
+        r.mark_lost(1)
+        now[0] = 130.0
+        assert r.lost_age_s() == pytest.approx(30.0)
+        r.mark_alive(1)
+        assert r.lost_age_s() == 0.0
+
+
+# ---------------------------------------------------------------------------
+# PodCoordinator
+# ---------------------------------------------------------------------------
+
+
+class TestPodCoordinator:
+    def test_barrier_fault_becomes_typed_mesh_loss(self):
+        """The ``serving.host_lost`` fault site drives the full
+        loss path without a real multi-host pod: barrier deadline →
+        roster marked → epoch-tagged ``MeshReplicaLostError``."""
+        roster = HostRoster([0, 1])
+        pod = PodCoordinator(roster, 0, name="t", barrier_timeout_s=0.1)
+        with FaultInjector().plan(
+                "serving.host_lost", at=0,
+                exc=HostLostError("injected kill", barrier="b1",
+                                  timeout_s=0.1)):
+            with pytest.raises(MeshReplicaLostError) as ei:
+                pod.dispatch_barrier()
+        err = ei.value
+        assert err.code == "mesh_replica_lost"
+        assert err.epoch == 1
+        assert roster.lost() == (1,)
+        assert isinstance(err, HostLostError)  # one except-clause catches both
+
+    def test_host_lost_fans_out_peer_loss_hooks(self):
+        """One barrier deadline notifies every registered hook — the
+        cross-host quarantine entry point for every OTHER model."""
+        roster = HostRoster([0, 1, 2])
+        pod = PodCoordinator(roster, 0, name="t")
+        seen = []
+        on_peer_loss(seen.append)
+        try:
+            err = pod.host_lost(2)
+            assert err.lost_process_id == 2 and err.epoch == 1
+            assert seen == [2]
+            # an unnamed loss (pure barrier timeout) marks every peer
+            err = pod.host_lost()
+            assert roster.lost() == (1, 2)
+            assert set(seen) == {1, 2}
+        finally:
+            remove_peer_loss_hook(seen.append)
+
+    def test_hook_errors_never_mask_the_loss(self):
+        roster = HostRoster([0, 1])
+        pod = PodCoordinator(roster, 0, name="t")
+
+        def bad(_pid):
+            raise RuntimeError("hook exploded")
+
+        on_peer_loss(bad)
+        try:
+            err = pod.host_lost(1)
+            assert err.epoch == 1 and roster.lost() == (1,)
+        finally:
+            remove_peer_loss_hook(bad)
+
+
+# ---------------------------------------------------------------------------
+# mesh-replica serving lifecycle
+# ---------------------------------------------------------------------------
+
+
+def _cfg(**kw):
+    base = dict(batch_size=4, replicas=1, mesh_replicas=1,
+                supervisor_interval_s=0.05, breaker_cooldown_s=0.2,
+                mesh_shed_after_s=600.0)
+    base.update(kw)
+    return ServingConfig(**base)
+
+
+class TestMeshReplicaServing:
+    def test_sharded_forward_parity_vs_replicated(self, tp_ctx):
+        """The mesh-sharded forward must match the replicated
+        single-device forward bit-near-exactly, and the HOT dispatch
+        path must make every host transfer explicit (model build /
+        compile warmup happen before the guard closes)."""
+        import jax
+
+        m = _bag_model()
+        x = _ids(4)
+        rep = m.replica_forwards(n=1)[0]
+        srep = m.shard_replica(tp_ctx.mesh)
+        # warmup: compiles and first input upload
+        rep.harvest(rep.dispatch([x]))
+        srep.harvest(srep.dispatch([x]))
+        with jax.transfer_guard("disallow"):
+            ref = rep.harvest(rep.dispatch([x]))
+            got = srep.harvest(srep.dispatch([x]))
+        np.testing.assert_allclose(np.asarray(got[0]), np.asarray(ref[0]),
+                                   rtol=1e-6, atol=1e-6)
+
+    def test_over_chip_budget_model_serves_through_mesh(self, tp_ctx):
+        """Budget planning charges a mesh replica its PER-CHIP shard
+        bytes: with a budget between per-chip and full weight bytes the
+        plan keeps the mesh replica (the sharded table spreads its
+        rows) alongside the mandatory single-chip copy."""
+        m = _bag_model()
+        full = m.weight_nbytes()
+        chip = m.weight_nbytes_per_chip(tp_ctx.mesh)
+        assert chip < full  # the table really shards
+        srv = ClusterServing(
+            m, MemoryQueue(),
+            _cfg(hbm_budget_bytes=int(full + chip + 1)),
+            mesh=tp_ctx.mesh).start()
+        try:
+            h = srv.health()
+            assert h["mesh"]["plan"] == {"default": 1}
+            assert srv._executor.healthy_mesh_replicas() == 1
+            outs, errs = _serve(InputQueue(srv.queue),
+                                OutputQueue(srv.queue), _ids(8))
+            assert len(outs) == 8 and not errs, errs[:2]
+        finally:
+            srv.stop()
+
+    def test_budget_too_tight_sheds_mesh_plan_to_zero(self, tp_ctx):
+        """Mesh capacity is optional: when even the per-chip bytes
+        don't fit on top of the single-chip plan, the mesh plan drops
+        to 0 instead of overcommitting HBM."""
+        m = _bag_model()
+        srv = ClusterServing(
+            m, MemoryQueue(),
+            _cfg(hbm_budget_bytes=int(m.weight_nbytes() + 1)),
+            mesh=tp_ctx.mesh).start()
+        try:
+            assert srv.health()["mesh"]["plan"] == {"default": 0}
+            outs, errs = _serve(InputQueue(srv.queue),
+                                OutputQueue(srv.queue), _ids(4))
+            assert len(outs) == 4 and not errs
+        finally:
+            srv.stop()
+
+    def test_quarantine_degrade_heal_cycle(self, tp_ctx):
+        """The whole lifecycle in one pod: epoch-atomic quarantine on a
+        host loss (idempotent re-observation), degrade onto the
+        single-chip replica with zero lost records, then a roster heal
+        rebuilds the mesh replica and it serves again."""
+        m = _bag_model()
+        roster = HostRoster([0, 1])
+        srv = ClusterServing(m, MemoryQueue(), _cfg(),
+                             mesh=tp_ctx.mesh, roster=roster).start()
+        inq, outq = InputQueue(srv.queue), OutputQueue(srv.queue)
+        try:
+            outs, errs = _serve(inq, outq, _ids(8))
+            assert len(outs) == 8 and not errs, errs[:2]
+            assert srv._executor.healthy_mesh_replicas() == 1
+
+            epoch = srv.notify_host_lost(1)
+            assert epoch == 1
+            assert srv._executor.healthy_mesh_replicas() == 0
+            # idempotent: the same epoch observed again trips nothing
+            assert not srv._executor.quarantine_mesh_replica(epoch)
+            # a peer's concurrent observation of the same death is the
+            # same epoch — still one quarantine
+            assert srv.notify_host_lost(1) == epoch
+
+            # degrade path: the single-chip replica answers everything
+            outs, errs = _serve(inq, outq, _ids(8, seed=1))
+            assert len(outs) == 8 and not errs, errs[:2]
+
+            # heal: the supervisor rebuilds once the roster is whole
+            roster.mark_alive(1)
+            deadline = time.monotonic() + 10.0
+            while (srv._executor.healthy_mesh_replicas() == 0
+                   and time.monotonic() < deadline):
+                time.sleep(0.05)
+            assert srv._executor.healthy_mesh_replicas() == 1
+            outs, errs = _serve(inq, outq, _ids(4, seed=2))
+            assert len(outs) == 4 and not errs
+            # rebuild went through the in-memory executables / compile
+            # cache: no new live compiles for the same buckets
+            assert srv.health()["mesh"]["quarantine_epoch"] == epoch
+        finally:
+            srv.stop()
+
+    def test_broken_roster_sheds_after_deadline_and_replans(self, tp_ctx):
+        """A roster broken past ``mesh_shed_after_s`` sheds the mesh
+        replica (freeing its per-chip budget) instead of waiting
+        forever; the pod keeps serving single-chip."""
+        m = _bag_model()
+        now = [0.0]
+        roster = HostRoster([0, 1], clock=lambda: now[0])
+        srv = ClusterServing(m, MemoryQueue(),
+                             _cfg(mesh_shed_after_s=5.0),
+                             mesh=tp_ctx.mesh, roster=roster).start()
+        try:
+            assert srv._executor.mesh_group_size() == 1
+            srv.notify_host_lost(1)
+            now[0] = 6.0    # loss age > mesh_shed_after_s
+            deadline = time.monotonic() + 10.0
+            while (srv._executor.mesh_group_size() > 0
+                   and time.monotonic() < deadline):
+                time.sleep(0.05)
+            assert srv._executor.mesh_group_size() == 0
+            assert srv.health()["mesh"]["plan"] == {"default": 0}
+            outs, errs = _serve(InputQueue(srv.queue),
+                                OutputQueue(srv.queue), _ids(4))
+            assert len(outs) == 4 and not errs
+        finally:
+            srv.stop()
+
+    def test_pod_barrier_timeout_quarantines_during_serving(self, tp_ctx):
+        """End to end through the serving pipeline: a fault-injected
+        barrier deadline on a mesh dispatch quarantines the replica and
+        the in-flight batch requeues — the client still gets every
+        answer (zero lost, zero errors)."""
+        m = _bag_model()
+        roster = HostRoster([0, 1])
+        pod = PodCoordinator(roster, 0, name="fastpod",
+                             barrier_timeout_s=0.2)
+        srv = ClusterServing(m, MemoryQueue(), _cfg(),
+                             mesh=tp_ctx.mesh, roster=roster,
+                             pod=pod).start()
+        try:
+            with FaultInjector().plan(
+                    "serving.host_lost", at=1,
+                    exc=HostLostError("injected pod kill",
+                                      barrier="zoo_pod_dispatch_fastpod_2",
+                                      timeout_s=0.2)) as fi:
+                outs, errs = _serve(InputQueue(srv.queue),
+                                    OutputQueue(srv.queue), _ids(16))
+                assert len(outs) == 16 and not errs, errs[:2]
+                assert fi.fired.get("serving.host_lost") == 1
+            assert srv.health()["mesh"]["quarantine_epoch"] >= 1
+            assert roster.lost() == (1,)
+        finally:
+            srv.stop()

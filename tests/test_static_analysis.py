@@ -201,6 +201,21 @@ def test_mesh_data_cursor_fixture():
     assert len(fs) == 1
 
 
+def test_roster_fixture():
+    """The pod host-roster idiom (core/context.HostRoster behind the
+    PodCoordinator): a supervisor thread marking a host lost with no
+    lock fires THR-SHARED-MUT — a torn read could dispatch onto a
+    half-dead mesh replica; the shipped
+    mutate-and-read-under-one-lock-with-an-epoch-tag protocol stays
+    quiet, so the failure-domain bookkeeping keeps a clean lint bill by
+    construction."""
+    fs = fixture_findings("roster.py")
+    assert scopes_of(fs, "THR-SHARED-MUT") == {"NaiveRoster._run"}
+    quiet = {"EpochRoster._run", "EpochRoster.healed"}
+    assert not quiet & {f.scope for f in fs}
+    assert len(fs) == 1
+
+
 def test_observe_instrumentation_fixture():
     """Span/metric instrumentation idioms: the naive retrofit fires
     (unlocked ring read, per-step host sync for a metric sample); the
