@@ -85,6 +85,25 @@ class ZooConfig:
     # (Pallas on TPU above its win threshold), "on" insists on the
     # kernel wherever shapes allow, "off" pins the XLA gather path.
     fused_embedding: str = "auto"
+    # Within-batch duplicate-id dedup for embedding lookups
+    # (ops/embedding_bag.py embedding_bag_dedup): "auto" dedups the
+    # sharded-table lookup path only (where duplicate rows pay full HBM
+    # + exchange price), "on" dedups every bag lookup, "off" pins the
+    # naive per-slot gather.  Exact-parity custom_vjp either way.
+    dedup_ids: str = "auto"
+    # Hot-row replication cache for SERVING lookups against row-sharded
+    # tables (parallel/hot_cache.py): "auto"/"on" lets deploy serving
+    # build a per-table top-K replica cache so hot ids resolve from a
+    # chip-local copy and skip the psum exchange; "off" disables cache
+    # construction entirely.  Training never reads the cache (optimizer
+    # writes stay authoritative).
+    table_hot_cache: str = "auto"
+    # Rows held per hot cache (top-K by observed lookup frequency).
+    table_hot_cache_capacity: int = 1024
+    # Seconds between cache refreshes from the authoritative shards; a
+    # refresh re-ranks the top-K from the live frequency counts and
+    # re-reads the row values, bounding staleness to one period.
+    table_hot_cache_refresh_s: float = 30.0
     # Ring-attention routing (ops/ring_attention.py) for sequence-
     # parallel long context: "auto" rings only on a mesh with a >1-way
     # seq axis above RING_MIN_LEN tokens, "on" insists wherever a mesh

@@ -17,6 +17,10 @@ from analytics_zoo_tpu.data.preprocessing import (  # noqa: F401
     Preprocessing,
     SeqToTensor,
 )
+from analytics_zoo_tpu.data.zipf import (  # noqa: F401
+    zipf_weights,
+    zipfian_ids,
+)
 from analytics_zoo_tpu.data.text import (  # noqa: F401
     TextFeature,
     TextSet,

@@ -731,6 +731,105 @@ class InferenceModel:
             getattr(self, "_state", None) or {}, tables, mesh, axis=axis)
         return total
 
+    # -- hot-row replication caches (ISSUE 19) -----------------------------
+    def _table_leaf(self, tname: str):
+        """The authoritative ``<tname>/table`` param leaf, or None."""
+        from analytics_zoo_tpu.parallel.sharding import path_str
+        from analytics_zoo_tpu.parallel.table_sharding import \
+            table_leaf_patterns
+
+        pats = table_leaf_patterns((tname,))
+        found = [None]
+
+        def one(path, leaf):
+            if any(p.search(path_str(path)) for p in pats):
+                found[0] = leaf
+            return leaf
+
+        jax.tree_util.tree_map_with_path(
+            one, getattr(self, "_params", None) or {})
+        return found[0]
+
+    def enable_hot_caches(self, mesh=None, *, axis: str = "model",
+                          capacity: Optional[int] = None,
+                          refresh_period_s: Optional[float] = None,
+                          clock=time.monotonic) -> Dict[str, Any]:
+        """Build one :class:`~analytics_zoo_tpu.parallel.hot_cache.
+        HotRowCache` per entry of the net's ``_sharded_tables`` manifest
+        (the ``table_hot_cache`` knob gates this: ``"off"`` builds
+        none).  The caches are SERVING-side and read-only: frequency
+        fills from the dispatch id streams (``record_hot_ids``), values
+        come only from ``refresh_hot_caches`` re-reading the
+        authoritative params, and ``invalidate_hot_caches`` runs on
+        every ``swap_replicas`` / hot reload.  ``clock`` is injectable
+        for the staleness tests."""
+        from analytics_zoo_tpu.ops.dispatch import config_knob
+        from analytics_zoo_tpu.parallel.hot_cache import HotRowCache
+
+        if config_knob("table_hot_cache", "auto") == "off":
+            self._hot_caches: Dict[str, Any] = {}
+            return {}
+        if capacity is None:
+            capacity = int(config_knob("table_hot_cache_capacity", 1024))
+        if refresh_period_s is None:
+            refresh_period_s = float(
+                config_knob("table_hot_cache_refresh_s", 30.0))
+        caches: Dict[str, Any] = {}
+        for tname in self.sharded_tables():
+            leaf = self._table_leaf(tname)
+            if leaf is None or len(getattr(leaf, "shape", ())) != 2:
+                continue
+            caches[tname] = HotRowCache(
+                f"{self.name}/{tname}", capacity,
+                dim=int(leaf.shape[1]),
+                refresh_period_s=refresh_period_s, clock=clock,
+                mesh=mesh,
+                dtype=np.dtype(str(getattr(leaf, "dtype", "float32"))))
+        self._hot_caches = caches
+        return dict(caches)
+
+    def hot_caches(self) -> Dict[str, Any]:
+        return dict(getattr(self, "_hot_caches", None) or {})
+
+    def record_hot_ids(self, xs) -> None:
+        """Fold a dispatch batch's integer arrays (the id streams the
+        batcher fused) into every table cache's frequency counts."""
+        caches = getattr(self, "_hot_caches", None)
+        if not caches:
+            return
+        for x in xs:
+            a = np.asarray(x)
+            if a.dtype.kind not in "iu":
+                continue
+            for c in caches.values():
+                c.record(a)
+
+    def refresh_hot_caches(self, force: bool = False) -> int:
+        """Re-rank + re-read every cache from the authoritative table
+        leaves; ``force`` skips the period check (used right after a
+        weight swap).  Returns the number of caches refreshed."""
+        from analytics_zoo_tpu.parallel.hot_cache import table_row_reader
+
+        done = 0
+        for tname, cache in self.hot_caches().items():
+            leaf = self._table_leaf(tname)
+            if leaf is None:
+                continue
+            reader = table_row_reader(leaf)
+            if force:
+                cache.refresh(reader)
+                done += 1
+            elif cache.maybe_refresh(reader):
+                done += 1
+        return done
+
+    def invalidate_hot_caches(self, reason: str = "swap") -> None:
+        """Drop every cache's replica rows (all ids miss until the next
+        refresh) — the weight-swap safety hook: a hot-reloaded model
+        must never serve pre-swap rows."""
+        for cache in self.hot_caches().values():
+            cache.invalidate(reason)
+
     def shard_replica(self, mesh, top_n: Optional[int] = None,
                       axis: str = "model") -> "ModelReplica":
         """One serving replica spanning a whole ``Mesh`` with the net's
@@ -784,6 +883,9 @@ class InferenceModel:
 
         def dispatch(xs):
             self._note_shapes(xs, tag=desc)
+            # hot-row cache frequency tap: the fused id streams passing
+            # through here ARE the batcher's traffic (host numpy still)
+            self.record_hot_ids(xs)
             xd = [jax.device_put(jnp.asarray(x), rep) for x in xs]
             if self._cache is not None:
                 prog = self._aot_program(p_i, s_i, xd, device=desc,

@@ -1074,6 +1074,9 @@ class DeviceExecutor:
         self.breaker_cooldown_s = float(breaker_cooldown_s)
         self.max_retries = max(0, int(max_retries))
         self._heartbeat: Optional[Callable[[], None]] = None
+        # swap listeners: fn(model_name) called on every swap_replicas —
+        # how the hot-row caches (ISSUE 19) learn the weights changed
+        self._swap_listeners: List[Callable[[str], None]] = []
         self._inbox: "pyqueue.Queue" = pyqueue.Queue(
             maxsize=max(2, self.max_inflight * 4))
         self._pending: "pyqueue.Queue" = pyqueue.Queue(
@@ -1198,6 +1201,22 @@ class DeviceExecutor:
                 self._swap = swap
             else:
                 self._swap.update(swap)
+            listeners = list(self._swap_listeners)
+        # weight-swap hooks outside the lock: hot-row caches invalidate
+        # here so a swapped model can never serve pre-swap rows
+        for fn in listeners:
+            for mname in swap:
+                try:
+                    fn(mname)
+                except Exception:
+                    logging.getLogger("analytics_zoo_tpu.deploy") \
+                        .exception("swap listener failed for %r", mname)
+
+    def add_swap_listener(self, fn: Callable[[str], None]) -> None:
+        """Register ``fn(model_name)`` to run on every
+        :meth:`swap_replicas` (hot reload / resize / rebuild)."""
+        with self._lock:
+            self._swap_listeners.append(fn)
 
     def stop(self, timeout: float = 5.0) -> None:
         self._stop.set()
@@ -2188,6 +2207,14 @@ class ClusterServing:
             breaker_cooldown_s=self.cfg.breaker_cooldown_s,
             fallback=fb_map, mesh_replicas=mesh_map or None)
         self._executor._heartbeat = lambda: self._hb.beat("device")
+        # hot-row replication caches (ISSUE 19): models serving sharded
+        # tables through mesh replicas get a per-table top-K cache; a
+        # replica swap (hot reload / resize / rebuild) invalidates it
+        for mname in mesh_map:
+            m = self.models[mname]
+            if getattr(m, "sharded_tables", lambda: ())():
+                m.enable_hot_caches(self._mesh, axis=self.cfg.mesh_axis)
+        self._executor.add_swap_listener(self._on_replica_swap)
         self._batcher = DynamicBatcher(
             max_batch=self.cfg.batch_size,
             max_latency_ms=self.cfg.max_batch_delay_ms,
@@ -2233,6 +2260,11 @@ class ClusterServing:
             sup.add_check("shm_lease_reclaim", reclaim)
         sup.add_check("stages", self._check_stages)
         sup.add_check("gauges", self._publish_gauges)
+        # hot-row cache upkeep rides the supervisor cadence: each tick
+        # asks every model's caches to refresh iff their period elapsed
+        # (or they were invalidated by a swap) — staleness stays bounded
+        # by table_hot_cache_refresh_s without a dedicated thread
+        sup.add_check("hot_cache_refresh", self._refresh_hot_caches)
         # the flight recorder rides the supervisor cadence: e2e-p99
         # SLOs (per model — e2e series carry a {model} label) plus
         # breaker trips always
@@ -3032,6 +3064,30 @@ class ClusterServing:
         scrape endpoint payload (``parse_prometheus`` round-trips it)."""
         return to_prometheus(obs.METRICS)
 
+    # -- hot-row replication caches (ISSUE 19) ----------------------------
+    def _on_replica_swap(self, model: str) -> None:
+        """DeviceExecutor swap listener: a replica swap means the served
+        weights (may have) changed — drop the model's hot-row replicas
+        so no post-swap request is answered from pre-swap rows.  The
+        supervisor's ``hot_cache_refresh`` check rebuilds them from the
+        authoritative shards on its next tick."""
+        m = self.models.get(model)
+        if m is not None and hasattr(m, "invalidate_hot_caches"):
+            m.invalidate_hot_caches("swap")
+
+    def _refresh_hot_caches(self) -> None:
+        for m in self.models.values():
+            if hasattr(m, "refresh_hot_caches") and m.hot_caches():
+                m.refresh_hot_caches()
+
+    def hot_cache_stats(self) -> Dict[str, Any]:
+        """Per-table cache stats across models (ops dashboards/tests)."""
+        out: Dict[str, Any] = {}
+        for mname, m in self.models.items():
+            for tname, cache in getattr(m, "hot_caches", dict)().items():
+                out[f"{mname}/{tname}"] = cache.stats()
+        return out
+
     # -- model hot reload (reference ClusterServingHelper.scala:185-193:
     # the config/model path is re-checked periodically and the serving
     # model swapped in place without stopping the stream) ----------------
@@ -3074,9 +3130,17 @@ class ClusterServing:
         import logging
         logging.getLogger("analytics_zoo_tpu.deploy").info(
             "model at %s changed (mtime %.0f); hot-reloading", path, mtime)
+        old = self.models.get(self._default_model)
         self.model = InferenceModel.load(path)
         self.model.name = self._default_model
         self.models[self._default_model] = self.model
+        # the reloaded model starts with EMPTY hot caches (every id
+        # misses until the first refresh) — carried-over rows would be
+        # pre-reload weights; the old model's caches die with it
+        if old is not None and getattr(old, "hot_caches", dict)():
+            old.invalidate_hot_caches("reload")
+            self.model.enable_hot_caches(self._mesh,
+                                         axis=self.cfg.mesh_axis)
         if (self._compile_cache is not None
                 and getattr(self.model, "_net", None) is not None):
             self.model.attach_compile_cache(self._compile_cache)

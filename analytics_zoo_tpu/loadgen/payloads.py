@@ -18,7 +18,8 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-__all__ = ["PayloadClass", "PayloadMix", "saturated_images"]
+__all__ = ["PayloadClass", "PayloadMix", "ZipfianIdPayload",
+           "saturated_images"]
 
 
 class PayloadClass:
@@ -51,6 +52,39 @@ class PayloadClass:
     def __repr__(self) -> str:
         return (f"PayloadClass(model={self.model!r}, shape={self.shape}, "
                 f"dtype={self.dtype.name}, weight={self.weight})")
+
+
+class ZipfianIdPayload(PayloadClass):
+    """Skewed recommender id traffic: each request's id block draws
+    zipf(s) over ``vocab`` through :func:`analytics_zoo_tpu.data.zipf.
+    zipfian_ids` — the SAME generator the ``bench.py`` sharded-table
+    legs use, so the load harness's skew is byte-identical to the
+    bench's for the same generator state (ISSUE 19 satellite).  The
+    hot-row cache hit rates a bench pins therefore describe exactly the
+    traffic this class offers."""
+
+    def __init__(self, model: str, shape: Tuple[int, ...], vocab: int,
+                 s: float = 1.0, dtype: str = "int32",
+                 weight: float = 1.0, field: str = "ids",
+                 ttl_ms: Optional[float] = None):
+        super().__init__(model, shape, dtype=dtype, weight=weight,
+                         field=field, ttl_ms=ttl_ms, low=0.0,
+                         high=float(vocab))
+        if vocab <= 0:
+            raise ValueError(f"vocab must be positive, got {vocab}")
+        self.vocab = int(vocab)
+        self.s = float(s)
+
+    def draw(self, rng: np.random.Generator) -> np.ndarray:
+        from analytics_zoo_tpu.data.zipf import zipfian_ids
+
+        n = int(np.prod(self.shape)) if self.shape else 1
+        ids = zipfian_ids(self.vocab, n, self.s, rng=rng)
+        return ids.reshape(self.shape).astype(self.dtype)
+
+    def __repr__(self) -> str:
+        return (f"ZipfianIdPayload(model={self.model!r}, "
+                f"shape={self.shape}, vocab={self.vocab}, s={self.s})")
 
 
 class PayloadMix:

@@ -135,3 +135,24 @@ class ShardedEmbeddingTable(StatelessLayer):
             return sharded_gather(table, ids, mesh=mesh, axis=axis)
         return sharded_bag(table, ids, self.combiner, self.pad_id,
                            mesh=mesh, axis=axis)
+
+    def cached_forward(self, params, ids, cache, *, mesh=None,
+                       axis: str = "model"):
+        """Serving-side two-tier lookup through a ``parallel.hot_cache.
+        HotRowCache``: numpy ids in, numpy vectors out — hot ids resolve
+        from the chip-local replica (no psum), cold ids ride one bounded
+        sharded program.  Read-only over ``params`` (the cache refresh
+        path re-reads authoritative rows; training never calls this)."""
+        from analytics_zoo_tpu.parallel.hot_cache import (
+            cached_sharded_bag, cached_sharded_gather)
+
+        ids = np.asarray(ids)
+        if not self.zero_based:
+            ids = ids - 1
+        mesh = mesh if mesh is not None else cache.mesh
+        if self.combiner is None:
+            return cached_sharded_gather(cache, params["table"], ids,
+                                         mesh=mesh, axis=axis)
+        return cached_sharded_bag(cache, params["table"], ids,
+                                  self.combiner, self.pad_id,
+                                  mesh=mesh, axis=axis)

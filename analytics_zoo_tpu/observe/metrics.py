@@ -171,6 +171,27 @@ CATALOG: Dict[str, Tuple[str, str, Tuple[str, ...]]] = {
         "(requested | no_model_axis | axis_indivisible | fits_budget "
         "| over_budget | sharded_over_budget)",
         ("placement", "reason")),
+    # hot-row replication cache + dedup tier (parallel/hot_cache.py,
+    # ops/embedding_bag.py embedding_bag_dedup)
+    "table_hot_cache_lookups_total": (
+        "counter", "hot-row cache routing decisions per id "
+        "(hit = served from the chip-local replica, no exchange; "
+        "miss = rode the cold sharded-psum bucket)",
+        ("outcome", "table")),
+    "table_hot_cache_bytes_saved_total": (
+        "counter", "exchange bytes hot ids did NOT move over the model "
+        "axis (hits x row dim x dtype bytes)", ("table",)),
+    "table_hot_cache_refresh_total": (
+        "counter", "hot-row cache lifecycle events (refresh | "
+        "invalidate_swap | invalidate_reload ...)", ("event", "table")),
+    "table_hot_cache_hit_rate": (
+        "gauge", "cumulative hot-row cache hit fraction per table",
+        ("table",)),
+    "table_dedup_selected_total": (
+        "counter", "within-batch duplicate-id dedup routing decisions "
+        "per lookup site, by decision and bounded reason "
+        "(knob_on | knob_off | auto_sharded | auto_dense)",
+        ("decision", "reason")),
     "prefetch_queue_depth": (
         "gauge", "batches queued ahead of the consumer in the prefetch "
         "pipeline", ()),

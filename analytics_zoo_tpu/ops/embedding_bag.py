@@ -262,6 +262,111 @@ _bag.defvjp(_bag_fwd_rule, _bag_bwd_rule)
 
 
 # ---------------------------------------------------------------------------
+# within-batch duplicate-id dedup (ISSUE 19)
+#
+# Recommender id streams repeat heavily inside a batch (zipfian traffic):
+# the naive lookup pays one table-row DMA per SLOT, duplicates included.
+# The dedup path collapses the flattened id block to its unique set with
+# ``jnp.unique(size=B*N)`` — static output shape, so it jits — gathers
+# each distinct row from the big table exactly once, and scatters back
+# through the inverse index (a gather from the SMALL unique set, never
+# from HBM-resident table rows).  Big-table rows touched per batch drop
+# from ``B*N`` to ``U`` (the distinct count).  The custom_vjp keeps the
+# training contract exact: gradients accumulate PER OCCURRENCE (segment-
+# summed over the inverse index, then one scatter-add per unique row).
+
+
+def _dedup_unique(ids, vocab, pad_id):
+    """Static-shape unique decomposition of a ``(B, N)`` id block.
+
+    Returns ``(mask, uniq, inv)``: the (B, N) f32 validity mask, the
+    length-``B*N`` unique key vector (clipped ids; pad slots collapse to
+    the ``-1`` fill so they unify with the tail padding), and the (B, N)
+    inverse index with ``uniq[inv] == key``.
+    """
+    mask = _bag_mask(ids, pad_id)
+    clipped = jnp.clip(ids.astype(jnp.int32), 0, vocab - 1)  # take parity
+    key = jnp.where(mask > 0, clipped, -1)
+    uniq, inv = jnp.unique(key.reshape(-1), size=key.size,
+                           fill_value=-1, return_inverse=True)
+    return mask, uniq, inv.reshape(ids.shape)
+
+
+def _dedup_forward(table, ids, combiner, pad_id):
+    vocab, _ = table.shape
+    mask, uniq, inv = _dedup_unique(ids, vocab, pad_id)
+    live = (uniq >= 0).astype(jnp.float32)
+    rows_u = jnp.take(table, jnp.clip(uniq, 0, vocab - 1), axis=0)
+    rows_u = rows_u.astype(jnp.float32) * live[:, None]      # (U, D)
+    gathered = jnp.take(rows_u, inv, axis=0)                 # small-set
+    out = jnp.sum(gathered * mask[..., None], axis=1)
+    out = out * _combiner_scale(mask, combiner)
+    return out.astype(table.dtype), (table, ids)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _dedup_bag(table, ids, combiner, pad_id):
+    return _dedup_forward(table, ids, combiner, pad_id)[0]
+
+
+def _dedup_bag_fwd(table, ids, combiner, pad_id):
+    return _dedup_forward(table, ids, combiner, pad_id)
+
+
+def _dedup_bag_bwd(combiner, pad_id, res, g):
+    table, ids = res
+    vocab, dim = table.shape
+    mask, uniq, inv = _dedup_unique(ids, vocab, pad_id)
+    live = (uniq >= 0).astype(jnp.float32)
+    g_scaled = g.astype(jnp.float32) * _combiner_scale(mask, combiner)
+    # per-occurrence contribution, segment-summed per unique id first so
+    # the big-table scatter touches each distinct row exactly once
+    contrib = (g_scaled[:, None, :] * mask[..., None]).reshape(-1, dim)
+    d_u = jnp.zeros((uniq.shape[0], dim), jnp.float32)
+    d_u = d_u.at[inv.reshape(-1)].add(contrib) * live[:, None]
+    dtable = jnp.zeros((vocab, dim), jnp.float32)
+    dtable = dtable.at[jnp.clip(uniq, 0, vocab - 1)].add(d_u)
+    return (dtable.astype(table.dtype),
+            np.zeros(ids.shape, jax.dtypes.float0))
+
+
+_dedup_bag.defvjp(_dedup_bag_fwd, _dedup_bag_bwd)
+
+
+def embedding_bag_dedup(table, ids, combiner: str = "sum", pad_id=0):
+    """``embedding_bag`` through the within-batch dedup path: the same
+    bag math (same mask/clip/combiner semantics, parity at rtol 1e-6),
+    but each distinct id reads its table row exactly once per batch and
+    the backward scatter-adds exactly once per distinct row — duplicate
+    ids are free on both sides.  Differentiable wrt ``table``."""
+    _check_args(table, ids, combiner)
+    return _dedup_bag(table, ids, combiner, pad_id)
+
+
+def dedup_wanted(*, sharded: bool) -> bool:
+    """Resolve the ``dedup_ids`` knob for one lookup site and count the
+    decision (``table_dedup_selected_total{decision,reason}``) — the
+    PR 12 counted-dispatch contract for the dedup tier.  ``auto`` turns
+    dedup ON for sharded lookups (where the unique set also shrinks the
+    psum-side work and HBM row traffic pays full price) and OFF for the
+    dense path (the fused kernel already streams rows at line rate)."""
+    from analytics_zoo_tpu.observe import metrics as _metrics
+
+    knob = dispatch.config_knob("dedup_ids", "auto")
+    if knob == "off":
+        decision, reason = "off", "knob_off"
+    elif knob == "on":
+        decision, reason = "on", "knob_on"
+    else:
+        decision, reason = (("on", "auto_sharded") if sharded
+                            else ("off", "auto_dense"))
+    _metrics.count("table_dedup_selected_total", 1,
+                   flat=f"ops/dedup_{decision}",
+                   decision=decision, reason=reason)
+    return decision == "on"
+
+
+# ---------------------------------------------------------------------------
 # public entry
 
 

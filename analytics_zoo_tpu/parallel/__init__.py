@@ -33,6 +33,13 @@ from analytics_zoo_tpu.parallel.table_sharding import (  # noqa: F401
     sharded_bag,
     sharded_gather,
 )
+from analytics_zoo_tpu.parallel.hot_cache import (  # noqa: F401
+    HotRowCache,
+    cached_sharded_bag,
+    cached_sharded_gather,
+    cold_bucket,
+    table_row_reader,
+)
 from analytics_zoo_tpu.parallel.sequence import (  # noqa: F401
     ring_attention,
     ring_self_attention,

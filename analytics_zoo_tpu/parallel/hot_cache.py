@@ -1,0 +1,333 @@
+"""Hot-row replication cache for sharded embedding lookups (ISSUE 19).
+
+Recommender traffic is zipfian: a tiny head of the 10⁸-row sharded
+tables (PR 14) absorbs most lookups, yet ``sharded_bag`` pays the full
+(B, D) psum exchange for every slot of every batch.  This module adds
+the serving-side second tier:
+
+- :class:`HotRowCache` tracks per-id lookup frequency from the batcher's
+  id streams (count-based, lock-guarded, injectable clock), keeps a
+  small replica of the top-K most-frequent rows — replicated on every
+  chip when a mesh is attached, so a hit never crosses a link — and
+  refreshes the replica values from the authoritative shards on a
+  period (staleness is bounded by ``refresh_period_s``).
+- :func:`cached_sharded_gather` / :func:`cached_sharded_bag` route each
+  id **before dispatch**: hot ids resolve from the local replica with
+  no collective at all; cold ids dedup host-side and batch through ONE
+  bounded-size ``sharded_gather`` program (bucket sizes are powers of
+  two, so the compile count stays bounded).  A fully-hot batch skips
+  the exchange program entirely.
+
+The cache is strictly read-only over the table: training never consults
+it (optimizer writes stay authoritative — the training win is the
+within-batch dedup in ``ops.embedding_bag``), and serving invalidates
+it on ``swap_replicas`` / hot reload so a weight swap can never serve
+rows older than the next refresh.
+
+Every lookup is counted: ``table_hot_cache_lookups_total{outcome,
+table}``, ``table_hot_cache_bytes_saved_total{table}`` (exchange bytes
+the hot ids did NOT ride the psum), ``table_hot_cache_refresh_total
+{event,table}``, and the ``table_hot_cache_hit_rate{table}`` gauge.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import Counter
+from typing import Callable, Optional, Tuple
+
+import numpy as np
+
+from analytics_zoo_tpu.observe import metrics as obs
+
+__all__ = ["HotRowCache", "cached_sharded_bag", "cached_sharded_gather",
+           "cold_bucket", "table_row_reader"]
+
+# the smallest cold-id program; buckets grow by powers of two above it,
+# so a vocab-V table compiles at most log2(V) cold programs
+MIN_COLD_BUCKET = 8
+
+
+def cold_bucket(n: int) -> int:
+    """Bounded cold-id batch size: the next power of two >= ``n`` (and
+    >= ``MIN_COLD_BUCKET``) — the static shapes the cold ``sharded_
+    gather`` programs compile at."""
+    b = MIN_COLD_BUCKET
+    while b < int(n):
+        b <<= 1
+    return b
+
+
+class HotRowCache:
+    """Top-K hot-row replica of one sharded table, frequency-ranked.
+
+    Thread-safe: ``record`` runs on batcher/decode threads while
+    ``route``/``refresh`` run on dispatch threads, so every shared
+    mutation is taken under one lock.  The replica arrays themselves
+    are replaced wholesale on refresh (never mutated in place), so a
+    reader holding a pre-refresh snapshot sees a consistent, merely
+    stale, view.  ``clock`` is injectable for the staleness tests.
+    """
+
+    def __init__(self, table: str, capacity: int, dim: int, *,
+                 refresh_period_s: float = 30.0,
+                 clock: Callable[[], float] = time.monotonic,
+                 mesh=None, dtype=np.float32):
+        if capacity <= 0:
+            raise ValueError(f"capacity must be positive, got {capacity}")
+        self.table = str(table)
+        self.capacity = int(capacity)
+        self.dim = int(dim)
+        self.refresh_period_s = float(refresh_period_s)
+        self.mesh = mesh
+        self.dtype = np.dtype(dtype)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._counts: Counter = Counter()
+        # replica state; all three replaced together under the lock
+        self._sorted_ids = np.empty((0,), np.int64)
+        self._rows = np.zeros((0, self.dim), self.dtype)
+        self._device_rows = None
+        self._version = 0
+        self._last_refresh: Optional[float] = None
+        self._hits = 0
+        self._lookups = 0
+
+    # -- frequency tracking (batcher id streams) ---------------------------
+    def record(self, ids) -> None:
+        """Fold one id stream into the frequency counts (any shape)."""
+        flat = np.asarray(ids).reshape(-1)
+        if flat.size == 0:
+            return
+        vals, cnts = np.unique(flat.astype(np.int64), return_counts=True)
+        with self._lock:
+            for v, c in zip(vals.tolist(), cnts.tolist()):
+                self._counts[v] += c
+
+    def top_ids(self) -> np.ndarray:
+        """The current top-``capacity`` ids by observed frequency
+        (count desc, id asc — deterministic under ties)."""
+        with self._lock:
+            items = list(self._counts.items())
+        items.sort(key=lambda kv: (-kv[1], kv[0]))
+        return np.asarray([k for k, _ in items[:self.capacity]],
+                          np.int64)
+
+    # -- replica lifecycle -------------------------------------------------
+    def refresh(self, row_reader: Callable[[np.ndarray], np.ndarray]
+                ) -> int:
+        """Re-rank the top-K and re-read their rows from the
+        authoritative shards via ``row_reader(ids) -> (len(ids), D)``.
+        Returns the number of rows now cached."""
+        ids = self.top_ids()
+        rows = (np.asarray(row_reader(ids), self.dtype)
+                if ids.size else np.zeros((0, self.dim), self.dtype))
+        if rows.shape != (ids.size, self.dim):
+            raise ValueError(
+                f"row_reader returned {rows.shape} for {ids.size} ids "
+                f"of dim {self.dim}")
+        order = np.argsort(ids, kind="stable")
+        dev = None
+        if self.mesh is not None and ids.size:
+            # the replicated placement IS the claim: every chip holds
+            # the K hot rows locally, so a hit never crosses a link
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            with jax.transfer_guard("allow"):
+                dev = jax.device_put(
+                    rows, NamedSharding(self.mesh, PartitionSpec()))
+        with self._lock:
+            self._sorted_ids = ids[order]
+            self._rows = rows[order]
+            self._device_rows = dev
+            self._version += 1
+            self._last_refresh = self._clock()
+        obs.count("table_hot_cache_refresh_total", 1,
+                  flat="parallel/hot_cache_refresh",
+                  event="refresh", table=self.table)
+        return int(ids.size)
+
+    def maybe_refresh(self, row_reader) -> bool:
+        """Refresh iff never refreshed, invalidated, or the period has
+        elapsed on the injected clock."""
+        with self._lock:
+            last = self._last_refresh
+        if last is not None and \
+                self._clock() - last < self.refresh_period_s:
+            return False
+        self.refresh(row_reader)
+        return True
+
+    def invalidate(self, reason: str = "swap") -> None:
+        """Drop the replica (every id misses until the next refresh).
+        Frequency counts survive — traffic knowledge is still valid
+        when the weights change under a swap/hot-reload."""
+        with self._lock:
+            self._sorted_ids = np.empty((0,), np.int64)
+            self._rows = np.zeros((0, self.dim), self.dtype)
+            self._device_rows = None
+            self._version += 1
+            self._last_refresh = None
+        obs.count("table_hot_cache_refresh_total", 1,
+                  flat="parallel/hot_cache_invalidate",
+                  event=f"invalidate_{reason}", table=self.table)
+
+    # -- lookup routing ----------------------------------------------------
+    def route(self, ids) -> Tuple[np.ndarray, np.ndarray]:
+        """Split one flat id block into (slots, hot): ``hot[i]`` true
+        where ``ids[i]`` is cached, ``slots[i]`` its replica row index.
+        Counts hits/misses/bytes-saved and updates the hit-rate gauge."""
+        flat = np.asarray(ids).reshape(-1).astype(np.int64)
+        with self._lock:
+            sids = self._sorted_ids
+        if sids.size == 0:
+            slots = np.full(flat.shape, -1, np.int64)
+            hot = np.zeros(flat.shape, bool)
+        else:
+            pos = np.searchsorted(sids, flat)
+            pos_c = np.minimum(pos, sids.size - 1)
+            hot = sids[pos_c] == flat
+            slots = np.where(hot, pos_c, -1)
+        hits = int(hot.sum())
+        misses = int(flat.size - hits)
+        with self._lock:
+            self._hits += hits
+            self._lookups += flat.size
+            rate = self._hits / max(1, self._lookups)
+        if hits:
+            obs.count("table_hot_cache_lookups_total", hits,
+                      flat="parallel/hot_cache_hit",
+                      outcome="hit", table=self.table)
+            obs.count("table_hot_cache_bytes_saved_total",
+                      hits * self.dim * self.dtype.itemsize,
+                      flat="parallel/hot_cache_bytes_saved",
+                      table=self.table)
+        if misses:
+            obs.count("table_hot_cache_lookups_total", misses,
+                      flat="parallel/hot_cache_miss",
+                      outcome="miss", table=self.table)
+        obs.set_gauge("table_hot_cache_hit_rate", rate,
+                      table=self.table)
+        return slots, hot
+
+    def take(self, slots) -> np.ndarray:
+        """Replica rows for ``slots`` (as returned hot by ``route``)."""
+        with self._lock:
+            rows = self._rows
+        return rows[np.asarray(slots, np.int64)]
+
+    # -- introspection -----------------------------------------------------
+    @property
+    def version(self) -> int:
+        with self._lock:
+            return self._version
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"table": self.table, "capacity": self.capacity,
+                    "cached_rows": int(self._sorted_ids.size),
+                    "tracked_ids": len(self._counts),
+                    "hits": self._hits, "lookups": self._lookups,
+                    "hit_rate": self._hits / max(1, self._lookups),
+                    "version": self._version,
+                    "last_refresh": self._last_refresh}
+
+
+def table_row_reader(table, *, mesh=None, axis: str = "model"):
+    """A ``row_reader`` over the authoritative (possibly row-sharded)
+    device table: reads exact current row values, so a refresh right
+    after an optimizer step or weight swap serves the new weights."""
+    import jax
+    import jax.numpy as jnp
+
+    def read(ids: np.ndarray) -> np.ndarray:
+        if len(ids) == 0:
+            return np.zeros((0, int(table.shape[1])))
+        # the refresh IS the explicit staging chokepoint (like
+        # init_table_sharded's upload): guarded serving paths stay
+        # runnable because transfers only happen here, on a period
+        with jax.transfer_guard("allow"):
+            rows = jnp.take(table, jnp.asarray(np.asarray(ids),
+                                               jnp.int32), axis=0)
+            return np.asarray(jax.device_get(rows))
+
+    return read
+
+
+def _two_tier_rows(cache: HotRowCache, table, flat: np.ndarray, *,
+                   mesh, axis: str) -> np.ndarray:
+    """(n, D) rows for a flat clipped id block: hot from the replica,
+    cold deduped host-side and fetched through one bounded
+    ``sharded_gather`` program (none at all when fully hot)."""
+    import jax
+    import jax.numpy as jnp
+
+    from analytics_zoo_tpu.parallel.table_sharding import sharded_gather
+
+    dim = int(table.shape[1])
+    slots, hot = cache.route(flat)
+    out = np.zeros((flat.size, dim), cache.dtype)
+    if hot.any():
+        out[hot] = cache.take(slots[hot])
+    cold = flat[~hot]
+    if cold.size:
+        uniq = np.unique(cold)
+        bucket = cold_bucket(uniq.size)
+        padded = np.full((bucket,), int(uniq[0]), np.int32)
+        padded[:uniq.size] = uniq.astype(np.int32)
+        with jax.transfer_guard("allow"):
+            ids_d = jax.device_put(jnp.asarray(padded))
+            rows = np.asarray(jax.device_get(
+                sharded_gather(table, ids_d, mesh=mesh, axis=axis)))
+        out[~hot] = rows[np.searchsorted(uniq, cold)]
+    return out
+
+
+def cached_sharded_gather(cache: HotRowCache, table, ids, *, mesh,
+                          axis: str = "model",
+                          record: bool = True) -> np.ndarray:
+    """Serving-side two-tier ``table[ids]``: numpy ids in (pre-dispatch,
+    where the serving path holds host arrays), numpy rows out — exact
+    same values as :func:`~analytics_zoo_tpu.parallel.table_sharding.
+    sharded_gather` after a refresh, but hot ids never enter the psum
+    exchange and the cold remainder rides a deduped bounded bucket."""
+    ids_np = np.asarray(ids)
+    vocab = int(table.shape[0])
+    flat = np.clip(ids_np.reshape(-1).astype(np.int64), 0, vocab - 1)
+    if record:
+        cache.record(flat)
+    out = _two_tier_rows(cache, table, flat, mesh=mesh, axis=axis)
+    return out.reshape(tuple(ids_np.shape) + (cache.dim,))
+
+
+def cached_sharded_bag(cache: HotRowCache, table, ids,
+                       combiner: str = "sum", pad_id=None, *, mesh,
+                       axis: str = "model",
+                       record: bool = True) -> np.ndarray:
+    """Two-tier ``embedding_bag`` over a sharded table: (B, N) ids ->
+    (B, D), same mask/clip/combiner semantics as ``sharded_bag`` (pad
+    slots contribute exact zeros and don't pollute the frequency
+    counts), parity at rtol 1e-6 against the uncached path."""
+    if combiner not in ("sum", "mean", "sqrtn"):
+        raise ValueError(f"combiner must be sum|mean|sqrtn, "
+                         f"got {combiner!r}")
+    ids_np = np.asarray(ids)
+    if ids_np.ndim != 2:
+        raise ValueError(f"ids must be (bags, max_nnz), got "
+                         f"{ids_np.shape}")
+    vocab = int(table.shape[0])
+    mask = (np.ones(ids_np.shape, np.float32) if pad_id is None
+            else (ids_np != pad_id).astype(np.float32))
+    clipped = np.clip(ids_np.astype(np.int64), 0, vocab - 1)
+    flat = np.where(mask > 0, clipped, 0).reshape(-1)
+    if record:
+        cache.record(clipped.reshape(-1)[mask.reshape(-1) > 0])
+    rows = _two_tier_rows(cache, table, flat, mesh=mesh, axis=axis)
+    rows = rows.reshape(ids_np.shape + (cache.dim,)).astype(np.float32)
+    out = np.sum(rows * mask[..., None], axis=1)
+    if combiner != "sum":
+        n = np.maximum(mask.sum(axis=1, keepdims=True), 1.0)
+        out = out / (n if combiner == "mean" else np.sqrt(n))
+    return out.astype(cache.dtype)

@@ -106,7 +106,7 @@ def _data_axis(mesh, own_axis: str) -> Optional[str]:
 
 
 def sharded_bag(table, ids, combiner: str = "sum", pad_id=None, *,
-                mesh, axis: str = "model"):
+                mesh, axis: str = "model", dedup: Optional[bool] = None):
     """``embedding_bag`` over a row-sharded table: ``(B, N)`` ids against
     a ``(rows, D)`` table laid out ``P(axis, None)`` -> ``(B, D)``.
 
@@ -118,13 +118,26 @@ def sharded_bag(table, ids, combiner: str = "sum", pad_id=None, *,
     scaling applies AFTER the exchange from the global validity count
     (ids are replicated over the model axis, so every shard derives the
     same count).  Exchange bytes per step: ``B * D * 4`` per table.
+
+    ``dedup`` routes the local gather through the within-batch unique-id
+    path (``ops.embedding_bag.embedding_bag_dedup``: duplicate ids cost
+    one row read, grads still accumulate per occurrence); ``None``
+    resolves the ``dedup_ids`` knob, whose ``auto`` default turns dedup
+    ON here — this is exactly the lookup where duplicate rows pay full
+    HBM price on every shard.
     """
-    from analytics_zoo_tpu.ops.embedding_bag import embedding_bag
+    from analytics_zoo_tpu.ops.embedding_bag import (dedup_wanted,
+                                                     embedding_bag,
+                                                     embedding_bag_dedup)
 
     rows = int(table.shape[0])
     ways = resolve_table_ways(mesh, axis, rows)
     if ways <= 1:
         return embedding_bag(table, ids, combiner, pad_id)
+    if dedup is None:
+        dedup = dedup_wanted(sharded=True)
+    local_bag = embedding_bag_dedup if dedup else (
+        lambda tab, i, c, pad_id: embedding_bag(tab, i, c, pad_id=pad_id))
     rows_local = rows // ways
     batch_ax = _data_axis(mesh, axis)
 
@@ -136,7 +149,7 @@ def sharded_bag(table, ids, combiner: str = "sum", pad_id=None, *,
                  else ids_l != pad_id)
         owned = valid & (ids_l >= lo) & (ids_l < lo + rows_local)
         local_ids = jnp.where(owned, ids_l - lo, -1)
-        part = embedding_bag(tab, local_ids, "sum", pad_id=-1)
+        part = local_bag(tab, local_ids, "sum", -1)
         total = jax.lax.psum(part.astype(jnp.float32), axis)
         if combiner != "sum":
             n = jnp.maximum(
@@ -153,13 +166,15 @@ def sharded_bag(table, ids, combiner: str = "sum", pad_id=None, *,
     )(table, ids)
 
 
-def sharded_gather(table, ids, *, mesh, axis: str = "model"):
+def sharded_gather(table, ids, *, mesh, axis: str = "model",
+                   dedup: Optional[bool] = None):
     """``table[ids]`` over a row-sharded table: ids of any shape ->
     ``ids.shape + (D,)`` — the degenerate single-slot bag, same local
-    gather + psum exchange as :func:`sharded_bag`."""
+    gather + psum exchange (and the same ``dedup_ids``-resolved
+    unique-id routing) as :func:`sharded_bag`."""
     flat = ids.astype(jnp.int32).reshape((-1, 1))
     out = sharded_bag(table, flat, "sum", pad_id=None, mesh=mesh,
-                      axis=axis)
+                      axis=axis, dedup=dedup)
     return out.reshape(tuple(ids.shape) + (int(table.shape[1]),))
 
 

@@ -1,0 +1,40 @@
+"""zoolint fixture: the hot-row cache frequency-counter idiom behind
+parallel/hot_cache.py.  The batcher thread records id frequencies while
+the supervisor thread re-ranks the top-K — an unlocked bump of that
+shared counter fires THR-SHARED-MUT (a torn read re-ranks from a
+half-written count and replicates the wrong rows); the shipped idiom —
+every counter mutation under one lock, the replica array replaced
+wholesale, never edited in place — stays quiet, so the cache keeps a
+clean lint bill by construction, not by suppression."""
+
+import threading
+
+
+class NaiveHotCounter:
+    def __init__(self):
+        self._counts = {}
+        self._hot = ()
+        self._thread = threading.Thread(target=self._run)
+
+    def _run(self):
+        self._hot = (3, 7)        # THR-SHARED-MUT fires: unlocked
+        # cross-thread re-rank, read by top_ids() below
+
+    def top_ids(self):
+        return self._hot
+
+
+class LockedHotCounter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counts = {}
+        self._hot = ()
+        self._thread = threading.Thread(target=self._run)
+
+    def _run(self):
+        with self._lock:
+            self._hot = (3, 7)    # quiet: re-rank under the lock
+
+    def top_ids(self):
+        with self._lock:
+            return self._hot

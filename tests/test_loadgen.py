@@ -24,6 +24,7 @@ from analytics_zoo_tpu.loadgen.arrivals import (DiurnalRamp, FlashCrowd,
                                                 interarrivals)
 from analytics_zoo_tpu.loadgen.client import RequestRecord, _outcome_of
 from analytics_zoo_tpu.loadgen.payloads import (PayloadClass, PayloadMix,
+                                                ZipfianIdPayload,
                                                 saturated_images)
 
 
@@ -130,6 +131,36 @@ class TestPayloads:
         # seed path builds its own RandomState
         c = saturated_images(2, seed=7)
         assert np.array_equal(c[0], b[0])
+
+    def test_zipfian_payload_matches_bench_generator_bytes(self):
+        """The skew contract (ISSUE 19): the payload class's id blocks
+        are BYTE-IDENTICAL to ``data.zipf.zipfian_ids`` for the same
+        generator state — a bench hit-rate claim at s=1.0 is literally
+        about the traffic this class offers."""
+        from analytics_zoo_tpu.data.zipf import zipfian_ids
+
+        cls = ZipfianIdPayload("m", shape=(4, 8), vocab=256, s=1.0)
+        got = cls.draw(np.random.default_rng(42))
+        want = zipfian_ids(256, 32, 1.0, seed=42).reshape(4, 8)
+        np.testing.assert_array_equal(got, want)
+        assert got.dtype == np.int32 and got.shape == (4, 8)
+        assert got.min() >= 0 and got.max() < 256
+
+    def test_zipfian_payload_skew_and_mix_wiring(self):
+        cls = ZipfianIdPayload("m", shape=(4096,), vocab=64, s=1.0,
+                               ttl_ms=50.0)
+        ids = cls.draw(np.random.default_rng(0))
+        counts = np.bincount(ids, minlength=64)
+        # zipf(1): id 0 carries ~1/H(64) ≈ 21% of the mass; uniform
+        # would put ~1.6% there — the skew must be unmistakable
+        assert counts[0] > 4 * counts[32:].max()
+        assert np.argmax(counts) == 0
+        # rides a PayloadMix like any other class
+        mix = PayloadMix([cls, PayloadClass("m", (4,), weight=1.0)])
+        pick, payload = mix.draw(np.random.default_rng(1))
+        assert payload is not None and pick.model == "m"
+        with pytest.raises(ValueError, match="vocab"):
+            ZipfianIdPayload("m", shape=(4,), vocab=0)
 
 
 def _rec(uri, model, t_sched, latency_s=None, outcome="ok"):

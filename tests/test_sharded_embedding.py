@@ -645,3 +645,513 @@ class TestSyntheticGiantTable:
         host = np.asarray(arr)
         np.testing.assert_array_equal(host[:60], src.rows(0, 60))
         assert np.all(host[60:] == 0)          # padding tail
+
+
+# ---------------------------------------------------------------------------
+# within-batch dedup through the sharded lookup (ISSUE 19 tentpole a)
+# ---------------------------------------------------------------------------
+
+
+class TestShardedDedup:
+    @pytest.mark.parametrize("combiner", ["sum", "mean", "sqrtn"])
+    def test_dedup_matches_naive(self, tp_ctx, combiner):
+        import jax.numpy as jnp
+
+        from analytics_zoo_tpu.parallel import sharded_bag
+
+        rs = np.random.RandomState(10)
+        table = jnp.asarray(rs.randn(48, 8).astype(np.float32))
+        ids = jnp.asarray(rs.randint(0, 48, (16, 5)).astype(np.int32))
+        ids = ids.at[0, :3].set(0)
+        naive = np.asarray(sharded_bag(table, ids, combiner, pad_id=0,
+                                       mesh=tp_ctx.mesh, axis="model",
+                                       dedup=False))
+        got = np.asarray(sharded_bag(table, ids, combiner, pad_id=0,
+                                     mesh=tp_ctx.mesh, axis="model",
+                                     dedup=True))
+        np.testing.assert_allclose(got, naive, rtol=1e-6, atol=1e-7)
+
+    def test_gather_through_dedup_matches_take(self, tp_ctx):
+        import jax.numpy as jnp
+
+        from analytics_zoo_tpu.parallel import sharded_gather
+
+        rs = np.random.RandomState(11)
+        table = jnp.asarray(rs.randn(48, 6).astype(np.float32))
+        ids = jnp.asarray(rs.randint(0, 48, (8, 3)).astype(np.int32))
+        got = np.asarray(sharded_gather(table, ids, mesh=tp_ctx.mesh,
+                                        axis="model", dedup=True))
+        np.testing.assert_allclose(
+            got, np.asarray(jnp.take(table, ids, axis=0)),
+            rtol=1e-6, atol=1e-7)
+
+    def test_gradient_matches_naive(self, tp_ctx):
+        import jax
+        import jax.numpy as jnp
+
+        from analytics_zoo_tpu.parallel import sharded_bag
+
+        rs = np.random.RandomState(12)
+        table = jnp.asarray(rs.randn(48, 8).astype(np.float32))
+        ids = jnp.asarray(rs.randint(0, 48, (16, 4)).astype(np.int32))
+
+        def loss(dedup):
+            return lambda t: jnp.sum(sharded_bag(
+                t, ids, "sum", pad_id=0, mesh=tp_ctx.mesh,
+                axis="model", dedup=dedup) ** 2)
+
+        g_d = np.asarray(jax.grad(loss(True))(table))
+        g_n = np.asarray(jax.grad(loss(False))(table))
+        np.testing.assert_allclose(g_d, g_n, rtol=1e-6, atol=1e-6)
+
+    def test_fully_duplicated_batch_regression(self, tp_ctx):
+        """EVERY slot the same id: unique collapses to one live row —
+        the forward and per-occurrence gradient must survive both the
+        inverse-index scatter and the psum exchange."""
+        import jax
+        import jax.numpy as jnp
+
+        from analytics_zoo_tpu.ops.embedding_bag import embedding_bag
+        from analytics_zoo_tpu.parallel import sharded_bag
+
+        rs = np.random.RandomState(13)
+        table = jnp.asarray(rs.randn(48, 8).astype(np.float32))
+        ids = jnp.full((16, 4), 37, jnp.int32)
+        ref = np.asarray(embedding_bag(table, ids, "sum", pad_id=None))
+        got = np.asarray(sharded_bag(table, ids, "sum", pad_id=None,
+                                     mesh=tp_ctx.mesh, axis="model",
+                                     dedup=True))
+        np.testing.assert_allclose(got, ref, rtol=1e-6, atol=1e-7)
+        g = np.asarray(jax.grad(lambda t: jnp.sum(sharded_bag(
+            t, ids, "sum", pad_id=None, mesh=tp_ctx.mesh,
+            axis="model", dedup=True)))(table))
+        np.testing.assert_allclose(g[37], np.full(8, 64.0, np.float32),
+                                   rtol=1e-6)
+        assert float(np.abs(np.delete(g, 37, axis=0)).max()) == 0.0
+
+    def test_all_pad_bag_regression(self, tp_ctx):
+        import jax.numpy as jnp
+
+        from analytics_zoo_tpu.ops.embedding_bag import embedding_bag
+        from analytics_zoo_tpu.parallel import sharded_bag
+
+        rs = np.random.RandomState(14)
+        table = jnp.asarray(rs.randn(48, 8).astype(np.float32))
+        ids = jnp.asarray(rs.randint(1, 48, (8, 4)).astype(np.int32))
+        ids = ids.at[3].set(0)                # one fully-padded bag
+        got = np.asarray(sharded_bag(table, ids, "mean", pad_id=0,
+                                     mesh=tp_ctx.mesh, axis="model",
+                                     dedup=True))
+        ref = np.asarray(embedding_bag(table, ids, "mean", pad_id=0))
+        np.testing.assert_allclose(got, ref, rtol=1e-6, atol=1e-7)
+        np.testing.assert_array_equal(got[3], np.zeros(8, np.float32))
+
+
+# ---------------------------------------------------------------------------
+# the hot-row replication cache (ISSUE 19 tentpole b)
+# ---------------------------------------------------------------------------
+
+
+class TestHotRowCache:
+    def _cache(self, table_np, capacity=4, period=30.0, clock=None):
+        from analytics_zoo_tpu.parallel import HotRowCache
+
+        kw = {} if clock is None else {"clock": clock}
+        return HotRowCache("t/test", capacity, dim=table_np.shape[1],
+                           refresh_period_s=period, **kw)
+
+    def test_cold_bucket_is_bounded_powers_of_two(self):
+        from analytics_zoo_tpu.parallel import cold_bucket
+        from analytics_zoo_tpu.parallel.hot_cache import MIN_COLD_BUCKET
+
+        assert MIN_COLD_BUCKET == 8
+        assert cold_bucket(0) == 8
+        assert cold_bucket(1) == 8
+        assert cold_bucket(8) == 8
+        assert cold_bucket(9) == 16
+        assert cold_bucket(129) == 256
+
+    def test_frequency_ranking_deterministic_under_ties(self):
+        table = np.zeros((16, 4), np.float32)
+        c = self._cache(table, capacity=3)
+        c.record([5, 5, 5, 9, 9, 2, 7])       # tie between 2 and 7
+        np.testing.assert_array_equal(c.top_ids(), [5, 9, 2])
+        c.record(np.asarray([[7, 7]]))        # any shape folds in
+        # 7 ties 5 at count 3 -> ascending id breaks it: 5 stays first
+        np.testing.assert_array_equal(c.top_ids(), [5, 7, 9])
+
+    def test_route_and_metrics(self):
+        from analytics_zoo_tpu.observe.metrics import METRICS
+
+        table = np.arange(32, dtype=np.float32).reshape(8, 4)
+        c = self._cache(table, capacity=2)
+        c.record([1, 1, 6])
+        c.refresh(lambda ids: table[np.asarray(ids, np.int64)])
+        before = METRICS.snapshot()
+        slots, hot = c.route([1, 3, 6, 1])
+        np.testing.assert_array_equal(hot, [True, False, True, True])
+        np.testing.assert_array_equal(c.take(slots[hot]),
+                                      table[[1, 6, 1]])
+        snap = METRICS.snapshot()
+        hit_key = ("table_hot_cache_lookups_total",
+                   (("outcome", "hit"), ("table", "t/test")))
+        miss_key = ("table_hot_cache_lookups_total",
+                    (("outcome", "miss"), ("table", "t/test")))
+        bytes_key = ("table_hot_cache_bytes_saved_total",
+                     (("table", "t/test"),))
+        assert snap.counters[hit_key] == \
+            before.counters.get(hit_key, 0) + 3
+        assert snap.counters[miss_key] == \
+            before.counters.get(miss_key, 0) + 1
+        assert snap.counters[bytes_key] == \
+            before.counters.get(bytes_key, 0) + 3 * 4 * 4
+        assert c.stats()["hit_rate"] == pytest.approx(0.75)
+
+    def test_staleness_bounded_by_refresh_period(self):
+        """The acceptance bound: a cached row can lag the authoritative
+        table by at most ``refresh_period_s`` on the injected clock —
+        stale reads before the period, fresh right after it."""
+        now = [100.0]
+        table = np.ones((8, 4), np.float32)
+        c = self._cache(table, capacity=2, period=10.0,
+                        clock=lambda: now[0])
+        c.record([0, 0, 3])
+        reads = {"n": 0}
+
+        def reader(ids):
+            reads["n"] += 1
+            return table[np.asarray(ids, np.int64)]
+
+        assert c.maybe_refresh(reader)        # never refreshed: fires
+        v1 = c.version
+        table += 1.0                          # the optimizer moved
+        now[0] = 109.9                        # inside the period
+        assert not c.maybe_refresh(reader)
+        np.testing.assert_array_equal(c.take([0]),
+                                      np.ones((1, 4), np.float32))
+        now[0] = 110.1                        # period elapsed
+        assert c.maybe_refresh(reader)
+        assert c.version == v1 + 1 and reads["n"] == 2
+        np.testing.assert_array_equal(
+            c.take([0]), np.full((1, 4), 2.0, np.float32))
+        assert c.stats()["last_refresh"] == 110.1
+
+    def test_invalidate_drops_replica_keeps_traffic_knowledge(self):
+        from analytics_zoo_tpu.observe.metrics import METRICS
+
+        table = np.ones((8, 4), np.float32)
+        c = self._cache(table, capacity=2)
+        c.record([2, 2, 5])
+        c.refresh(lambda ids: table[np.asarray(ids, np.int64)])
+        assert c.stats()["cached_rows"] == 2
+        before = METRICS.snapshot()
+        c.invalidate("swap")
+        key = ("table_hot_cache_refresh_total",
+               (("event", "invalidate_swap"), ("table", "t/test")))
+        assert METRICS.snapshot().counters[key] == \
+            before.counters.get(key, 0) + 1
+        _, hot = c.route([2, 5])              # every id misses now
+        assert not hot.any()
+        assert c.stats()["cached_rows"] == 0
+        # frequency knowledge survives: the next refresh re-ranks from
+        # the SAME counts and repopulates immediately
+        c.refresh(lambda ids: table[np.asarray(ids, np.int64)])
+        assert c.stats()["cached_rows"] == 2
+        _, hot = c.route([2, 5])
+        assert hot.all()
+
+    def test_bad_inputs_rejected(self):
+        from analytics_zoo_tpu.parallel import HotRowCache
+
+        with pytest.raises(ValueError, match="capacity"):
+            HotRowCache("t", 0, dim=4)
+        c = self._cache(np.zeros((4, 4), np.float32))
+        c.record([1])
+        with pytest.raises(ValueError, match="row_reader"):
+            c.refresh(lambda ids: np.zeros((len(ids), 7)))
+
+
+# ---------------------------------------------------------------------------
+# two-tier cached lookups on the mesh (transfer-guarded parity suite)
+# ---------------------------------------------------------------------------
+
+
+def _warm_cache(table, mesh, capacity=16, ids=None):
+    from analytics_zoo_tpu.parallel import HotRowCache, table_row_reader
+
+    c = HotRowCache("t/parity", capacity, dim=int(table.shape[1]),
+                    mesh=mesh)
+    c.record(ids if ids is not None else np.arange(capacity))
+    c.refresh(table_row_reader(table))
+    return c
+
+
+class TestCachedShardedLookup:
+    @pytest.mark.transfer_guard
+    def test_cached_gather_matches_uncached(self, tp_ctx):
+        """The acceptance gate: cached-vs-uncached parity at rtol 1e-6
+        on zipfian traffic, with the serving-side path running under
+        ``transfer_guard("disallow")`` — its cold fetch and replica
+        reads are EXPLICIT staging chokepoints, never implicit."""
+        import jax
+        import jax.numpy as jnp
+
+        from analytics_zoo_tpu.data.zipf import zipfian_ids
+        from analytics_zoo_tpu.parallel import cached_sharded_gather
+        from analytics_zoo_tpu.parallel import sharded_gather
+
+        rs = np.random.RandomState(20)
+        table = jnp.asarray(rs.randn(64, 8).astype(np.float32))
+        cache = _warm_cache(table, tp_ctx.mesh,
+                            ids=zipfian_ids(64, 2048, 1.0, seed=0))
+        meas = zipfian_ids(64, 256, 1.0, seed=1).reshape(16, 16)
+        with jax.transfer_guard("allow"):
+            want = np.asarray(jax.device_get(sharded_gather(
+                table, jnp.asarray(meas), mesh=tp_ctx.mesh,
+                axis="model")))
+        with jax.transfer_guard("disallow"):
+            got = cached_sharded_gather(cache, table, meas,
+                                        mesh=tp_ctx.mesh, axis="model")
+        np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-7)
+        assert cache.stats()["hits"] > 0      # the hot tier really hit
+
+    @pytest.mark.transfer_guard
+    def test_cached_bag_matches_uncached(self, tp_ctx):
+        import jax
+        import jax.numpy as jnp
+
+        from analytics_zoo_tpu.parallel import (cached_sharded_bag,
+                                                sharded_bag)
+
+        rs = np.random.RandomState(21)
+        table = jnp.asarray(rs.randn(48, 8).astype(np.float32))
+        cache = _warm_cache(table, tp_ctx.mesh)
+        ids = rs.randint(0, 48, (16, 5)).astype(np.int32)
+        ids[0, :3] = 0                        # pad slots
+        for combiner, pad in (("mean", 0), ("sum", None), ("sqrtn", 0)):
+            with jax.transfer_guard("allow"):
+                want = np.asarray(jax.device_get(sharded_bag(
+                    table, jnp.asarray(ids), combiner, pad_id=pad,
+                    mesh=tp_ctx.mesh, axis="model")))
+            with jax.transfer_guard("disallow"):
+                got = cached_sharded_bag(cache, table, ids, combiner,
+                                         pad_id=pad, mesh=tp_ctx.mesh,
+                                         axis="model")
+            # atol 1e-6: the host-side bag reduces in a different f32
+            # association order than the on-device lowering
+            np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6,
+                                       err_msg=combiner)
+
+    @pytest.mark.transfer_guard
+    def test_fully_hot_batch_skips_the_exchange(self, tp_ctx):
+        """Every id cached -> the cold sharded program never runs: the
+        lookup completes under the guard with zero device dispatches
+        beyond the replica read, and every lookup counts as a hit."""
+        import jax
+        import jax.numpy as jnp
+
+        rs = np.random.RandomState(22)
+        table = jnp.asarray(rs.randn(32, 4).astype(np.float32))
+        cache = _warm_cache(table, tp_ctx.mesh, capacity=8)
+        ids = np.asarray([[0, 7], [3, 3]], np.int64)
+        with jax.transfer_guard("disallow"):
+            from analytics_zoo_tpu.parallel import cached_sharded_gather
+
+            got = cached_sharded_gather(cache, table, ids,
+                                        mesh=tp_ctx.mesh, axis="model")
+        np.testing.assert_allclose(
+            got, np.asarray(table)[ids], rtol=1e-6, atol=1e-7)
+        s = cache.stats()
+        assert s["hits"] == s["lookups"] == 4
+
+    def test_post_invalidate_parity_through_cold_path(self, tp_ctx):
+        import jax.numpy as jnp
+
+        from analytics_zoo_tpu.parallel import cached_sharded_gather
+
+        rs = np.random.RandomState(23)
+        table = jnp.asarray(rs.randn(48, 8).astype(np.float32))
+        cache = _warm_cache(table, tp_ctx.mesh)
+        cache.invalidate("swap")
+        ids = rs.randint(0, 48, (8, 3))
+        got = cached_sharded_gather(cache, table, ids,
+                                    mesh=tp_ctx.mesh, axis="model")
+        np.testing.assert_allclose(got, np.asarray(table)[ids],
+                                   rtol=1e-6, atol=1e-7)
+        assert cache.stats()["hits"] == 0     # all-cold, still exact
+
+    def test_refresh_after_weight_change_serves_new_rows(self, tp_ctx):
+        """Staleness contract end to end: a table update is invisible
+        until the next refresh, exact immediately after it."""
+        import jax.numpy as jnp
+
+        from analytics_zoo_tpu.parallel import (cached_sharded_gather,
+                                                table_row_reader)
+
+        rs = np.random.RandomState(24)
+        table = jnp.asarray(rs.randn(32, 4).astype(np.float32))
+        cache = _warm_cache(table, tp_ctx.mesh, capacity=8)
+        new_table = table + 1.0
+        ids = np.asarray([[0, 5, 7]])         # all hot -> all stale
+        got = cached_sharded_gather(cache, new_table, ids,
+                                    mesh=tp_ctx.mesh, axis="model")
+        np.testing.assert_allclose(got, np.asarray(table)[ids],
+                                   rtol=1e-6, atol=1e-7)
+        cache.refresh(table_row_reader(new_table))
+        got = cached_sharded_gather(cache, new_table, ids,
+                                    mesh=tp_ctx.mesh, axis="model")
+        np.testing.assert_allclose(got, np.asarray(new_table)[ids],
+                                   rtol=1e-6, atol=1e-7)
+
+    def test_layer_cached_forward_matches_forward(self, tp_ctx):
+        import jax
+
+        from analytics_zoo_tpu.nn.layers import ShardedEmbeddingTable
+        from analytics_zoo_tpu.parallel import (HotRowCache,
+                                                TableShardedStrategy,
+                                                table_row_reader)
+
+        lyr = ShardedEmbeddingTable(48, 8, combiner="mean", name="t")
+        p = lyr.build_params(jax.random.PRNGKey(0), (4, 3))
+        cache = HotRowCache("t", 16, dim=8, mesh=tp_ctx.mesh)
+        cache.record(np.arange(16))
+        cache.refresh(table_row_reader(p["table"]))
+        ids = np.asarray(
+            np.random.RandomState(0).randint(0, 48, (8, 3)), np.int32)
+        strat = TableShardedStrategy(tables=("t",))
+        with strat.activate(tp_ctx.mesh):
+            want = np.asarray(lyr.forward(p, ids))
+        got = lyr.cached_forward(p, ids, cache, axis="model")
+        np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-7)
+
+
+# ---------------------------------------------------------------------------
+# serving cache lifecycle (fast, in-process)
+# ---------------------------------------------------------------------------
+
+
+class TestServingHotCacheLifecycle:
+    def test_record_refresh_invalidate_through_serving(self):
+        """The whole serving lifecycle in one fast pod: the pipeline
+        builds one cache per sharded table, dispatch id streams fill
+        its frequency counts, the supervisor's ``hot_cache_refresh``
+        check populates the replica on the configured period, and a
+        ``swap_replicas`` hot reload invalidates it (then the next
+        supervisor pass rebuilds from the still-valid counts)."""
+        import time as _time
+
+        import jax
+        import jax.numpy as jnp
+
+        from analytics_zoo_tpu import init_zoo_context
+        from analytics_zoo_tpu.deploy import InferenceModel
+        from analytics_zoo_tpu.deploy.serving import (ClusterServing,
+                                                      InputQueue,
+                                                      MemoryQueue,
+                                                      OutputQueue,
+                                                      ServingConfig)
+        from analytics_zoo_tpu.nn import Input, Model
+        from analytics_zoo_tpu.nn.layers.core import Dense
+        from analytics_zoo_tpu.nn.layers.sharded_embedding import \
+            ShardedEmbeddingTable
+
+        try:
+            # refresh period 0: every supervisor pass refreshes, so the
+            # test needs no sleeps beyond the supervisor cadence
+            init_zoo_context(mesh_shape=(4, 2),
+                             axis_names=("data", "model"),
+                             table_hot_cache_capacity=16,
+                             table_hot_cache_refresh_s=0.0)
+            from analytics_zoo_tpu.core.context import get_zoo_context
+
+            mesh = get_zoo_context().mesh
+            ids_in = Input(shape=(4,), dtype=jnp.int32, name="ids")
+            bag = ShardedEmbeddingTable(64, 8, combiner="mean",
+                                        name="embed")(ids_in)
+            net = Model([ids_in], Dense(4, name="head")(bag),
+                        name="bagnet")
+            net._sharded_tables = ("embed",)
+            net.compile(optimizer="adam", loss="mse")
+            est = net.estimator
+            params, state = jax.jit(
+                lambda r: est.model.init(r, (2, 4)))(jax.random.PRNGKey(0))
+            m = InferenceModel.from_keras_net(net, params, state,
+                                              batch_buckets=(1, 4))
+            srv = ClusterServing(
+                m, MemoryQueue(),
+                ServingConfig(batch_size=4, replicas=1, mesh_replicas=1,
+                              supervisor_interval_s=0.05),
+                mesh=mesh).start()
+            try:
+                stats = srv.hot_cache_stats()
+                assert list(stats) == ["default/embed"]
+                assert stats["default/embed"]["capacity"] == 16
+
+                inq, outq = InputQueue(srv.queue), OutputQueue(srv.queue)
+                x = np.random.RandomState(0).randint(
+                    0, 64, (8, 4)).astype(np.int32)
+                rids = [inq.enqueue(ids=x[i]) for i in range(len(x))]
+                outs = [outq.query(r, timeout=60.0) for r in rids]
+                assert not any(isinstance(o, dict) and "error" in o
+                               for o in outs)
+
+                # dispatch recorded the id streams; the supervisor's
+                # refresh check populates the replica from them
+                deadline = _time.monotonic() + 10.0
+                while _time.monotonic() < deadline:
+                    s = srv.hot_cache_stats()["default/embed"]
+                    if s["cached_rows"] > 0:
+                        break
+                    _time.sleep(0.05)
+                assert s["tracked_ids"] > 0
+                assert 0 < s["cached_rows"] <= 16
+                v_before = s["version"]
+
+                # hot reload: the swap listener invalidates instantly…
+                srv._executor.swap_replicas(srv._build_replicas())
+                assert srv.hot_cache_stats()["default/embed"]["version"] \
+                    > v_before
+                # …and the next supervisor pass repopulates from the
+                # surviving frequency counts
+                deadline = _time.monotonic() + 10.0
+                while _time.monotonic() < deadline:
+                    s = srv.hot_cache_stats()["default/embed"]
+                    if s["cached_rows"] > 0:
+                        break
+                    _time.sleep(0.05)
+                assert s["cached_rows"] > 0
+            finally:
+                srv.stop()
+        finally:
+            init_zoo_context()
+
+    def test_knob_off_builds_no_caches(self, zoo_ctx):
+        import jax
+        import jax.numpy as jnp
+
+        from analytics_zoo_tpu import init_zoo_context
+        from analytics_zoo_tpu.deploy import InferenceModel
+        from analytics_zoo_tpu.nn import Input, Model
+        from analytics_zoo_tpu.nn.layers.core import Dense
+        from analytics_zoo_tpu.nn.layers.sharded_embedding import \
+            ShardedEmbeddingTable
+
+        ids_in = Input(shape=(4,), dtype=jnp.int32, name="ids")
+        bag = ShardedEmbeddingTable(64, 8, combiner="mean",
+                                    name="embed")(ids_in)
+        net = Model([ids_in], Dense(4, name="head")(bag), name="bagnet")
+        net._sharded_tables = ("embed",)
+        net.compile(optimizer="adam", loss="mse")
+        params, state = net.estimator.model.init(
+            jax.random.PRNGKey(0), (2, 4))
+        m = InferenceModel.from_keras_net(net, params, state)
+        try:
+            init_zoo_context(table_hot_cache="off")
+            assert m.enable_hot_caches() == {}
+            assert m.hot_caches() == {}
+        finally:
+            init_zoo_context()
+        assert m.enable_hot_caches(capacity=4)  # default auto builds
+        m.record_hot_ids([np.asarray([1, 2, 2], np.int32),
+                          np.zeros((2, 2), np.float32)])  # floats skip
+        assert m.hot_caches()["embed"].stats()["tracked_ids"] == 2

@@ -119,6 +119,20 @@ def test_concurrency_fixture():
     assert len(fs) == 5
 
 
+def test_hot_cache_fixture():
+    """The hot-row cache frequency-counter idiom (parallel/
+    hot_cache.py): the batcher thread bumping the shared counter / hot
+    set with no lock fires THR-SHARED-MUT — a torn read would replicate
+    the wrong rows; the shipped mutate-under-lock, replace-wholesale
+    twin stays quiet, so the cache keeps a clean lint bill by
+    construction, not by suppression."""
+    fs = fixture_findings("hot_cache.py")
+    assert scopes_of(fs, "THR-SHARED-MUT") == {"NaiveHotCounter._run"}
+    quiet = {"LockedHotCounter._run", "LockedHotCounter.top_ids"}
+    assert not quiet & {f.scope for f in fs}
+    assert len(fs) == 1
+
+
 def test_shm_ring_fixture():
     """The ring-buffer idiom behind deploy/shmqueue.py: an unlocked
     cross-thread cursor write fires THR-SHARED-MUT; the shipped
