@@ -750,9 +750,34 @@ class InferenceModel:
             one, getattr(self, "_params", None) or {})
         return found[0]
 
+    def _table_id_field_indices(self, tname: str,
+                                id_fields=None) -> Optional[tuple]:
+        """Input positions whose arrays carry ``tname``'s id stream:
+        an explicit ``id_fields`` entry wins, then the net's
+        ``_table_id_fields`` manifest, then the graph-ancestor trace
+        (``Model.input_ancestors``).  None means "unknown" — the
+        caller falls back to every integer input."""
+        names = None
+        if id_fields and tname in id_fields:
+            names = tuple(id_fields[tname])
+        else:
+            manifest = getattr(self._net, "_table_id_fields", None) or {}
+            if tname in manifest:
+                names = tuple(manifest[tname])
+            elif hasattr(self._net, "input_ancestors"):
+                # an empty trace means the manifest names a layer the
+                # graph doesn't apply — treat as unknown, not as "no
+                # id stream", so the cache still fills
+                names = self._net.input_ancestors(tname) or None
+        if names is None:
+            return None
+        inputs = [v.name for v in getattr(self._net, "inputs", [])]
+        return tuple(i for i, n in enumerate(inputs) if n in names)
+
     def enable_hot_caches(self, mesh=None, *, axis: str = "model",
                           capacity: Optional[int] = None,
                           refresh_period_s: Optional[float] = None,
+                          id_fields: Optional[Dict[str, Any]] = None,
                           clock=time.monotonic) -> Dict[str, Any]:
         """Build one :class:`~analytics_zoo_tpu.parallel.hot_cache.
         HotRowCache` per entry of the net's ``_sharded_tables`` manifest
@@ -762,12 +787,20 @@ class InferenceModel:
         come only from ``refresh_hot_caches`` re-reading the
         authoritative params, and ``invalidate_hot_caches`` runs on
         every ``swap_replicas`` / hot reload.  ``clock`` is injectable
-        for the staleness tests."""
+        for the staleness tests.
+
+        Each cache records only its OWN table's id streams: the input
+        fields feeding a table come from ``id_fields`` (table name ->
+        input-field names), the net's ``_table_id_fields`` manifest, or
+        the graph-ancestor trace — so a multi-table model's caches
+        never cross-pollute, and integer non-id inputs (lengths,
+        offsets, positions) never skew a ranking."""
         from analytics_zoo_tpu.ops.dispatch import config_knob
         from analytics_zoo_tpu.parallel.hot_cache import HotRowCache
 
         if config_knob("table_hot_cache", "auto") == "off":
             self._hot_caches: Dict[str, Any] = {}
+            self._hot_cache_fields: Dict[str, Any] = {}
             return {}
         if capacity is None:
             capacity = int(config_knob("table_hot_cache_capacity", 1024))
@@ -775,6 +808,7 @@ class InferenceModel:
             refresh_period_s = float(
                 config_knob("table_hot_cache_refresh_s", 30.0))
         caches: Dict[str, Any] = {}
+        fields: Dict[str, Any] = {}
         for tname in self.sharded_tables():
             leaf = self._table_leaf(tname)
             if leaf is None or len(getattr(leaf, "shape", ())) != 2:
@@ -785,24 +819,32 @@ class InferenceModel:
                 refresh_period_s=refresh_period_s, clock=clock,
                 mesh=mesh,
                 dtype=np.dtype(str(getattr(leaf, "dtype", "float32"))))
+            fields[tname] = self._table_id_field_indices(
+                tname, id_fields)
         self._hot_caches = caches
+        self._hot_cache_fields = fields
         return dict(caches)
 
     def hot_caches(self) -> Dict[str, Any]:
         return dict(getattr(self, "_hot_caches", None) or {})
 
     def record_hot_ids(self, xs) -> None:
-        """Fold a dispatch batch's integer arrays (the id streams the
-        batcher fused) into every table cache's frequency counts."""
+        """Fold a dispatch batch's id streams into the table caches'
+        frequency counts — each cache sees only the input positions
+        mapped to ITS table (``enable_hot_caches``); a table with no
+        known mapping falls back to every integer array."""
         caches = getattr(self, "_hot_caches", None)
         if not caches:
             return
-        for x in xs:
-            a = np.asarray(x)
-            if a.dtype.kind not in "iu":
-                continue
-            for c in caches.values():
-                c.record(a)
+        fields = getattr(self, "_hot_cache_fields", None) or {}
+        arrays = [np.asarray(x) for x in xs]
+        int_idx = [i for i, a in enumerate(arrays)
+                   if a.dtype.kind in "iu"]
+        for tname, c in caches.items():
+            idx = fields.get(tname)
+            for i in (int_idx if idx is None
+                      else [i for i in idx if i in int_idx]):
+                c.record(arrays[i])
 
     def refresh_hot_caches(self, force: bool = False) -> int:
         """Re-rank + re-read every cache from the authoritative table

@@ -257,6 +257,29 @@ class Model(KerasNet):
                 seen[v.layer.name] = v.layer
         return list(seen.values())
 
+    def input_ancestors(self, layer_name: str) -> Tuple[str, ...]:
+        """Names of the graph inputs whose values (transitively) feed
+        any application of the layer called ``layer_name``, in input
+        order.  This is the input-field-to-table manifest the serving
+        hot-row caches use to record each sharded table's OWN id
+        streams — not every integer input of the model (deploy/
+        inference.py ``record_hot_ids``)."""
+        targets = [v for v in self.order
+                   if v.kind in ("layer", "param")
+                   and v.layer.name == layer_name]
+        found: set = set()
+        stack = [p for t in targets for p in t.parents]
+        seen_ids = set()
+        while stack:
+            v = stack.pop()
+            if v.id in seen_ids:
+                continue
+            seen_ids.add(v.id)
+            if v.kind == "input":
+                found.add(v.id)
+            stack.extend(v.parents)
+        return tuple(v.name for v in self.inputs if v.id in found)
+
     # -- functional protocol ----------------------------------------------
     def build(self, rng, *input_shapes):
         if not input_shapes:

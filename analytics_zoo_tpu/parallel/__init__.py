@@ -34,6 +34,7 @@ from analytics_zoo_tpu.parallel.table_sharding import (  # noqa: F401
     sharded_gather,
 )
 from analytics_zoo_tpu.parallel.hot_cache import (  # noqa: F401
+    CacheSnapshot,
     HotRowCache,
     cached_sharded_bag,
     cached_sharded_gather,

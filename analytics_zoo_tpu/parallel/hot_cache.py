@@ -6,9 +6,10 @@ tables (PR 14) absorbs most lookups, yet ``sharded_bag`` pays the full
 the serving-side second tier:
 
 - :class:`HotRowCache` tracks per-id lookup frequency from the batcher's
-  id streams (count-based, lock-guarded, injectable clock), keeps a
-  small replica of the top-K most-frequent rows — replicated on every
-  chip when a mesh is attached, so a hit never crosses a link — and
+  id streams (count-based, lock-guarded, bounded by a lossy-counting
+  decay, injectable clock), keeps a small host-side replica of the
+  top-K most-frequent rows — consulted *before* dispatch, so a hit
+  never enters a device program, touches HBM, or crosses a link — and
   refreshes the replica values from the authoritative shards on a
   period (staleness is bounded by ``refresh_period_s``).
 - :func:`cached_sharded_gather` / :func:`cached_sharded_bag` route each
@@ -24,10 +25,11 @@ within-batch dedup in ``ops.embedding_bag``), and serving invalidates
 it on ``swap_replicas`` / hot reload so a weight swap can never serve
 rows older than the next refresh.
 
-Every lookup is counted: ``table_hot_cache_lookups_total{outcome,
-table}``, ``table_hot_cache_bytes_saved_total{table}`` (exchange bytes
-the hot ids did NOT ride the psum), ``table_hot_cache_refresh_total
-{event,table}``, and the ``table_hot_cache_hit_rate{table}`` gauge.
+Every *valid* lookup is counted (pad slots are excluded from routing
+and metrics alike): ``table_hot_cache_lookups_total{outcome, table}``,
+``table_hot_cache_bytes_saved_total{table}`` (exchange bytes the hot
+ids did NOT ride the psum), ``table_hot_cache_refresh_total{event,
+table}``, and the ``table_hot_cache_hit_rate{table}`` gauge.
 """
 
 from __future__ import annotations
@@ -35,18 +37,25 @@ from __future__ import annotations
 import threading
 import time
 from collections import Counter
-from typing import Callable, Optional, Tuple
+from typing import Callable, NamedTuple, Optional, Tuple
 
 import numpy as np
 
 from analytics_zoo_tpu.observe import metrics as obs
 
-__all__ = ["HotRowCache", "cached_sharded_bag", "cached_sharded_gather",
-           "cold_bucket", "table_row_reader"]
+__all__ = ["HotRowCache", "CacheSnapshot", "cached_sharded_bag",
+           "cached_sharded_gather", "cold_bucket", "table_row_reader"]
 
 # the smallest cold-id program; buckets grow by powers of two above it,
 # so a vocab-V table compiles at most log2(V) cold programs
 MIN_COLD_BUCKET = 8
+
+# default frequency-tracker bound: this many tracked ids per replica
+# slot (and never fewer than TRACKED_FLOOR) before the lossy-counting
+# decay kicks in — a 1024-row cache tracks at most 32Ki ids, not the
+# whole 10^8-row vocab
+TRACKED_PER_SLOT = 32
+TRACKED_FLOOR = 1024
 
 
 def cold_bucket(n: int) -> int:
@@ -59,21 +68,44 @@ def cold_bucket(n: int) -> int:
     return b
 
 
+class CacheSnapshot(NamedTuple):
+    """One immutable view of the replica: ``sorted_ids``/``rows`` are
+    the arrays a refresh installed together (never edited in place),
+    ``version`` the install counter.  ``route``/``take`` against the
+    SAME snapshot are consistent no matter how many refreshes or
+    invalidations land in between."""
+    sorted_ids: np.ndarray
+    rows: np.ndarray
+    version: int
+
+
 class HotRowCache:
     """Top-K hot-row replica of one sharded table, frequency-ranked.
 
     Thread-safe: ``record`` runs on batcher/decode threads while
     ``route``/``refresh`` run on dispatch threads, so every shared
     mutation is taken under one lock.  The replica arrays themselves
-    are replaced wholesale on refresh (never mutated in place), so a
-    reader holding a pre-refresh snapshot sees a consistent, merely
-    stale, view.  ``clock`` is injectable for the staleness tests.
+    are replaced wholesale on refresh (never mutated in place); a
+    multi-step reader MUST pin one :meth:`snapshot` and pass it to both
+    ``route`` and ``take`` — that pair then sees a consistent, merely
+    stale, view even when a refresh or invalidate lands between the
+    calls.  ``clock`` is injectable for the staleness tests.
+
+    ``mesh`` is carried only as the default mesh for the cold-path
+    ``sharded_gather`` in the ``cached_*`` helpers; the replica itself
+    is host memory (a hit costs zero HBM and zero ICI bytes).
+
+    ``max_tracked_ids`` bounds the frequency tracker: past the bound
+    every count is halved and zeros pruned (lossy counting — heavy
+    hitters keep their relative order), then the smallest survivors
+    dropped, so host memory stays O(bound) over any vocab.
     """
 
     def __init__(self, table: str, capacity: int, dim: int, *,
                  refresh_period_s: float = 30.0,
                  clock: Callable[[], float] = time.monotonic,
-                 mesh=None, dtype=np.float32):
+                 mesh=None, dtype=np.float32,
+                 max_tracked_ids: Optional[int] = None):
         if capacity <= 0:
             raise ValueError(f"capacity must be positive, got {capacity}")
         self.table = str(table)
@@ -82,13 +114,20 @@ class HotRowCache:
         self.refresh_period_s = float(refresh_period_s)
         self.mesh = mesh
         self.dtype = np.dtype(dtype)
+        self.max_tracked_ids = int(
+            max(TRACKED_FLOOR, TRACKED_PER_SLOT * self.capacity)
+            if max_tracked_ids is None else max_tracked_ids)
+        if self.max_tracked_ids < self.capacity:
+            raise ValueError(
+                f"max_tracked_ids ({self.max_tracked_ids}) must be >= "
+                f"capacity ({self.capacity})")
         self._clock = clock
         self._lock = threading.Lock()
         self._counts: Counter = Counter()
-        # replica state; all three replaced together under the lock
+        # replica state; replaced together under the lock, published to
+        # readers only as a CacheSnapshot
         self._sorted_ids = np.empty((0,), np.int64)
         self._rows = np.zeros((0, self.dim), self.dtype)
-        self._device_rows = None
         self._version = 0
         self._last_refresh: Optional[float] = None
         self._hits = 0
@@ -104,6 +143,21 @@ class HotRowCache:
         with self._lock:
             for v, c in zip(vals.tolist(), cnts.tolist()):
                 self._counts[v] += c
+            if len(self._counts) > self.max_tracked_ids:
+                self._shrink_counts_locked()
+
+    def _shrink_counts_locked(self) -> None:
+        """Lossy-counting decay, called under ``self._lock``: halve
+        every count and prune zeros; if the survivors still exceed the
+        bound, keep only the heaviest ``max_tracked_ids`` (count desc,
+        id asc — the same deterministic order ``top_ids`` ranks by)."""
+        self._counts = Counter(
+            {k: v >> 1 for k, v in self._counts.items() if v >> 1 > 0})
+        if len(self._counts) > self.max_tracked_ids:
+            items = sorted(self._counts.items(),
+                           key=lambda kv: (-kv[1], kv[0]))
+            self._counts = Counter(
+                dict(items[:self.max_tracked_ids]))
 
     def top_ids(self) -> np.ndarray:
         """The current top-``capacity`` ids by observed frequency
@@ -128,20 +182,9 @@ class HotRowCache:
                 f"row_reader returned {rows.shape} for {ids.size} ids "
                 f"of dim {self.dim}")
         order = np.argsort(ids, kind="stable")
-        dev = None
-        if self.mesh is not None and ids.size:
-            # the replicated placement IS the claim: every chip holds
-            # the K hot rows locally, so a hit never crosses a link
-            import jax
-            from jax.sharding import NamedSharding, PartitionSpec
-
-            with jax.transfer_guard("allow"):
-                dev = jax.device_put(
-                    rows, NamedSharding(self.mesh, PartitionSpec()))
         with self._lock:
             self._sorted_ids = ids[order]
             self._rows = rows[order]
-            self._device_rows = dev
             self._version += 1
             self._last_refresh = self._clock()
         obs.count("table_hot_cache_refresh_total", 1,
@@ -167,7 +210,6 @@ class HotRowCache:
         with self._lock:
             self._sorted_ids = np.empty((0,), np.int64)
             self._rows = np.zeros((0, self.dim), self.dtype)
-            self._device_rows = None
             self._version += 1
             self._last_refresh = None
         obs.count("table_hot_cache_refresh_total", 1,
@@ -175,13 +217,24 @@ class HotRowCache:
                   event=f"invalidate_{reason}", table=self.table)
 
     # -- lookup routing ----------------------------------------------------
-    def route(self, ids) -> Tuple[np.ndarray, np.ndarray]:
-        """Split one flat id block into (slots, hot): ``hot[i]`` true
-        where ``ids[i]`` is cached, ``slots[i]`` its replica row index.
-        Counts hits/misses/bytes-saved and updates the hit-rate gauge."""
-        flat = np.asarray(ids).reshape(-1).astype(np.int64)
+    def snapshot(self) -> CacheSnapshot:
+        """The current replica view under ONE lock acquisition — the
+        unit of consistency for a ``route``/``take`` pair."""
         with self._lock:
-            sids = self._sorted_ids
+            return CacheSnapshot(self._sorted_ids, self._rows,
+                                 self._version)
+
+    def route(self, ids, snapshot: Optional[CacheSnapshot] = None
+              ) -> Tuple[np.ndarray, np.ndarray]:
+        """Split one flat id block into (slots, hot): ``hot[i]`` true
+        where ``ids[i]`` is cached, ``slots[i]`` its replica row index
+        *within ``snapshot``* (pass the same snapshot to ``take`` — a
+        refresh between the calls re-ranks the replica, so indices are
+        only meaningful against the snapshot they were computed from).
+        Counts hits/misses/bytes-saved and updates the hit-rate gauge."""
+        snap = snapshot if snapshot is not None else self.snapshot()
+        flat = np.asarray(ids).reshape(-1).astype(np.int64)
+        sids = snap.sorted_ids
         if sids.size == 0:
             slots = np.full(flat.shape, -1, np.int64)
             hot = np.zeros(flat.shape, bool)
@@ -212,10 +265,14 @@ class HotRowCache:
                       table=self.table)
         return slots, hot
 
-    def take(self, slots) -> np.ndarray:
-        """Replica rows for ``slots`` (as returned hot by ``route``)."""
-        with self._lock:
-            rows = self._rows
+    def take(self, slots, snapshot: Optional[CacheSnapshot] = None
+             ) -> np.ndarray:
+        """Replica rows for ``slots`` — which MUST come from a ``route``
+        against the SAME ``snapshot`` (without one, both calls race any
+        concurrent refresh/invalidate and may index a re-ranked or
+        emptied replica)."""
+        rows = snapshot.rows if snapshot is not None \
+            else self.snapshot().rows
         return rows[np.asarray(slots, np.int64)]
 
     # -- introspection -----------------------------------------------------
@@ -229,6 +286,7 @@ class HotRowCache:
             return {"table": self.table, "capacity": self.capacity,
                     "cached_rows": int(self._sorted_ids.size),
                     "tracked_ids": len(self._counts),
+                    "max_tracked_ids": self.max_tracked_ids,
                     "hits": self._hits, "lookups": self._lookups,
                     "hit_rate": self._hits / max(1, self._lookups),
                     "version": self._version,
@@ -260,17 +318,21 @@ def _two_tier_rows(cache: HotRowCache, table, flat: np.ndarray, *,
                    mesh, axis: str) -> np.ndarray:
     """(n, D) rows for a flat clipped id block: hot from the replica,
     cold deduped host-side and fetched through one bounded
-    ``sharded_gather`` program (none at all when fully hot)."""
+    ``sharded_gather`` program (none at all when fully hot).  One
+    snapshot covers both the routing and the row reads, so a refresh
+    or invalidate landing mid-lookup can never mix two replica
+    generations (or index an emptied one)."""
     import jax
     import jax.numpy as jnp
 
     from analytics_zoo_tpu.parallel.table_sharding import sharded_gather
 
     dim = int(table.shape[1])
-    slots, hot = cache.route(flat)
+    snap = cache.snapshot()
+    slots, hot = cache.route(flat, snapshot=snap)
     out = np.zeros((flat.size, dim), cache.dtype)
     if hot.any():
-        out[hot] = cache.take(slots[hot])
+        out[hot] = cache.take(slots[hot], snapshot=snap)
     cold = flat[~hot]
     if cold.size:
         uniq = np.unique(cold)
@@ -308,8 +370,10 @@ def cached_sharded_bag(cache: HotRowCache, table, ids,
                        record: bool = True) -> np.ndarray:
     """Two-tier ``embedding_bag`` over a sharded table: (B, N) ids ->
     (B, D), same mask/clip/combiner semantics as ``sharded_bag`` (pad
-    slots contribute exact zeros and don't pollute the frequency
-    counts), parity at rtol 1e-6 against the uncached path."""
+    slots contribute exact zeros and touch NOTHING — not the frequency
+    counts, not the hit/miss metrics, not the cold exchange; an all-pad
+    batch runs no lookup at all), parity at rtol 1e-6 against the
+    uncached path."""
     if combiner not in ("sum", "mean", "sqrtn"):
         raise ValueError(f"combiner must be sum|mean|sqrtn, "
                          f"got {combiner!r}")
@@ -321,11 +385,15 @@ def cached_sharded_bag(cache: HotRowCache, table, ids,
     mask = (np.ones(ids_np.shape, np.float32) if pad_id is None
             else (ids_np != pad_id).astype(np.float32))
     clipped = np.clip(ids_np.astype(np.int64), 0, vocab - 1)
-    flat = np.where(mask > 0, clipped, 0).reshape(-1)
+    valid = mask.reshape(-1) > 0
+    flat = clipped.reshape(-1)[valid]
     if record:
-        cache.record(clipped.reshape(-1)[mask.reshape(-1) > 0])
-    rows = _two_tier_rows(cache, table, flat, mesh=mesh, axis=axis)
-    rows = rows.reshape(ids_np.shape + (cache.dim,)).astype(np.float32)
+        cache.record(flat)
+    rows = np.zeros((ids_np.size, cache.dim), np.float32)
+    if flat.size:
+        rows[valid] = _two_tier_rows(cache, table, flat, mesh=mesh,
+                                     axis=axis).astype(np.float32)
+    rows = rows.reshape(ids_np.shape + (cache.dim,))
     out = np.sum(rows * mask[..., None], axis=1)
     if combiner != "sum":
         n = np.maximum(mask.sum(axis=1, keepdims=True), 1.0)

@@ -1760,8 +1760,8 @@ def bench_table_hot_cache_child(tiny=False):
         "exchange_reduction_bucketed": _safe_ratio(B * D * 4,
                                                    bucket * D * 4),
         # HBM: naive reads one big-table row per slot; dedup+cache
-        # reads each distinct cold row once (hot rows live in the
-        # K-row chip-local replica)
+        # reads each distinct cold row once (hot rows serve from the
+        # K-row host-side replica, touching no HBM at all)
         "hbm_rows_touched_naive": B,
         "hbm_rows_touched_dedup_cached": cold_unique,
         "hbm_reduction": _safe_ratio(B, cold_unique),

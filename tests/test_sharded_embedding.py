@@ -860,6 +860,55 @@ class TestHotRowCache:
         _, hot = c.route([2, 5])
         assert hot.all()
 
+    def test_snapshot_pins_route_take_across_refresh(self):
+        """The route/take atomicity contract: both calls against ONE
+        snapshot stay consistent even when a refresh re-ranks (or an
+        invalidate empties) the replica between them — the race a
+        supervisor refresh landing mid-lookup would otherwise hit."""
+        table = np.arange(64, dtype=np.float32).reshape(16, 4)
+        c = self._cache(table, capacity=2)
+        c.record([3, 3, 9])
+        c.refresh(lambda ids: table[np.asarray(ids, np.int64)])
+        snap = c.snapshot()
+        slots, hot = c.route([3, 9], snapshot=snap)
+        assert hot.all()
+        # a refresh with a DIFFERENT ranking lands mid-lookup...
+        c.record([11] * 10 + [14] * 9)
+        c.refresh(lambda ids: table[np.asarray(ids, np.int64)])
+        np.testing.assert_array_equal(
+            c.snapshot().sorted_ids, [11, 14])   # replica re-ranked
+        # ...but the pinned snapshot still serves the routed ids' rows
+        np.testing.assert_array_equal(c.take(slots, snapshot=snap),
+                                      table[[3, 9]])
+        # even a full invalidate can't break the pinned pair
+        c.invalidate("swap")
+        np.testing.assert_array_equal(c.take(slots, snapshot=snap),
+                                      table[[3, 9]])
+        # an UN-pinned take against the emptied replica is exactly the
+        # hazard the snapshot exists to avoid
+        with pytest.raises(IndexError):
+            c.take(slots)
+
+    def test_tracked_ids_bounded_heavy_hitters_survive(self):
+        """The frequency tracker never exceeds ``max_tracked_ids`` no
+        matter how wide the id stream — and the lossy-counting decay
+        keeps the heavy hitters ranked on top."""
+        from analytics_zoo_tpu.parallel import HotRowCache
+
+        c = HotRowCache("t/bound", 2, dim=4, max_tracked_ids=8)
+        c.record([5] * 50 + [7] * 40)         # the heavy hitters
+        for start in range(100, 160, 20):     # wide singleton tail
+            c.record(np.arange(start, start + 20))
+        s = c.stats()
+        assert s["max_tracked_ids"] == 8
+        assert s["tracked_ids"] <= 8
+        np.testing.assert_array_equal(c.top_ids(), [5, 7])
+        # default bound scales with capacity, floored
+        d = HotRowCache("t/dflt", 1024, dim=4)
+        assert d.max_tracked_ids == 32 * 1024
+        with pytest.raises(ValueError, match="max_tracked_ids"):
+            HotRowCache("t/bad", 16, dim=4, max_tracked_ids=4)
+
     def test_bad_inputs_rejected(self):
         from analytics_zoo_tpu.parallel import HotRowCache
 
@@ -1002,6 +1051,44 @@ class TestCachedShardedLookup:
                                     mesh=tp_ctx.mesh, axis="model")
         np.testing.assert_allclose(got, np.asarray(new_table)[ids],
                                    rtol=1e-6, atol=1e-7)
+
+    @pytest.mark.transfer_guard
+    def test_pad_slots_skip_route_metrics_and_cold(self, tp_ctx):
+        """Pad slots never enter the routing tier: they count in NO
+        lookup metric (the hit-rate gauge the bench pins stays pure
+        traffic) and an all-pad bag triggers NO cold exchange at all —
+        it completes under the transfer guard on an EMPTY cache."""
+        import jax
+        import jax.numpy as jnp
+
+        from analytics_zoo_tpu.observe.metrics import METRICS
+        from analytics_zoo_tpu.parallel import (HotRowCache,
+                                                cached_sharded_bag)
+
+        rs = np.random.RandomState(25)
+        table = jnp.asarray(rs.randn(32, 4).astype(np.float32))
+        cache = HotRowCache("t/pads", 8, dim=4, mesh=tp_ctx.mesh)
+        before = METRICS.snapshot().counters
+        ids = np.zeros((3, 5), np.int64)      # every slot is the pad
+        with jax.transfer_guard("disallow"):  # no cold fetch allowed
+            got = cached_sharded_bag(cache, table, ids, "mean",
+                                     pad_id=0, mesh=tp_ctx.mesh,
+                                     axis="model")
+        np.testing.assert_array_equal(got, np.zeros((3, 4), np.float32))
+        after = METRICS.snapshot().counters
+        for outcome in ("hit", "miss"):
+            key = ("table_hot_cache_lookups_total",
+                   (("outcome", outcome), ("table", "t/pads")))
+            assert after.get(key, 0) == before.get(key, 0)
+        assert cache.stats()["lookups"] == 0
+        # a mixed bag routes (and counts) ONLY its valid slots
+        warm = _warm_cache(table, tp_ctx.mesh, capacity=8)
+        mixed = np.asarray([[3, 5, 0, 0, 0]], np.int64)
+        with jax.transfer_guard("disallow"):  # both valid ids are hot
+            cached_sharded_bag(warm, table, mixed, "sum", pad_id=0,
+                               mesh=tp_ctx.mesh, axis="model")
+        assert warm.stats()["lookups"] == 2
+        assert warm.stats()["hits"] == 2
 
     def test_layer_cached_forward_matches_forward(self, tp_ctx):
         import jax
@@ -1155,3 +1242,50 @@ class TestServingHotCacheLifecycle:
         m.record_hot_ids([np.asarray([1, 2, 2], np.int32),
                           np.zeros((2, 2), np.float32)])  # floats skip
         assert m.hot_caches()["embed"].stats()["tracked_ids"] == 2
+
+    def test_record_hot_ids_routes_per_table(self, zoo_ctx):
+        """Each table's cache records ONLY its own id streams: the
+        graph-ancestor trace maps input fields to tables, so a
+        multi-table model never cross-pollutes rankings and an integer
+        non-id input (lengths here) never enters any cache."""
+        import jax
+        import jax.numpy as jnp
+
+        from analytics_zoo_tpu.deploy import InferenceModel
+        from analytics_zoo_tpu.nn import Input, Model
+        from analytics_zoo_tpu.nn.layers.core import Dense
+        from analytics_zoo_tpu.nn.layers.merge import merge
+        from analytics_zoo_tpu.nn.layers.sharded_embedding import \
+            ShardedEmbeddingTable
+
+        u_in = Input(shape=(2,), dtype=jnp.int32, name="user")
+        i_in = Input(shape=(2,), dtype=jnp.int32, name="item")
+        l_in = Input(shape=(1,), dtype=jnp.int32, name="lengths")
+        ue = ShardedEmbeddingTable(32, 4, combiner="mean",
+                                   name="u_embed")(u_in)
+        ie = ShardedEmbeddingTable(32, 4, combiner="mean",
+                                   name="i_embed")(i_in)
+        head = Dense(2, name="head")(merge([ue, ie], mode="concat"))
+        net = Model([u_in, i_in, l_in], head, name="two_tables")
+        net._sharded_tables = ("u_embed", "i_embed")
+        assert net.input_ancestors("u_embed") == ("user",)
+        assert net.input_ancestors("i_embed") == ("item",)
+        net.compile(optimizer="adam", loss="mse")
+        params, state = net.estimator.model.init(
+            jax.random.PRNGKey(0), (2, 2), (2, 2), (2, 1))
+        m = InferenceModel.from_keras_net(net, params, state)
+        m.enable_hot_caches(capacity=4)
+        m.record_hot_ids([np.asarray([1, 2, 2], np.int32),   # user
+                          np.asarray([9, 9, 10], np.int32),  # item
+                          np.asarray([7, 7, 7], np.int32)])  # lengths
+        u, i = m.hot_caches()["u_embed"], m.hot_caches()["i_embed"]
+        np.testing.assert_array_equal(np.sort(u.top_ids()), [1, 2])
+        np.testing.assert_array_equal(np.sort(i.top_ids()), [9, 10])
+        # explicit id_fields override beats the trace
+        m.enable_hot_caches(capacity=4,
+                            id_fields={"u_embed": ("item",)})
+        m.record_hot_ids([np.asarray([1, 1], np.int32),
+                          np.asarray([5, 6], np.int32),
+                          np.asarray([8], np.int32)])
+        np.testing.assert_array_equal(
+            np.sort(m.hot_caches()["u_embed"].top_ids()), [5, 6])
